@@ -1,0 +1,21 @@
+"""DeepSpeed-TPU: TPU-native large-model training & inference framework.
+
+Brand-new JAX/XLA/Pallas implementation of the capabilities of the reference
+DeepSpeed (jpli02/DeepSpeed v0.16.4); see SURVEY.md for the component map.
+The top-level API mirrors the reference's ``deepspeed/__init__.py``
+(``initialize`` at :69, ``init_inference`` at :291) in spirit while being
+functional underneath.
+"""
+
+__version__ = "0.1.0"
+
+from deepspeed_tpu.utils.logging import logger, log_dist  # noqa: F401
+from deepspeed_tpu.config import DeepSpeedConfig, load_config  # noqa: F401
+import deepspeed_tpu.comm as comm  # noqa: F401
+
+
+def initialize(*args, **kwargs):
+    """Create a training engine (reference ``deepspeed.initialize``)."""
+    from deepspeed_tpu.runtime.engine import initialize as _init
+
+    return _init(*args, **kwargs)

@@ -1,0 +1,34 @@
+from deepspeed_tpu.comm.comm import (
+    init_distributed,
+    is_initialized,
+    initialize_mesh,
+    set_topology,
+    get_topology,
+    get_mesh,
+    get_world_size,
+    get_rank,
+    get_local_rank,
+    get_process_count,
+    barrier,
+    all_reduce,
+    inference_all_reduce,
+    all_gather,
+    reduce_scatter,
+    all_to_all,
+    ppermute,
+    broadcast,
+    axis_index,
+    log_summary,
+    configure,
+    comms_logger,
+)
+from deepspeed_tpu.comm.comms_logging import CommsLogger, get_bw
+
+__all__ = [
+    "init_distributed", "is_initialized", "initialize_mesh", "set_topology",
+    "get_topology", "get_mesh", "get_world_size", "get_rank", "get_local_rank",
+    "get_process_count", "barrier", "all_reduce", "inference_all_reduce",
+    "all_gather", "reduce_scatter", "all_to_all", "ppermute", "broadcast",
+    "axis_index", "log_summary", "configure", "comms_logger", "CommsLogger",
+    "get_bw",
+]

@@ -1,0 +1,135 @@
+"""Communication op logging.
+
+Re-creation of the reference's ``deepspeed/utils/comms_logging.py:67``
+(``CommsLogger``) and the bus-bandwidth math in ``get_bw``: every collective
+issued through the ``deepspeed_tpu.comm`` facade is recorded (op name,
+message size, world size, latency when measurable) and ``log_summary``
+prints the per-op table with algorithmic and bus bandwidth plus an optional
+straggler effect (max-latency vs avg-latency difference across calls).
+
+Under ``jit`` individual collectives cannot be wall-clock timed from the
+host (XLA fuses and overlaps them); those records carry ``latency=None`` and
+the summary reports counts/volumes only — per-op device timing belongs to
+the profiler (``jax.profiler`` traces).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def get_bw(comm_op: str, size_bytes: int, duration_s: float, n: int) -> Dict[str, float]:
+    """Algorithmic / bus bandwidth in GB/s (reference ``get_bw``)."""
+    if duration_s <= 0:
+        return {"algbw": 0.0, "busbw": 0.0}
+    tput = size_bytes / duration_s
+    if comm_op in ("all_to_all", "all_to_all_single", "all_gather",
+                   "all_gather_into_tensor", "reduce_scatter",
+                   "reduce_scatter_tensor"):
+        busbw = tput * ((n - 1) / n) if n > 0 else tput
+    elif comm_op in ("all_reduce",):
+        busbw = tput * (2 * (n - 1) / n) if n > 0 else tput
+    elif comm_op in ("send", "recv", "isend", "irecv", "broadcast", "reduce",
+                     "gather", "scatter", "barrier", "ppermute"):
+        busbw = tput
+    else:
+        busbw = tput
+    return {"algbw": tput / 1e9, "busbw": busbw / 1e9}
+
+
+def calc_bw_log(comm_op: str, size: int, duration: float, n: int):
+    bws = get_bw(comm_op, size, duration, n)
+    return bws["algbw"], bws["busbw"]
+
+
+def convert_size(size_bytes: int) -> str:
+    if size_bytes == 0:
+        return "0B"
+    names = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    return f"{round(size_bytes / p, 2)} {names[i]}"
+
+
+class CommsLogger:
+    """Per-op record book (reference ``CommsLogger``)."""
+
+    def __init__(self, enabled: bool = False, verbose: bool = False,
+                 prof_all: bool = True, debug: bool = False,
+                 prof_ops: Optional[List[str]] = None):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.debug = debug
+        self.prof_ops = prof_ops or []
+        # op_name -> msg_size -> [count, total_lat, [lats...], world]
+        self.comms_dict: Dict[str, Dict[int, list]] = {}
+
+    def configure(self, config) -> None:
+        self.enabled = config.enabled
+        self.verbose = config.verbose
+        self.prof_all = config.prof_all
+        self.debug = config.debug
+        self.prof_ops = list(config.prof_ops)
+
+    def should_profile(self, op_name: str) -> bool:
+        if not self.enabled:
+            return False
+        if self.prof_ops:
+            return op_name in self.prof_ops
+        return self.prof_all
+
+    def append(self, op_name: str, size: int, world: int,
+               latency: Optional[float] = None, log_name: Optional[str] = None) -> None:
+        if not self.should_profile(op_name):
+            return
+        key = log_name or op_name
+        per_op = self.comms_dict.setdefault(key, {})
+        rec = per_op.setdefault(size, [0, 0.0, [], world])
+        rec[0] += 1
+        if latency is not None:
+            rec[1] += latency
+            rec[2].append(latency)
+        rec[3] = world
+        if self.verbose:
+            if latency is not None:
+                algbw, busbw = calc_bw_log(op_name, size, latency, world)
+                logger.info(
+                    f"comm op: {key} | time (ms): {latency * 1000:.2f} | "
+                    f"msg size: {convert_size(size)} | algbw (Gbps): {algbw * 8:.2f} | "
+                    f"busbw (Gbps): {busbw * 8:.2f}")
+            else:
+                logger.info(f"comm op: {key} (traced) | msg size: {convert_size(size)} "
+                            f"| world: {world}")
+
+    def log_summary(self, show_straggler: bool = False) -> str:
+        lines = []
+        header = (f"{'Comm. Op':<25}{'Message Size':<18}{'Count':<8}"
+                  f"{'Total Lat(ms)':<16}{'Avg Lat(ms)':<14}{'algbw(Gbps)':<14}"
+                  f"{'busbw(Gbps)':<14}")
+        lines.append(header)
+        for op_name, sizes in sorted(self.comms_dict.items()):
+            for size, (count, total_lat, lats, world) in sorted(sizes.items()):
+                if lats:
+                    avg = total_lat / len(lats)
+                    algbw, busbw = calc_bw_log(op_name, size, avg, world)
+                    lines.append(
+                        f"{op_name:<25}{convert_size(size):<18}{count:<8}"
+                        f"{total_lat * 1000:<16.2f}{avg * 1000:<14.2f}"
+                        f"{algbw * 8:<14.2f}{busbw * 8:<14.2f}")
+                    if show_straggler and lats:
+                        worst = max(lats)
+                        lines.append(f"{'':<25}{'straggler effect':<18}"
+                                     f"{(worst - avg) * 1000:.2f} ms")
+                else:
+                    lines.append(
+                        f"{op_name:<25}{convert_size(size):<18}{count:<8}"
+                        f"{'traced':<16}{'-':<14}{'-':<14}{'-':<14}")
+        out = "\n".join(lines)
+        logger.info("\n" + out)
+        return out
+
+    def reset(self) -> None:
+        self.comms_dict = {}

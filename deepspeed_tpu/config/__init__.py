@@ -1,0 +1,29 @@
+from deepspeed_tpu.config.config import (
+    DeepSpeedConfig,
+    FP16Config,
+    BF16Config,
+    ZeroConfig,
+    OptimizerConfig,
+    SchedulerConfig,
+    TensorParallelConfig,
+    PipelineParallelConfig,
+    SequenceParallelConfig,
+    ExpertParallelConfig,
+    ActivationCheckpointingConfig,
+    FlopsProfilerConfig,
+    CommsLoggerConfig,
+    MonitorConfig,
+    CheckpointConfig,
+    ElasticityConfig,
+    load_config,
+)
+from deepspeed_tpu.config.config_utils import ConfigModel, AUTO
+
+__all__ = [
+    "DeepSpeedConfig", "FP16Config", "BF16Config", "ZeroConfig",
+    "OptimizerConfig", "SchedulerConfig", "TensorParallelConfig",
+    "PipelineParallelConfig", "SequenceParallelConfig", "ExpertParallelConfig",
+    "ActivationCheckpointingConfig", "FlopsProfilerConfig", "CommsLoggerConfig",
+    "MonitorConfig", "CheckpointConfig", "ElasticityConfig", "load_config",
+    "ConfigModel", "AUTO",
+]

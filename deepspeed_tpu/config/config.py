@@ -1,0 +1,448 @@
+"""The single-JSON config tree.
+
+TPU-native re-design of ``deepspeed/runtime/config.py:707``
+(``DeepSpeedConfig``) and its per-feature pydantic subtrees.  Field names are
+kept JSON-compatible with the reference (``train_batch_size``,
+``zero_optimization.stage``, ``bf16.enabled``, ...) so existing DeepSpeed
+configs parse unchanged; GPU-only knobs are accepted and ignored with a
+warning.  The batch triple reconciliation
+(``train_batch_size = micro_batch * gradient_accumulation_steps * dp_world``)
+mirrors ``_configure_train_batch_size`` in the reference.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.config.config_utils import ConfigModel
+from deepspeed_tpu.utils.logging import logger
+
+# ---------------------------------------------------------------------------
+# Precision
+# ---------------------------------------------------------------------------
+
+
+class FP16Config(ConfigModel):
+    """``fp16`` subtree (reference ``runtime/fp16/loss_scaler.py`` knobs)."""
+
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 => dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+
+
+class BF16Config(ConfigModel):
+    """``bf16`` subtree. On TPU this is the default precision."""
+
+    enabled: bool = False
+    # Keep an fp32 master copy of params in the optimizer (reference
+    # BF16_Optimizer semantics). Disable for pure-bf16 experiments.
+    master_weights: bool = True
+
+
+# ---------------------------------------------------------------------------
+# ZeRO
+# ---------------------------------------------------------------------------
+
+
+class OffloadDeviceEnum:
+    none = "none"
+    cpu = "cpu"  # == TPU host memory (pinned_host)
+    nvme = "nvme"
+
+
+class OffloadParamConfig(ConfigModel):
+    device: str = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    max_in_cpu: int = 1_000_000_000
+    pin_memory: bool = False
+
+
+class OffloadOptimizerConfig(ConfigModel):
+    device: str = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = 1.0
+
+
+class ZeroConfig(ConfigModel):
+    """``zero_optimization`` subtree (reference ``runtime/zero/config.py``).
+
+    On TPU the stages map to sharding layouts on the train state rather than
+    hook-driven partitioning:
+
+    - stage 0: replicated params/grads/opt state (plain DP; grads ``psum``).
+    - stage 1: optimizer state sharded over the data axis.
+    - stage 2: stage 1 + gradients reduce-scattered (``psum_scatter``).
+    - stage 3: params also sharded; XLA/GSPMD inserts per-layer all-gathers
+      (FSDP). ``stage3_max_live_parameters``-style control is expressed with
+      scan-over-layers + remat policies instead of a prefetch tracer.
+    """
+
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+    round_robin_gradients: bool = False
+    offload_param: Optional[OffloadParamConfig] = None
+    offload_optimizer: Optional[OffloadOptimizerConfig] = None
+    sub_group_size: int = 1_000_000_000
+    stage3_max_live_parameters: int = 1_000_000_000
+    stage3_max_reuse_distance: int = 1_000_000_000
+    stage3_prefetch_bucket_size: int = 50_000_000
+    stage3_param_persistence_threshold: int = 100_000
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    ignore_unused_parameters: bool = True
+    # ZeRO++ knobs
+    zero_hpz_partition_size: int = 1
+    zero_quantized_weights: bool = False
+    zero_quantized_nontrainable_weights: bool = False
+    zero_quantized_gradients: bool = False
+    # MiCS
+    mics_shard_size: int = -1
+    mics_hierarchical_params_gather: bool = False
+
+    @model_validator(mode="after")
+    def _validate_stage(self):
+        if self.stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Optimizer / scheduler
+# ---------------------------------------------------------------------------
+
+
+class OptimizerConfig(ConfigModel):
+    type: str = "AdamW"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class SchedulerConfig(ConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Parallel topology
+# ---------------------------------------------------------------------------
+
+
+class TensorParallelConfig(ConfigModel):
+    """``tensor_parallel`` subtree (reference ``runtime/tensor_parallel/config.py``)."""
+
+    autotp_size: int = 1
+    tp_size: int = 1
+    tp_grain_size: int = 1
+
+    @model_validator(mode="after")
+    def _merge(self):
+        if self.autotp_size > 1 and self.tp_size == 1:
+            self.tp_size = self.autotp_size
+        return self
+
+
+class PipelineParallelConfig(ConfigModel):
+    stages: int = 1
+    partition_method: str = "parameters"
+    num_microbatches: Optional[int] = None
+    activation_checkpoint_interval: int = 0
+
+
+class SequenceParallelConfig(ConfigModel):
+    size: int = 1
+    attention_impl: str = "ulysses"  # ulysses | ring
+
+
+class ExpertParallelConfig(ConfigModel):
+    size: int = 1
+
+
+# ---------------------------------------------------------------------------
+# Aux subsystems
+# ---------------------------------------------------------------------------
+
+
+class ActivationCheckpointingConfig(ConfigModel):
+    """Maps to ``jax.checkpoint`` policies rather than torch re-forward."""
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-specific: which jax.checkpoint policy to use inside scanned layers.
+    policy: str = "nothing_saveable"  # nothing_saveable | dots_saveable | everything_saveable
+
+
+class FlopsProfilerConfig(ConfigModel):
+    enabled: bool = False
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class CommsLoggerConfig(ConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = Field(default_factory=list)
+
+
+class TensorBoardConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJobName"
+
+
+class WandbConfig(ConfigModel):
+    enabled: bool = False
+    group: Optional[str] = None
+    team: Optional[str] = None
+    project: str = "deepspeed_tpu"
+
+
+class CSVConfig(ConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJobName"
+
+
+class MonitorConfig(ConfigModel):
+    tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+
+
+class CheckpointConfig(ConfigModel):
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = Field(default_factory=dict)
+    async_save: bool = False
+
+
+class DataTypesConfig(ConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+class CompressionConfig(ConfigModel):
+    weight_quantization: Dict[str, Any] = Field(default_factory=dict)
+    activation_quantization: Dict[str, Any] = Field(default_factory=dict)
+    sparse_pruning: Dict[str, Any] = Field(default_factory=dict)
+    row_pruning: Dict[str, Any] = Field(default_factory=dict)
+    head_pruning: Dict[str, Any] = Field(default_factory=dict)
+    channel_pruning: Dict[str, Any] = Field(default_factory=dict)
+    layer_reduction: Dict[str, Any] = Field(default_factory=dict)
+
+
+class ElasticityConfig(ConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+class CurriculumParams(ConfigModel):
+    enabled: bool = False
+    curriculum_type: str = "seqlen"
+    min_difficulty: int = 8
+    max_difficulty: int = 1024
+    schedule_type: str = "fixed_linear"
+    schedule_config: Dict[str, Any] = Field(default_factory=dict)
+
+
+class DataEfficiencyConfig(ConfigModel):
+    enabled: bool = False
+    seed: int = 1234
+    data_routing: Dict[str, Any] = Field(default_factory=dict)
+    data_sampling: Dict[str, Any] = Field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Top-level config
+# ---------------------------------------------------------------------------
+
+ADAM_OPTIMIZERS = ["adam", "adamw", "fusedadam"]
+
+
+class DeepSpeedConfig(ConfigModel):
+    """Top-level typed config (reference ``runtime/config.py:707``).
+
+    Parameters
+    ----------
+    config: dict | str path to JSON
+    world_size: data-parallel world size used for batch reconciliation
+      (``dp_world_size`` in the reference engine).
+    """
+
+    train_batch_size: Optional[int] = None
+    train_micro_batch_size_per_gpu: Optional[int] = None
+    gradient_accumulation_steps: Optional[int] = None
+
+    steps_per_print: int = 10
+    gradient_clipping: float = 0.0
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    communication_data_type: Optional[str] = None
+    seed: int = 1234
+    disable_allgather: bool = False
+    dump_state: bool = False
+    wall_clock_breakdown: bool = False
+    memory_breakdown: bool = False
+    dataloader_drop_last: bool = False
+    sparse_gradients: bool = False
+
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    bf16: BF16Config = Field(default_factory=BF16Config)
+    zero_optimization: ZeroConfig = Field(default_factory=ZeroConfig)
+    optimizer: Optional[OptimizerConfig] = None
+    scheduler: Optional[SchedulerConfig] = None
+
+    tensor_parallel: TensorParallelConfig = Field(default_factory=TensorParallelConfig)
+    pipeline: PipelineParallelConfig = Field(default_factory=PipelineParallelConfig)
+    sequence_parallel: SequenceParallelConfig = Field(default_factory=SequenceParallelConfig)
+    expert_parallel: ExpertParallelConfig = Field(default_factory=ExpertParallelConfig)
+
+    activation_checkpointing: ActivationCheckpointingConfig = Field(
+        default_factory=ActivationCheckpointingConfig)
+    flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    monitor_config: MonitorConfig = Field(default_factory=MonitorConfig)
+    tensorboard: Optional[TensorBoardConfig] = None  # legacy top-level spelling
+    wandb: Optional[WandbConfig] = None
+    csv_monitor: Optional[CSVConfig] = None
+    checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+    data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
+    compression_training: CompressionConfig = Field(default_factory=CompressionConfig)
+    elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    curriculum_learning: CurriculumParams = Field(default_factory=CurriculumParams)
+    data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
+
+    load_universal_checkpoint: bool = False
+    zero_allow_untested_optimizer: bool = True
+    zero_force_ds_cpu_optimizer: bool = False
+    graph_harvesting: bool = False  # GPU-only (cuda graphs); accepted & ignored
+
+    # -- non-pydantic attrs populated by ``parse`` ------------------------------
+
+    def __init__(self, **data: Any):
+        super().__init__(**data)
+        # legacy top-level monitor keys fold into monitor_config
+        if self.tensorboard is not None:
+            self.monitor_config.tensorboard = self.tensorboard
+        if self.wandb is not None:
+            self.monitor_config.wandb = self.wandb
+        if self.csv_monitor is not None:
+            self.monitor_config.csv_monitor = self.csv_monitor
+
+    # ------------------------------------------------------------------
+
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_optimization.stage > 0
+
+    @property
+    def precision_dtype(self) -> str:
+        if self.fp16.enabled:
+            return "float16"
+        if self.bf16.enabled:
+            return "bfloat16"
+        return "float32"
+
+    def reconcile_batch_size(self, dp_world_size: int) -> None:
+        """Solve ``train = micro * gas * dp`` (reference
+        ``_configure_train_batch_size``). Any two of the three determine the
+        third; one alone assumes the others default; none defaults micro=1,
+        gas=1.
+        """
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            gas = train // (micro * dp_world_size)
+        elif train is not None and gas is not None:
+            micro = train // (gas * dp_world_size)
+        elif micro is not None and gas is not None:
+            train = micro * gas * dp_world_size
+        elif train is not None:
+            gas = 1
+            micro = train // dp_world_size
+        elif micro is not None:
+            gas = 1
+            train = micro * dp_world_size
+        else:
+            micro, gas = 1, 1
+            train = dp_world_size
+
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+        self._batch_assertion(dp_world_size)
+
+    def _batch_assertion(self, dp_world_size: int) -> None:
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        assert train > 0, f"train_batch_size: {train} must be > 0"
+        assert micro > 0, f"train_micro_batch_size_per_gpu: {micro} must be > 0"
+        assert gas > 0, f"gradient_accumulation_steps: {gas} must be > 0"
+        assert train == micro * gas * dp_world_size, (
+            f"Check batch related parameters: train_batch_size={train} has to equal "
+            f"micro_batch_per_gpu({micro}) * gradient_acc_steps({gas}) * "
+            f"dp_world_size({dp_world_size})")
+
+    def print_config(self, name: str = "DeepSpeedConfig") -> None:
+        logger.info(f"{name}:")
+        logger.info(json.dumps(self.model_dump(), indent=2, default=str, sort_keys=True))
+
+
+def load_config(config: Union[str, Dict[str, Any], DeepSpeedConfig, None],
+                dp_world_size: Optional[int] = None) -> DeepSpeedConfig:
+    """Parse a config dict / JSON path into a ``DeepSpeedConfig``."""
+    if config is None:
+        config = {}
+    if isinstance(config, DeepSpeedConfig):
+        cfg = config
+    elif isinstance(config, str):
+        if not os.path.exists(config):
+            raise FileNotFoundError(f"DeepSpeed config path does not exist: {config}")
+        with open(config) as f:
+            cfg = DeepSpeedConfig(**json.load(f))
+    elif isinstance(config, dict):
+        cfg = DeepSpeedConfig(**config)
+    else:
+        raise TypeError(f"Unsupported config type: {type(config)}")
+    if dp_world_size is not None:
+        cfg.reconcile_batch_size(dp_world_size)
+    return cfg

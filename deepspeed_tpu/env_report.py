@@ -1,0 +1,68 @@
+"""Environment report CLI (the reference's ``ds_report``,
+``deepspeed/env_report.py``): versions, visible devices, and feature
+availability on this host."""
+from __future__ import annotations
+
+import importlib
+import sys
+
+GREEN_OK = "[OKAY]"
+RED_NO = "[NO]"
+
+
+def _try_version(mod_name: str) -> str:
+    try:
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, "__version__", "unknown")
+    except Exception:
+        return ""
+
+
+def feature_report() -> list:
+    """(name, available, detail) rows for TPU-relevant features."""
+    rows = []
+    try:
+        import jax
+
+        devs = jax.devices()
+        rows.append(("jax devices", True, f"{len(devs)} x {devs[0].platform}"))
+        try:
+            kind = devs[0].device_kind
+            rows.append(("device kind", True, kind))
+        except Exception:
+            pass
+        try:
+            from jax.experimental import pallas  # noqa: F401
+
+            rows.append(("pallas", True, "importable"))
+        except Exception:
+            rows.append(("pallas", False, ""))
+    except Exception as e:  # pragma: no cover
+        rows.append(("jax devices", False, str(e)))
+    return rows
+
+
+def main() -> int:
+    print("-" * 60)
+    print("DeepSpeed-TPU environment report")
+    print("-" * 60)
+    print(f"python version ............ {sys.version.split()[0]}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "numpy", "pydantic"):
+        v = _try_version(mod)
+        status = GREEN_OK if v else RED_NO
+        print(f"{mod:<26} {status} {v}")
+    try:
+        import deepspeed_tpu
+
+        print(f"{'deepspeed_tpu':<26} {GREEN_OK} {deepspeed_tpu.__version__}")
+    except Exception:
+        print(f"{'deepspeed_tpu':<26} {RED_NO}")
+    print("-" * 60)
+    for name, ok, detail in feature_report():
+        print(f"{name:<26} {GREEN_OK if ok else RED_NO} {detail}")
+    print("-" * 60)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

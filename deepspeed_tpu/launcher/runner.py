@@ -1,0 +1,128 @@
+"""``dstpu`` launcher CLI.
+
+TPU-native counterpart of the reference launcher
+(``deepspeed/launcher/runner.py:419 main`` + per-node ``launch.py``).  On
+TPU pods each *host* runs exactly one JAX process that drives all of its
+local chips, so the per-GPU process fan-out of the reference collapses to
+one process per host:
+
+- single host: exec the training script directly (all local chips visible);
+- multi host: read a hostfile (reference format: ``hostname slots=N``), ssh
+  to every host, export ``DSTPU_COORDINATOR`` / ``DSTPU_NUM_PROCESSES`` /
+  ``DSTPU_PROCESS_ID``, and run the same script — the env that
+  ``deepspeed_tpu.comm.init_distributed`` consumes for
+  ``jax.distributed.initialize``.  (On GKE/Cloud-TPU the scheduler already
+  provides this env and ``dstpu`` is unnecessary — documented divergence.)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+from typing import Dict, List
+
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_COORD_PORT = 29500
+
+
+def parse_hostfile(path: str) -> Dict[str, int]:
+    """Parse ``hostname slots=N`` lines (reference ``runner.py:213``)."""
+    hosts: Dict[str, int] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            name = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=")[1])
+            if name in hosts:
+                raise ValueError(f"duplicate host {name} in hostfile")
+            hosts[name] = slots
+    if not hosts:
+        raise ValueError(f"no hosts found in hostfile {path}")
+    return hosts
+
+
+def filter_hosts(hosts: Dict[str, int], include: str = "", exclude: str = "") -> Dict[str, int]:
+    """Apply ``--include``/``--exclude`` host filters (reference ``runner.py:293``;
+    TPU hosts have no per-device slot filtering — whole hosts only)."""
+    def parse_list(s: str) -> List[str]:
+        return [h.split(":")[0] for h in s.split("@") if h]
+
+    out = dict(hosts)
+    if include:
+        keep = parse_list(include)
+        missing = [h for h in keep if h not in out]
+        if missing:
+            raise ValueError(f"--include hosts not in hostfile: {missing}")
+        out = {h: out[h] for h in keep}
+    if exclude:
+        for h in parse_list(exclude):
+            out.pop(h, None)
+    if not out:
+        raise ValueError("no hosts left after include/exclude filters")
+    return out
+
+
+def build_ssh_command(host: str, env: Dict[str, str], script_cmd: List[str],
+                      ssh_port: int = 22) -> List[str]:
+    exports = " ".join(f"export {k}={shlex.quote(v)};" for k, v in env.items())
+    remote = f"cd {shlex.quote(os.getcwd())}; {exports} {' '.join(map(shlex.quote, script_cmd))}"
+    return ["ssh", "-o", "StrictHostKeyChecking=no", "-p", str(ssh_port), host, remote]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dstpu", description="DeepSpeed-TPU multi-host launcher")
+    parser.add_argument("--hostfile", type=str, default=None,
+                        help="path to 'hostname slots=N' hostfile")
+    parser.add_argument("--include", type=str, default="",
+                        help="hosts to include, '@'-separated")
+    parser.add_argument("--exclude", type=str, default="",
+                        help="hosts to exclude, '@'-separated")
+    parser.add_argument("--master_addr", type=str, default=None,
+                        help="coordinator address (default: first host)")
+    parser.add_argument("--master_port", type=int, default=DEFAULT_COORD_PORT)
+    parser.add_argument("--ssh_port", type=int, default=22)
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    script_cmd = [sys.executable, args.user_script] + args.user_args
+
+    if args.hostfile is None:
+        logger.info("dstpu: single-host launch")
+        return subprocess.call(script_cmd)
+
+    hosts = filter_hosts(parse_hostfile(args.hostfile), args.include, args.exclude)
+    host_names = list(hosts.keys())
+    coord = args.master_addr or host_names[0]
+    n = len(host_names)
+    logger.info(f"dstpu: launching on {n} hosts, coordinator {coord}:{args.master_port}")
+
+    procs = []
+    for idx, host in enumerate(host_names):
+        env = {
+            "DSTPU_COORDINATOR": f"{coord}:{args.master_port}",
+            "DSTPU_NUM_PROCESSES": str(n),
+            "DSTPU_PROCESS_ID": str(idx),
+        }
+        cmd = build_ssh_command(host, env, script_cmd, args.ssh_port)
+        logger.info(f"dstpu: [{host}] {' '.join(cmd[:6])} ...")
+        procs.append(subprocess.Popen(cmd))
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,17 @@
+from deepspeed_tpu.parallel.topology import (
+    MeshTopology,
+    ProcessTopology,
+    PipeModelDataParallelTopology,
+    PIPE_AXIS,
+    DATA_AXIS,
+    EXPERT_AXIS,
+    SEQ_AXIS,
+    TENSOR_AXIS,
+    AXIS_ORDER,
+)
+
+__all__ = [
+    "MeshTopology", "ProcessTopology", "PipeModelDataParallelTopology",
+    "PIPE_AXIS", "DATA_AXIS", "EXPERT_AXIS", "SEQ_AXIS", "TENSOR_AXIS",
+    "AXIS_ORDER",
+]

@@ -1,0 +1,211 @@
+"""Device-mesh topology.
+
+TPU-native replacement for the reference's process-group bookkeeping
+(``deepspeed/utils/groups.py`` and ``deepspeed/runtime/pipe/topology.py``).
+Instead of building many ``torch.distributed`` process groups, a single
+``jax.sharding.Mesh`` carries every parallelism axis; "groups" become mesh
+axis names.  Axis order (outer→inner) is chosen so the innermost axes map to
+ICI-adjacent devices (tensor/seq innermost, pipe outermost — matching how
+DCN/ICI should be assigned on multi-slice):
+
+    ("pipe", "data", "expert", "seq", "tensor")
+
+- ``data``   — DP / ZeRO sharding axis (reference ``_create_expert_and_data_parallel``)
+- ``expert`` — expert parallelism; divides what would otherwise be data
+  (reference expert groups are subgroups of DP, ``groups.py:236``)
+- ``seq``    — Ulysses/ring sequence parallelism (``groups.py:611``)
+- ``tensor`` — Megatron-style TP (``groups.py:187 _create_model_parallel``)
+- ``pipe``   — pipeline stages (``runtime/pipe/topology.py``)
+
+ZeRO partitions over the combined (data, expert, seq) extent mirroring the
+reference's ``seq_data_parallel_group`` (engine.py:1603).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from deepspeed_tpu.utils.logging import log_dist
+
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
+SEQ_AXIS = "seq"
+TENSOR_AXIS = "tensor"
+
+AXIS_ORDER: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS)
+
+
+class MeshTopology:
+    """One mesh, every parallelism axis.
+
+    Parameters mirror the reference's sizes: ``pp`` pipeline stages, ``tp``
+    tensor-parallel degree, ``sp`` sequence-parallel degree, ``ep`` expert
+    parallel degree; ``dp`` is inferred from the device count unless given.
+    """
+
+    def __init__(self,
+                 dp: Optional[int] = None,
+                 tp: int = 1,
+                 pp: int = 1,
+                 sp: int = 1,
+                 ep: int = 1,
+                 devices: Optional[Sequence[jax.Device]] = None):
+        if devices is None:
+            devices = jax.devices()
+        n = len(devices)
+        denom = tp * pp * sp * ep
+        if n % denom != 0:
+            raise ValueError(
+                f"device count {n} not divisible by tp*pp*sp*ep = {denom}")
+        inferred_dp = n // denom
+        if dp is None:
+            dp = inferred_dp
+        if dp * denom != n:
+            raise ValueError(
+                f"dp({dp}) * tp({tp}) * pp({pp}) * sp({sp}) * ep({ep}) != "
+                f"device count {n}")
+        self.shape: Dict[str, int] = {
+            PIPE_AXIS: pp, DATA_AXIS: dp, EXPERT_AXIS: ep,
+            SEQ_AXIS: sp, TENSOR_AXIS: tp,
+        }
+        dev_array = np.asarray(devices).reshape(
+            tuple(self.shape[a] for a in AXIS_ORDER))
+        self.mesh = Mesh(dev_array, AXIS_ORDER)
+        log_dist(f"MeshTopology: {self.describe()}", ranks=[0])
+
+    # -- sizes ----------------------------------------------------------
+
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(list(self.shape.values())))
+
+    def axis_size(self, axis: str) -> int:
+        return self.shape[axis]
+
+    @property
+    def data_parallel_size(self) -> int:
+        return self.shape[DATA_AXIS]
+
+    @property
+    def tensor_parallel_size(self) -> int:
+        return self.shape[TENSOR_AXIS]
+
+    @property
+    def pipe_parallel_size(self) -> int:
+        return self.shape[PIPE_AXIS]
+
+    @property
+    def sequence_parallel_size(self) -> int:
+        return self.shape[SEQ_AXIS]
+
+    @property
+    def expert_parallel_size(self) -> int:
+        return self.shape[EXPERT_AXIS]
+
+    # -- derived groups (axis-name tuples usable in shard_map/psum) ------
+
+    @property
+    def zero_axes(self) -> Tuple[str, ...]:
+        """Axes ZeRO partitions over: data × expert × seq (the reference's
+        ``seq_data_parallel_group``; expert params handle ``expert``
+        separately via :meth:`expert_zero_axes`)."""
+        return (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+
+    @property
+    def expert_zero_axes(self) -> Tuple[str, ...]:
+        """Axes expert params ZeRO-shard over (the reference's
+        ``expert_data_parallel_group``)."""
+        return (DATA_AXIS, SEQ_AXIS)
+
+    @property
+    def grad_reduce_axes(self) -> Tuple[str, ...]:
+        """Axes over which dense-param gradients are averaged."""
+        return (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+
+    @property
+    def expert_grad_reduce_axes(self) -> Tuple[str, ...]:
+        return (DATA_AXIS, SEQ_AXIS)
+
+    def zero_partition_count(self) -> int:
+        return int(np.prod([self.shape[a] for a in self.zero_axes]))
+
+    # -- misc ------------------------------------------------------------
+
+    def describe(self) -> str:
+        return " x ".join(f"{a}={s}" for a, s in self.shape.items())
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"MeshTopology({self.describe()})"
+
+
+class ProcessCoord:
+    """Named coordinate in the topology (reference ``topology.py``
+    ``ProcessCoord`` namedtuple equivalent)."""
+
+    def __init__(self, **kwargs: int):
+        self.coords = dict(kwargs)
+
+    def __getattr__(self, item):
+        try:
+            return self.coords[item]
+        except KeyError as e:  # pragma: no cover
+            raise AttributeError(item) from e
+
+    def __repr__(self):  # pragma: no cover
+        return f"ProcessCoord({self.coords})"
+
+
+class ProcessTopology:
+    """Axis/coordinate bookkeeping for rank↔coordinate mapping.
+
+    Pure-python mirror of ``runtime/pipe/topology.py:ProcessTopology``; used
+    by the pipeline module partitioner and the checkpoint resharder, where
+    ranks are positions in the mesh rather than torch process-group ranks.
+    """
+
+    def __init__(self, axes: Sequence[str], dims: Sequence[int]):
+        assert len(axes) == len(dims)
+        self.axes = list(axes)
+        self.dims = list(dims)
+
+    def get_rank(self, **coords: int) -> int:
+        assert set(coords.keys()) == set(self.axes), \
+            f"need all axes {self.axes}, got {list(coords)}"
+        rank = 0
+        for axis, dim in zip(self.axes, self.dims):
+            c = coords[axis]
+            assert 0 <= c < dim
+            rank = rank * dim + c
+        return rank
+
+    def get_coord(self, rank: int) -> ProcessCoord:
+        coords = {}
+        for axis, dim in zip(reversed(self.axes), reversed(self.dims)):
+            coords[axis] = rank % dim
+            rank //= dim
+        return ProcessCoord(**coords)
+
+    def get_dim(self, axis: str) -> int:
+        return self.dims[self.axes.index(axis)]
+
+    def world_size(self) -> int:
+        return int(math.prod(self.dims))
+
+    def get_axis_list(self, axis: str, idx: int):
+        """All ranks whose coordinate on ``axis`` equals ``idx``."""
+        return [r for r in range(self.world_size())
+                if getattr(self.get_coord(r), axis) == idx]
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """Reference ``topology.py:PipeModelDataParallelTopology`` with axes
+    (pipe, data, model)."""
+
+    def __init__(self, num_pp: int, num_mp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data", "model"],
+                         dims=[num_pp, num_dp, num_mp])

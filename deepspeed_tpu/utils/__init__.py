@@ -1,0 +1,11 @@
+from deepspeed_tpu.utils.logging import logger, log_dist, print_rank_0
+from deepspeed_tpu.utils.timer import (
+    SynchronizedWallClockTimer,
+    ThroughputTimer,
+    Timer,
+)
+
+__all__ = [
+    "logger", "log_dist", "print_rank_0",
+    "SynchronizedWallClockTimer", "ThroughputTimer", "Timer",
+]

@@ -1,0 +1,207 @@
+"""Wall-clock and throughput timers.
+
+TPU-native re-design of the reference's ``deepspeed/utils/timer.py``
+(``SynchronizedWallClockTimer`` at :44, ``ThroughputTimer`` at :199).  Where
+the reference uses CUDA events per stream, the XLA equivalent of
+"synchronize" is blocking on the output buffers of the last dispatched
+computation: ``jax.block_until_ready`` / ``jax.effects_barrier``.  All timers
+are host-side; device-side timing belongs to the profiler
+(``deepspeed_tpu.profiling``).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+
+def _device_sync() -> None:
+    try:
+        import jax
+
+        jax.effects_barrier()
+    except Exception:  # pragma: no cover
+        pass
+
+
+class Timer:
+    """A single named timer with accumulated elapsed time."""
+
+    def __init__(self, name: str, synchronize: bool = True):
+        self.name = name
+        self.started = False
+        self.synchronize = synchronize
+        self._start_time = 0.0
+        self._elapsed = 0.0
+        self._record_count = 0
+
+    def start(self) -> None:
+        assert not self.started, f"timer {self.name} already started"
+        if self.synchronize:
+            _device_sync()
+        self._start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, record: bool = True) -> None:
+        assert self.started, f"timer {self.name} not started"
+        if self.synchronize:
+            _device_sync()
+        self._elapsed += time.perf_counter() - self._start_time
+        if record:
+            self._record_count += 1
+        self.started = False
+
+    def reset(self) -> None:
+        self.started = False
+        self._elapsed = 0.0
+        self._record_count = 0
+
+    def elapsed(self, reset: bool = True) -> float:
+        """Elapsed time in seconds."""
+        was_started = self.started
+        if was_started:
+            self.stop(record=False)
+        out = self._elapsed
+        if reset:
+            self.reset()
+        if was_started:
+            self.start()
+        return out
+
+    def mean(self) -> float:
+        if self._record_count == 0:
+            return 0.0
+        return self._elapsed / self._record_count
+
+
+class SynchronizedWallClockTimer:
+    """Group of named timers; mirrors the reference API (`timer.py:44`)."""
+
+    def __init__(self):
+        self.timers: Dict[str, Timer] = {}
+
+    def __call__(self, name: str) -> Timer:
+        if name not in self.timers:
+            self.timers[name] = Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name: str) -> bool:
+        return name in self.timers
+
+    @staticmethod
+    def memory_usage() -> str:
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            in_use = stats.get("bytes_in_use", 0) / (1024**3)
+            peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+            return f"Device mem in use {in_use:.2f} GB | peak {peak:.2f} GB"
+        except Exception:  # pragma: no cover
+            return "Device memory stats unavailable"
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False, ranks: Optional[List[int]] = None) -> None:
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}ms")
+        msg = "time (ms) | " + " | ".join(parts)
+        if memory_breakdown:
+            msg += " | " + self.memory_usage()
+        logger.info(msg)
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        assert normalizer > 0.0
+        return {
+            name: self.timers[name].mean() * 1000.0 / normalizer
+            for name in names if name in self.timers
+        }
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPs tracking (reference ``timer.py:199``)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: int = 50, monitor_memory: bool = False):
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.initialized = False
+
+    def update_epoch_count(self) -> None:
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self) -> None:
+        self.initialized = True
+
+    def start(self) -> None:
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_sync()
+            self.start_time = time.perf_counter()
+
+    def stop(self, global_step: bool = False, report_speed: bool = True) -> None:
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _device_sync()
+            self.end_time = time.perf_counter()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            self.start_time = 0.0
+            if global_step and report_speed and \
+                    self.global_step_count % self.steps_per_output == 0:
+                logger.info(
+                    f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                    f"global_step={self.global_step_count}, "
+                    f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.2f}, "
+                    f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.2f}")
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > self.start_step:
+            samples = self.batch_size * (self.global_step_count - self.start_step)
+            if self.total_elapsed_time > 0:
+                return samples / self.total_elapsed_time
+        return 0.0
+
+
+def trim_mean(data: List[float], trim_percent: float) -> float:
+    """Trimmed mean used by comms benchmarking (reference ``timer.py`` tail)."""
+    assert 0.0 <= trim_percent <= 1.0
+    n = len(data)
+    if n == 0:
+        return 0.0
+    data = sorted(data)
+    k = int(round(n * trim_percent))
+    trimmed = data[k: max(n - k, k + 1)]
+    if not trimmed:
+        trimmed = data
+    return sum(trimmed) / len(trimmed)

@@ -1,0 +1,35 @@
+"""Test bootstrap: run every test on a virtual 8-device CPU mesh.
+
+Mirrors the reference test strategy (SURVEY §4): distributed logic is
+exercised single-node with fake devices — here via
+``--xla_force_host_platform_device_count=8`` instead of forked torch
+processes, since one JAX controller drives all 8 virtual devices.
+"""
+import os
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# jax may already be imported by site customization before this file runs, so
+# env vars alone are not enough — use jax.config (valid until backends
+# initialize). The real-TPU path is exercised by bench.py / __graft_entry__.py.
+if not os.environ.get("DSTPU_TEST_ON_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(autouse=True)
+def _reset_comm_state():
+    """Fresh topology per test (tests install their own meshes)."""
+    yield
+    from deepspeed_tpu.comm import comm as _comm
+    _comm._state.topology = None
+    _comm.comms_logger.reset()

@@ -1,0 +1,116 @@
+"""Config-system tests (mirrors reference tests/unit/runtime/test_ds_config_dict.py)."""
+import json
+
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedConfig, load_config
+
+
+def test_defaults():
+    cfg = load_config({}, dp_world_size=1)
+    assert cfg.train_batch_size == 1
+    assert cfg.zero_optimization.stage == 0
+    assert not cfg.fp16.enabled
+    assert not cfg.bf16.enabled
+    assert cfg.precision_dtype == "float32"
+
+
+def test_batch_reconciliation_two_of_three():
+    cfg = load_config({"train_batch_size": 32,
+                       "train_micro_batch_size_per_gpu": 4}, dp_world_size=2)
+    assert cfg.gradient_accumulation_steps == 4
+
+    cfg = load_config({"train_batch_size": 32,
+                       "gradient_accumulation_steps": 4}, dp_world_size=2)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+    cfg = load_config({"train_micro_batch_size_per_gpu": 4,
+                       "gradient_accumulation_steps": 4}, dp_world_size=2)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_reconciliation_one_given():
+    cfg = load_config({"train_batch_size": 16}, dp_world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_mismatch_raises():
+    with pytest.raises(AssertionError):
+        load_config({"train_batch_size": 33,
+                     "train_micro_batch_size_per_gpu": 4,
+                     "gradient_accumulation_steps": 4}, dp_world_size=2)
+
+
+def test_zero_config():
+    cfg = load_config({
+        "zero_optimization": {
+            "stage": 3,
+            "stage3_param_persistence_threshold": 1000,
+            "offload_optimizer": {"device": "cpu"},
+        }
+    }, dp_world_size=1)
+    assert cfg.zero_optimization.stage == 3
+    assert cfg.zero_enabled
+    assert cfg.zero_optimization.offload_optimizer.device == "cpu"
+
+
+def test_zero_invalid_stage():
+    with pytest.raises(Exception):
+        load_config({"zero_optimization": {"stage": 5}})
+
+
+def test_precision():
+    cfg = load_config({"bf16": {"enabled": True}})
+    assert cfg.precision_dtype == "bfloat16"
+    cfg = load_config({"fp16": {"enabled": True, "initial_scale_power": 8}})
+    assert cfg.precision_dtype == "float16"
+    assert cfg.fp16.initial_scale_power == 8
+
+
+def test_reference_style_config_parses():
+    """A realistic reference-style JSON parses unchanged (GPU-only knobs
+    tolerated)."""
+    ds_config = {
+        "train_batch_size": 8,
+        "steps_per_print": 2000,
+        "optimizer": {"type": "Adam", "params": {"lr": 0.001, "betas": [0.8, 0.999],
+                                                 "eps": 1e-8, "weight_decay": 3e-7}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_min_lr": 0,
+                                                     "warmup_max_lr": 0.001,
+                                                     "warmup_num_steps": 1000}},
+        "gradient_clipping": 1.0,
+        "prescale_gradients": False,
+        "bf16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 2,
+            "allgather_partitions": True,
+            "reduce_scatter": True,
+            "allgather_bucket_size": 50000000,
+            "reduce_bucket_size": 50000000,
+            "overlap_comm": True,
+            "contiguous_gradients": True,
+            "cpu_offload": False,  # legacy/unknown key → warn, not fail
+        },
+        "wall_clock_breakdown": False,
+    }
+    cfg = load_config(ds_config, dp_world_size=8)
+    assert cfg.optimizer.type == "Adam"
+    assert cfg.optimizer.params["lr"] == 0.001
+    assert cfg.scheduler.type == "WarmupLR"
+    assert cfg.zero_optimization.stage == 2
+    assert cfg.train_micro_batch_size_per_gpu == 1
+
+
+def test_config_from_json_file(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 4, "bf16": {"enabled": True}}))
+    cfg = load_config(str(p), dp_world_size=2)
+    assert cfg.train_batch_size == 4
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_monitor_legacy_top_level():
+    cfg = load_config({"tensorboard": {"enabled": True, "output_path": "/tmp/tb"}})
+    assert cfg.monitor_config.tensorboard.enabled
+    assert cfg.monitor_config.tensorboard.output_path == "/tmp/tb"
