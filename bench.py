@@ -1,0 +1,117 @@
+"""Headline benchmark: GPT-2 training throughput + MFU on one chip.
+
+Run by the driver on real TPU hardware at the end of every round; prints ONE
+JSON line ``{"metric", "value", "unit", "vs_baseline"}``.  The metric is
+model FLOPs utilization (MFU) for a bf16 GPT-2 train step — the BASELINE.md
+north star is ZeRO-3 Llama-2-7B at >=45% MFU on v5p-128, so ``vs_baseline``
+reports value/45.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# bf16 peak FLOPs per chip by device kind substring
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,  # v5p
+    "TPU v6": 918e12,  # trillium
+    "cpu": 1e12,       # nominal, for smoke runs
+}
+
+NORTH_STAR_MFU = 45.0
+
+
+def peak_flops(kind: str) -> float:
+    for k, v in PEAK_FLOPS.items():
+        if kind.lower().startswith(k.lower()) or k.lower() in kind.lower():
+            return v
+    return 197e12
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models.gpt2 import (GPT2LMLoss, count_params,
+                                           get_config)
+
+    if on_tpu:
+        cfg_model = get_config("gpt2-125m", n_positions=1024,
+                               dtype=jnp.bfloat16, remat=True,
+                               scan_layers=True)
+        micro, seq, steps = 8, 1024, 20
+    else:  # CPU smoke: tiny shapes so the line still prints
+        cfg_model = get_config("gpt2-125m", n_positions=128, n_embd=256,
+                               n_layer=4, n_head=4, dtype=jnp.float32,
+                               remat=False)
+        micro, seq, steps = 2, 128, 3
+
+    topo = dist.initialize_mesh()  # all visible devices on the data axis
+    dp = topo.zero_partition_count()
+    ds_config = {
+        "train_batch_size": micro * dp,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": bool(on_tpu)},
+        "zero_optimization": {"stage": 0},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4,
+                                                  "weight_decay": 0.01}},
+        "steps_per_print": 1000000,
+    }
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg_model.vocab_size, size=(micro * dp, seq), dtype=np.int32)}
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=GPT2LMLoss(cfg_model), config=ds_config, topology=topo,
+        example_batch={"input_ids": batch["input_ids"][:1]},
+        rng=jax.random.PRNGKey(0))
+
+    n_params = count_params(engine.state.params)
+
+    # warmup (compile)
+    engine.train_batch(batch=batch)
+    jax.effects_barrier()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = steps * micro * dp / dt
+    tokens_per_sec = samples_per_sec * seq
+    # 6*N per token fwd+bwd + attention term
+    flops_per_tok = 6.0 * n_params + 12.0 * cfg_model.n_layer * cfg_model.n_embd * seq
+    model_flops = tokens_per_sec * flops_per_tok
+    n_chips = len(jax.devices())
+    mfu = 100.0 * model_flops / (peak_flops(dev.device_kind) * n_chips)
+
+    result = {
+        "metric": "gpt2_125m_bf16_train_mfu",
+        "value": round(mfu, 2),
+        "unit": "% MFU",
+        "vs_baseline": round(mfu / NORTH_STAR_MFU, 3),
+        "detail": {
+            "samples_per_sec_per_chip": round(samples_per_sec / n_chips, 2),
+            "tokens_per_sec": round(tokens_per_sec),
+            "params": n_params,
+            "device": dev.device_kind,
+            "n_chips": n_chips,
+            "final_loss": float(jax.device_get(loss)),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+from deepspeed_tpu.models.gpt2 import (
+    GPT2Config,
+    GPT2Model,
+    GPT2LMLoss,
+    get_config,
+    count_params,
+    flops_per_token,
+    PRESETS,
+)
+
+__all__ = ["GPT2Config", "GPT2Model", "GPT2LMLoss", "get_config",
+           "count_params", "flops_per_token", "PRESETS"]

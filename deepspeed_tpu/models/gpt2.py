@@ -1,0 +1,209 @@
+"""GPT-2 model family (flax).
+
+The engine's flagship dense LM for the baseline configs (BASELINE.md:
+GPT-2 125M ZeRO-0 smoke, GPT-2 1.3B ZeRO-2).  Built TPU-first:
+
+- ``scan_layers=True`` stacks the transformer blocks with ``nn.scan`` so the
+  compiled program is O(1) in depth and — under ZeRO-3 — XLA gathers one
+  layer's params at a time, bounding live parameters the way the reference's
+  prefetch coordinator does (``stage3_max_live_parameters``).
+- ``remat=True`` wraps each block in ``nn.remat`` (activation checkpointing,
+  the ``jax.checkpoint`` analogue of ``runtime/activation_checkpointing``).
+- all matmuls run in ``param_dtype``-independent ``dtype`` (bf16 on TPU) and
+  hit the MXU; attention uses a single fused softmax over [B, H, S, S] which
+  XLA tiles, or the Pallas flash kernel when enabled.
+
+The test fixtures (tests/unit/simple_model equivalent) use tiny instances of
+this same model, mirroring the reference's SimpleModel philosophy.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+    use_flash_attention: bool = False
+    tie_word_embeddings: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+
+# Preset sizes (reference baseline configs; param counts approximate)
+PRESETS = {
+    "gpt2-125m": dict(n_embd=768, n_layer=12, n_head=12),
+    "gpt2-350m": dict(n_embd=1024, n_layer=24, n_head=16),
+    "gpt2-760m": dict(n_embd=1536, n_layer=24, n_head=16),
+    "gpt2-1.3b": dict(n_embd=2048, n_layer=24, n_head=32),
+    "gpt2-2.7b": dict(n_embd=2560, n_layer=32, n_head=32),
+    "gpt2-6.7b": dict(n_embd=4096, n_layer=32, n_head=32),
+}
+
+
+def get_config(preset: str, **overrides) -> GPT2Config:
+    kw = dict(PRESETS[preset])
+    kw.update(overrides)
+    return GPT2Config(**kw)
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        B, S, E = x.shape
+        qkv = nn.Dense(3 * E, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, S, cfg.n_head, cfg.head_dim).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if cfg.use_flash_attention:
+            from deepspeed_tpu.ops.flash_attention import flash_attention
+
+            y = flash_attention(q, k, v, causal=True)
+        else:
+            scale = 1.0 / np.sqrt(cfg.head_dim)
+            att = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+            mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+            att = jnp.where(mask[None, None], att, jnp.finfo(att.dtype).min)
+            att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(cfg.dtype)
+            att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
+            y = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+        y = y.transpose(0, 2, 1, 3).reshape(B, S, E)
+        y = nn.Dense(E, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                     name="c_proj")(y)
+        return nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="c_fc")(x)
+        h = jax.nn.gelu(h)
+        h = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="c_proj")(h)
+        return nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
+        x = x + MLP(cfg, name="mlp")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x), deterministic)
+        return x
+
+
+class ScanBlock(nn.Module):
+    """Block adapted to nn.scan carry signature."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, _):
+        deterministic = self.config.dropout == 0.0
+        return Block(self.config, name="block")(x, deterministic), None
+
+
+class GPT2Model(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool = True):
+        cfg = self.config
+        B, S = input_ids.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wte")
+        wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wpe")
+        x = wte(input_ids) + wpe(jnp.arange(S)[None, :])
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        if cfg.scan_layers:
+            block_cls = ScanBlock
+            if cfg.remat:
+                block_cls = nn.remat(ScanBlock, prevent_cse=False)
+            x, _ = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layer,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="h")(x, None)
+        else:
+            block_cls = Block
+            if cfg.remat:
+                block_cls = nn.remat(Block, prevent_cse=False)
+            for i in range(cfg.n_layer):
+                x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
+
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        if cfg.tie_word_embeddings:
+            logits = wte.attend(x)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                              param_dtype=cfg.param_dtype, name="lm_head")(x)
+        return logits
+
+
+class GPT2LMLoss(nn.Module):
+    """Loss-returning wrapper: ``module(batch) -> scalar`` as the engine's
+    flax-module contract expects.  ``batch`` is ``{"input_ids": [B, S]}`` or
+    a raw [B, S] array; next-token cross entropy in fp32."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, batch):
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        deterministic = self.config.dropout == 0.0
+        logits = GPT2Model(self.config, name="transformer")(
+            input_ids, deterministic=deterministic)
+        logits = logits[:, :-1].astype(jnp.float32)
+        targets = input_ids[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_token(cfg: GPT2Config, seq_len: Optional[int] = None) -> float:
+    """Approximate fwd+bwd FLOPs per token (6N + attention term), for MFU."""
+    n = (12 * cfg.n_layer * cfg.n_embd ** 2 +
+         2 * cfg.vocab_size * cfg.n_embd)  # params sans embeddings-pos
+    s = seq_len or cfg.n_positions
+    attn = 12 * cfg.n_layer * cfg.n_embd * s
+    return 6.0 * n + attn

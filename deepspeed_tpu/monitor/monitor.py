@@ -1,0 +1,113 @@
+"""Experiment monitors.
+
+Re-creation of ``deepspeed/monitor/monitor.py:30`` (``MonitorMaster`` fanning
+out to TensorBoard / W&B / CSV writers).  Events are ``(name, value, step)``
+tuples written at gradient-accumulation boundaries by the engine.
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, events: List[Event]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class CSVMonitor(Monitor):
+    """``csv_monitor`` config subtree (reference ``csv_monitor.py:12``)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.output_path = getattr(config, "output_path", "") or "./csv_monitor"
+        self.job_name = getattr(config, "job_name", "DeepSpeedTPUJobName")
+        self._files = {}
+        if self.enabled:
+            os.makedirs(os.path.join(self.output_path, self.job_name),
+                        exist_ok=True)
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            fname = os.path.join(self.output_path, self.job_name,
+                                 name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, value])
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.writer = None
+        if self.enabled:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                path = os.path.join(
+                    getattr(config, "output_path", "") or "./runs",
+                    getattr(config, "job_name", "DeepSpeedTPUJobName"))
+                self.writer = SummaryWriter(log_dir=path)
+            except Exception as e:
+                logger.warning(f"TensorBoard unavailable ({e}); disabled")
+                self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled or self.writer is None:
+            return
+        for name, value, step in events:
+            self.writer.add_scalar(name, value, step)
+        self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        if self.enabled:
+            try:
+                import wandb
+
+                wandb.init(project=getattr(config, "project", "deepspeed_tpu"),
+                           group=getattr(config, "group", None))
+                self._wandb = wandb
+            except Exception as e:
+                logger.warning(f"wandb unavailable ({e}); disabled")
+                self.enabled = False
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in events:
+            self._wandb.log({name: value}, step=step)
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to every enabled writer; only process 0 writes."""
+
+    def __init__(self, monitor_config):
+        self.tb = TensorBoardMonitor(monitor_config.tensorboard)
+        self.csv = CSVMonitor(monitor_config.csv_monitor)
+        self.wandb = WandbMonitor(monitor_config.wandb)
+        self.enabled = self.tb.enabled or self.csv.enabled or self.wandb.enabled
+
+    def write_events(self, events: List[Event]) -> None:
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        for m in (self.tb, self.csv, self.wandb):
+            if m.enabled:
+                m.write_events(events)

@@ -1,0 +1,90 @@
+"""Data loading.
+
+Equivalent of the reference's ``runtime/dataloader.py``
+(``DeepSpeedDataLoader`` + ``RepeatingLoader``).  In the single-controller
+model the loader yields *global* batches (every host feeds its local chips
+from a globally-consistent stream); the engine shards the batch over the
+``data`` mesh axis on device_put.  Works with any iterable / indexable
+dataset yielding numpy arrays, dicts of arrays, or tuples.
+"""
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+import jax
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference
+    ``RepeatingLoader``)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+def _stack(samples):
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(np.stack([s[i] for s in samples])
+                           for i in range(len(first)))
+    return np.stack(samples)
+
+
+class DeepSpeedDataLoader:
+    """Batches an indexable dataset into global batches of
+    ``batch_size`` samples, optionally shuffled per epoch with a seeded RNG
+    (deterministic across hosts — the TPU analogue of the reference's
+    DistributedSampler consistency check, engine.py:434)."""
+
+    def __init__(self, dataset, batch_size: int, shuffle: bool = True,
+                 seed: int = 1234, drop_last: bool = True,
+                 collate_fn=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or _stack
+        self.epoch = 0
+        if not hasattr(dataset, "__len__") or not hasattr(dataset, "__getitem__"):
+            raise TypeError("DeepSpeedDataLoader needs an indexable dataset; "
+                            "wrap pure iterators with RepeatingLoader instead")
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[Any]:
+        n = len(self.dataset)
+        idx = np.arange(n)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(idx)
+        self.epoch += 1
+        stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
+        for start in range(0, stop, self.batch_size):
+            sel = idx[start:start + self.batch_size]
+            yield self.collate_fn([self.dataset[int(i)] for i in sel])
+
+
+def shard_batch(batch, sharding) -> Any:
+    """device_put every array in the batch with the given NamedSharding."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(np.asarray(x), sharding), batch)

@@ -1,0 +1,126 @@
+"""Mixed precision: dynamic loss scaling + master-weight policy.
+
+Functional re-design of the reference's ``runtime/fp16/loss_scaler.py``
+(``LossScaler:67``, ``DynamicLossScaler:91``) and the master-weight schemes
+of ``FP16_Optimizer`` / ``BF16_Optimizer``: instead of optimizer wrapper
+classes with hooks, the scale and its hysteresis counters are plain fields
+of the train state, updated inside the jitted step with ``jnp.where`` (no
+data-dependent host control flow — XLA-friendly).
+
+On TPU bf16 is the native compute dtype and needs no loss scaling; fp16
+support is kept for parity and for fp16-native checkpoints.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    """Carried in TrainState; all fields are device scalars."""
+
+    loss_scale: jnp.ndarray      # f32
+    good_steps: jnp.ndarray      # i32 consecutive non-overflow steps
+    hysteresis: jnp.ndarray      # i32 remaining tolerated overflows
+
+
+def init_loss_scale(cfg) -> LossScaleState:
+    """Build from an ``FP16Config`` (static scale when ``loss_scale`` > 0)."""
+    if cfg.enabled and cfg.loss_scale == 0:
+        scale = float(2.0 ** cfg.initial_scale_power)
+    elif cfg.enabled:
+        scale = float(cfg.loss_scale)
+    else:
+        scale = 1.0
+    return LossScaleState(
+        loss_scale=jnp.asarray(scale, jnp.float32),
+        good_steps=jnp.asarray(0, jnp.int32),
+        hysteresis=jnp.asarray(cfg.hysteresis if cfg.enabled else 1, jnp.int32),
+    )
+
+
+def update_loss_scale(state: LossScaleState, overflow: jnp.ndarray,
+                      dynamic: bool, loss_scale_window: int = 1000,
+                      min_loss_scale: float = 1.0,
+                      consecutive_hysteresis: bool = False,
+                      init_hysteresis: int = 2) -> LossScaleState:
+    """One scale update (reference ``DynamicLossScaler.update_scale``).
+
+    Overflow: consume hysteresis; once exhausted halve the scale (bounded by
+    ``min_loss_scale``).  ``loss_scale_window`` good steps: double the scale
+    and optionally refill hysteresis.
+    """
+    if not dynamic:
+        return state
+    scale, good, hyst = state
+
+    hyst_after_overflow = jnp.maximum(hyst - 1, 0)
+    reduce_now = hyst_after_overflow == 0
+    scale_on_overflow = jnp.where(
+        reduce_now, jnp.maximum(scale / 2.0, min_loss_scale), scale)
+    hyst_on_overflow = jnp.where(reduce_now,
+                                 jnp.asarray(init_hysteresis, jnp.int32),
+                                 hyst_after_overflow)
+
+    good_next = good + 1
+    window_hit = good_next >= loss_scale_window
+    scale_on_good = jnp.where(window_hit, scale * 2.0, scale)
+    good_on_good = jnp.where(window_hit, 0, good_next)
+    hyst_on_good = (jnp.asarray(init_hysteresis, jnp.int32)
+                    if consecutive_hysteresis else hyst)
+
+    return LossScaleState(
+        loss_scale=jnp.where(overflow, scale_on_overflow, scale_on_good),
+        good_steps=jnp.where(overflow, 0, good_on_good),
+        hysteresis=jnp.where(overflow, hyst_on_overflow, hyst_on_good),
+    )
+
+
+def has_inf_or_nan(tree) -> jnp.ndarray:
+    """Global overflow check (reference ``stage3.py:2188 _has_inf_or_nan``) —
+    a single fused reduction over every gradient leaf."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(False)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g))) for g in leaves]
+    return jnp.any(jnp.stack(flags))
+
+
+def global_norm(tree) -> jnp.ndarray:
+    """Global L2 norm across every leaf (sharded arrays reduce globally under
+    GSPMD — no explicit psum needed)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+
+
+def clip_by_global_norm(tree, max_norm: float, norm: jnp.ndarray = None) -> Tuple:
+    """Scale gradients so their global norm is at most ``max_norm``
+    (reference engine grad clipping semantics)."""
+    if norm is None:
+        norm = global_norm(tree)
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * factor.astype(g.dtype), tree), norm
+
+
+DTYPE_MAP = {
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "fp32": jnp.float32,
+    "fp16": jnp.float16,
+    "bf16": jnp.bfloat16,
+}
+
+
+def compute_dtype_from_config(cfg) -> jnp.dtype:
+    return DTYPE_MAP[cfg.precision_dtype]
+
+
+def cast_tree(tree, dtype):
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        tree)

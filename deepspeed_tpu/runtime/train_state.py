@@ -1,0 +1,24 @@
+"""Functional train state.
+
+The reference engine mutates module params, optimizer buffers, and loss-scale
+counters in place; on TPU all of it is one immutable pytree threaded through
+the jitted step (donated each call, so memory is reused in place by XLA).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import struct
+
+from deepspeed_tpu.runtime.precision import LossScaleState
+
+
+@struct.dataclass
+class TrainState:
+    step: jnp.ndarray           # i32 global step counter
+    params: Any                 # master params (fp32 when mixed precision)
+    opt_state: Any
+    scale: LossScaleState
+    rng: jnp.ndarray            # PRNGKey for dropout etc.
+    skipped_steps: jnp.ndarray  # i32, overflow-skipped step count

@@ -1,0 +1,167 @@
+"""ZeRO as sharding layouts.
+
+TPU-native re-design of the reference's ZeRO optimizers
+(``runtime/zero/stage_1_and_2.py:97``, ``runtime/zero/stage3.py:112``,
+``runtime/zero/partition_parameters.py``): on TPU the three stages are
+*sharding layouts* on the train state, and XLA/GSPMD emits the collectives
+the reference issues by hand (reduce-scatter of grads ≡ the
+``average_tensor`` hot loop; per-layer all-gather ≡ the param coordinator's
+``fetch_sub_module``):
+
+- stage 0: params/grads/opt-state replicated; grads all-reduced.
+- stage 1: optimizer state sharded over the ZeRO axes.
+- stage 2: stage 1 + gradients constrained to the sharded layout, so XLA
+  reduce-scatters instead of all-reducing (``psum_scatter`` on the wire).
+- stage 3: parameters sharded too; all-gather materializes each layer's
+  params at use (FSDP). Small params stay replicated below
+  ``stage3_param_persistence_threshold`` — same knob, same semantics: they
+  are "persistent" exceptions that never pay a gather.
+
+No module hooks, no prefetch tracer: XLA's latency-hiding scheduler overlaps
+the gathers; scan-over-layers in the model bounds live parameters the way
+``stage3_max_live_parameters`` does.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import MeshTopology
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ZeroShardingPlan:
+    """Computes per-leaf PartitionSpecs for a given stage and topology."""
+
+    def __init__(self, topology: MeshTopology, stage: int,
+                 persistence_threshold: int = 100_000,
+                 hpz_partition_size: int = 1):
+        assert stage in (0, 1, 2, 3)
+        self.topology = topology
+        self.stage = stage
+        self.persistence_threshold = persistence_threshold
+        self.hpz_partition_size = hpz_partition_size
+        # ZeRO partitions over data+expert+seq (the reference's
+        # seq_data_parallel_group, engine.py:1603)
+        self.axes: Tuple[str, ...] = tuple(
+            a for a in topology.zero_axes if topology.axis_size(a) > 1)
+        self.partitions = int(np.prod(
+            [topology.axis_size(a) for a in self.axes])) if self.axes else 1
+
+    # -- per-leaf spec ----------------------------------------------------
+
+    def _shardable_dim(self, shape: Tuple[int, ...]) -> Optional[int]:
+        """Pick the dimension to shard: largest dim divisible by the
+        partition count (ties → earliest)."""
+        best = None
+        best_size = 0
+        for i, d in enumerate(shape):
+            if d % self.partitions == 0 and d > best_size:
+                best, best_size = i, d
+        return best
+
+    def leaf_spec(self, shape: Tuple[int, ...], sharded: bool) -> P:
+        """PartitionSpec for one array of ``shape``."""
+        if not sharded or not self.axes or len(shape) == 0:
+            return P()
+        if int(np.prod(shape)) <= self.persistence_threshold:
+            return P()  # persistent (replicated) small param
+        dim = self._shardable_dim(shape)
+        if dim is None:
+            return P()
+        spec = [None] * len(shape)
+        spec[dim] = self.axes if len(self.axes) > 1 else self.axes[0]
+        return P(*spec)
+
+    # -- tree-level specs -------------------------------------------------
+
+    def param_specs(self, params):
+        """Stage 3 shards params; stages 0-2 replicate them."""
+        sharded = self.stage >= 3
+        return jax.tree_util.tree_map(
+            lambda x: self.leaf_spec(x.shape, sharded), params)
+
+    def grad_specs(self, params):
+        """Stage >= 2 keeps grads in the sharded layout (reduce-scatter)."""
+        sharded = self.stage >= 2
+        return jax.tree_util.tree_map(
+            lambda x: self.leaf_spec(x.shape, sharded), params)
+
+    def opt_state_specs(self, opt_state):
+        """Stage >= 1 shards optimizer moments. Rule: any leaf big enough to
+        shard follows the same layout as a param of its shape; scalars and
+        small leaves replicate."""
+        sharded = self.stage >= 1
+        return jax.tree_util.tree_map(
+            lambda x: self.leaf_spec(getattr(x, "shape", ()), sharded), opt_state)
+
+    # -- shardings --------------------------------------------------------
+
+    def _to_sharding(self, spec_tree):
+        mesh = self.topology.mesh
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), spec_tree,
+            is_leaf=lambda x: isinstance(x, P))
+
+    def param_shardings(self, params):
+        return self._to_sharding(self.param_specs(params))
+
+    def grad_shardings(self, params):
+        return self._to_sharding(self.grad_specs(params))
+
+    def opt_state_shardings(self, opt_state):
+        return self._to_sharding(self.opt_state_specs(opt_state))
+
+    def batch_spec(self, batch_ndim: int, has_gas_dim: bool = False) -> P:
+        """Batch arrays shard their batch dim over (data, expert): each
+        data-parallel (and expert-parallel) member sees different samples.
+        The ``seq`` axis shards the sequence dim when sequence parallelism is
+        active (handled by the sequence engine; here seq stays on batch)."""
+        axes = tuple(a for a in ("data", "expert")
+                     if self.topology.axis_size(a) > 1)
+        specs = []
+        if has_gas_dim:
+            specs.append(None)  # scan (GAS) dim never sharded
+        specs.append(axes if len(axes) > 1 else (axes[0] if axes else None))
+        while len(specs) < batch_ndim:
+            specs.append(None)
+        return P(*specs)
+
+    def batch_sharding(self, batch_ndim: int, has_gas_dim: bool = False) -> NamedSharding:
+        return NamedSharding(self.topology.mesh,
+                             self.batch_spec(batch_ndim, has_gas_dim))
+
+    def describe(self, params) -> str:
+        n_sharded = 0
+        n_total = 0
+        bytes_sharded = 0
+        bytes_total = 0
+        for leaf, spec in zip(jax.tree_util.tree_leaves(params),
+                              jax.tree_util.tree_leaves(
+                                  self.param_specs(params),
+                                  is_leaf=lambda x: isinstance(x, P))):
+            n_total += 1
+            sz = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+            bytes_total += sz
+            if any(s is not None for s in spec):
+                n_sharded += 1
+                bytes_sharded += sz
+        return (f"ZeRO stage {self.stage}: {n_sharded}/{n_total} param tensors "
+                f"sharded over {self.axes} ({self.partitions} partitions), "
+                f"{bytes_sharded / max(bytes_total, 1):.0%} of param bytes")
+
+
+def constrain_tree(tree, spec_tree, mesh: Mesh):
+    """Apply ``with_sharding_constraint`` leaf-wise (used on grads inside the
+    step for stage >= 2)."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.lax.with_sharding_constraint(x, NamedSharding(mesh, s)),
+        tree, spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def log_plan(plan: ZeroShardingPlan, params) -> None:
+    log_dist(plan.describe(params), ranks=[0])

@@ -1,0 +1,38 @@
+"""Tiny model fixtures (the reference's ``tests/unit/simple_model.py``
+philosophy: small models, not LLMs)."""
+import numpy as np
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMLoss
+
+TINY = GPT2Config(vocab_size=128, n_positions=32, n_embd=32, n_layer=2,
+                  n_head=2, dropout=0.0, dtype=np.float32,
+                  param_dtype=np.float32, scan_layers=True, remat=False)
+
+
+def tiny_gpt2(**overrides):
+    import dataclasses
+
+    cfg = dataclasses.replace(TINY, **overrides)
+    return GPT2LMLoss(cfg)
+
+
+def random_tokens(n_samples: int, seq_len: int = 16, vocab: int = 128,
+                  seed: int = 0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, size=(n_samples, seq_len),
+                                      dtype=np.int32)}
+
+
+class TokenDataset:
+    """Indexable dataset of {'input_ids': [S]} samples."""
+
+    def __init__(self, n_samples: int = 64, seq_len: int = 16,
+                 vocab: int = 128, seed: int = 0):
+        data = random_tokens(n_samples, seq_len, vocab, seed)
+        self.ids = data["input_ids"]
+
+    def __len__(self):
+        return len(self.ids)
+
+    def __getitem__(self, i):
+        return {"input_ids": self.ids[i]}

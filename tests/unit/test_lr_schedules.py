@@ -1,0 +1,93 @@
+"""LR schedule tests (mirrors reference tests/unit/runtime/test_lr_schedulers.py)."""
+import math
+
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (LRScheduler, get_schedule_fn,
+                                                one_cycle, warmup_cosine_lr,
+                                                warmup_decay_lr, warmup_lr,
+                                                lr_range_test)
+
+
+def test_warmup_lr_linear():
+    fn = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10,
+                   warmup_type="linear")
+    assert fn(0) == 0.0
+    assert abs(fn(5) - 0.05) < 1e-9
+    assert fn(10) == 0.1
+    assert fn(1000) == 0.1
+
+
+def test_warmup_lr_log():
+    fn = warmup_lr(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10,
+                   warmup_type="log")
+    assert fn(0) == 0.0
+    assert fn(5) < 0.1
+    assert fn(10) == 0.1
+    # log warmup front-loads lr vs linear
+    lin = warmup_lr(warmup_max_lr=0.1, warmup_num_steps=10, warmup_type="linear")
+    assert fn(3) > lin(3)
+
+
+def test_warmup_decay():
+    fn = warmup_decay_lr(total_num_steps=100, warmup_max_lr=0.1,
+                         warmup_num_steps=10, warmup_type="linear")
+    assert fn(10) == 0.1
+    assert abs(fn(55) - 0.05) < 1e-9
+    assert fn(100) == 0.0
+    assert fn(200) == 0.0
+
+
+def test_warmup_cosine():
+    fn = warmup_cosine_lr(total_num_steps=100, warmup_num_steps=10,
+                          cos_min_ratio=0.1, lr=1.0, warmup_type="linear")
+    assert abs(fn(10) - 1.0) < 1e-6
+    assert abs(fn(100) - 0.1) < 1e-6
+    mid = fn(55)
+    assert 0.1 < mid < 1.0
+
+
+def test_one_cycle():
+    fn = one_cycle(cycle_min_lr=0.01, cycle_max_lr=0.1,
+                   cycle_first_step_size=10, decay_step_size=10,
+                   decay_lr_rate=0.5)
+    assert fn(0) == 0.01
+    assert abs(fn(10) - 0.1) < 1e-9
+    assert abs(fn(20) - 0.01) < 1e-9
+    assert fn(40) < 0.01  # decay phase
+
+
+def test_lr_range_test():
+    fn = lr_range_test(lr_range_test_min_lr=0.001,
+                       lr_range_test_step_size=10,
+                       lr_range_test_step_rate=1.0)
+    assert fn(0) == 0.001
+    assert fn(10) == 0.002
+    stair = lr_range_test(lr_range_test_min_lr=0.001,
+                          lr_range_test_step_size=10,
+                          lr_range_test_step_rate=1.0,
+                          lr_range_test_staircase=True)
+    assert stair(9) == 0.001
+    assert stair(10) == 0.002
+
+
+def test_scheduler_wrapper():
+    sched = LRScheduler(get_schedule_fn("WarmupLR",
+                                        {"warmup_max_lr": 0.1,
+                                         "warmup_num_steps": 5,
+                                         "warmup_type": "linear"}))
+    lrs = []
+    for _ in range(6):
+        sched.step()
+        lrs.append(sched.get_lr()[0])
+    assert lrs[-1] == 0.1
+    sd = sched.state_dict()
+    sched2 = LRScheduler(get_schedule_fn("WarmupLR", {"warmup_max_lr": 0.1,
+                                                      "warmup_num_steps": 5}))
+    sched2.load_state_dict(sd)
+    assert sched2.get_lr() == sched.get_lr()
+
+
+def test_unknown_scheduler():
+    with pytest.raises(ValueError):
+        get_schedule_fn("NoSuchSchedule", {})
