@@ -90,9 +90,8 @@ def main() -> None:
 
     samples_per_sec = steps * micro * dp / dt
     tokens_per_sec = samples_per_sec * seq
-    # 6*N per token fwd+bwd + attention term
-    flops_per_tok = 6.0 * n_params + 12.0 * cfg_model.n_layer * cfg_model.n_embd * seq
-    model_flops = tokens_per_sec * flops_per_tok
+    from deepspeed_tpu.models.gpt2 import flops_per_token
+    model_flops = tokens_per_sec * flops_per_token(cfg_model, seq)
     n_chips = len(jax.devices())
     mfu = 100.0 * model_flops / (peak_flops(dev.device_kind) * n_chips)
 

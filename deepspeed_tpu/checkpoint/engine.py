@@ -43,7 +43,16 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     path = os.path.join(save_dir, tag)
     os.makedirs(path, exist_ok=True)
 
-    host_state: TrainState = jax.device_get(engine.state)
+    # single-writer: process 0 owns the canonical full-state file.  On
+    # multi-host meshes, sharded leaves span non-addressable devices; gather
+    # them to fully-replicated before the host transfer.
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        host_state: TrainState = multihost_utils.process_allgather(
+            engine.state)
+    else:
+        host_state = jax.device_get(engine.state)
     ckpt = {
         "module": host_state.params,
         "optimizer": host_state.opt_state,
@@ -56,7 +65,6 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "global_samples": engine.global_samples,
         "client_state": client_state or {},
     }
-    # single-writer: process 0 owns the canonical full-state file
     if jax.process_index() == 0:
         with open(os.path.join(path, MODEL_FILE), "wb") as f:
             pickle.dump(ckpt, f)

@@ -128,11 +128,11 @@ class ScanBlock(nn.Module):
     """Block adapted to nn.scan carry signature."""
 
     config: GPT2Config
+    deterministic: bool = True
 
     @nn.compact
     def __call__(self, x, _):
-        deterministic = self.config.dropout == 0.0
-        return Block(self.config, name="block")(x, deterministic), None
+        return Block(self.config, name="block")(x, self.deterministic), None
 
 
 class GPT2Model(nn.Module):
@@ -159,7 +159,7 @@ class GPT2Model(nn.Module):
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layer,
                 metadata_params={nn.PARTITION_NAME: "layers"},
-            )(cfg, name="h")(x, None)
+            )(cfg, deterministic, name="h")(x, None)
         else:
             block_cls = Block
             if cfg.remat:
@@ -201,9 +201,11 @@ def count_params(params) -> int:
 
 
 def flops_per_token(cfg: GPT2Config, seq_len: Optional[int] = None) -> float:
-    """Approximate fwd+bwd FLOPs per token (6N + attention term), for MFU."""
+    """Model fwd+bwd FLOPs per token for MFU (PaLM-appendix convention):
+    ``6 * N_matmul + 12 * L * E * S`` where ``N_matmul`` counts matmul
+    params (block weights + the LM head; embedding lookups are gathers)."""
     n = (12 * cfg.n_layer * cfg.n_embd ** 2 +
-         2 * cfg.vocab_size * cfg.n_embd)  # params sans embeddings-pos
+         cfg.vocab_size * cfg.n_embd)
     s = seq_len or cfg.n_positions
     attn = 12 * cfg.n_layer * cfg.n_embd * s
     return 6.0 * n + attn

@@ -1,0 +1,375 @@
+"""Flash attention for TPU.
+
+TPU-native replacement for the reference's fused attention kernels
+(``csrc/transformer/`` softmax/attention CUDA kernels powering
+``DeepSpeedTransformerLayer``, and the inference flash kernels under
+``csrc/transformer/inference/``).  Two implementations behind one API:
+
+- :func:`flash_attention` — a Pallas TPU kernel (online-softmax, blockwise,
+  O(S) memory, causal skip, GQA via head-index mapping).  The grid is
+  ``(B, H, num_q_blocks, num_k_blocks)``; TPU grids execute sequentially per
+  core, so the running max/denominator/accumulator live in VMEM scratch
+  across the innermost (k-block) grid steps.
+- :func:`blockwise_attention` — a pure-XLA ``lax.scan`` formulation of the
+  same math, used as the CPU fallback and as the memory-efficient custom
+  backward (recompute-based, matching the flash-attention-2 backward).
+
+Both return identical values; the custom VJP makes the Pallas forward
+differentiable with blockwise-recompute gradients, so the full train step
+stays O(S) in activation memory (the reference gets this from its fused
+kernels + activation checkpointing).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+_LANE = 128  # TPU lane width; scratch row-stat buffers are (bq, _LANE)
+
+
+# ---------------------------------------------------------------------------
+# Reference (naive) attention — used by tests and tiny shapes
+# ---------------------------------------------------------------------------
+
+def mha_reference(q, k, v, causal: bool = True,
+                  sm_scale: Optional[float] = None,
+                  bias: Optional[jax.Array] = None) -> jax.Array:
+    """Naive O(S^2)-memory attention. q: [B,H,S,D]; k,v: [B,Hkv,S,D]."""
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    groups = q.shape[1] // k.shape[1]
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=1)
+        v = jnp.repeat(v, groups, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * sm_scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask[None, None], logits, DEFAULT_MASK_VALUE)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Blockwise XLA implementation (fallback fwd + custom bwd)
+# ---------------------------------------------------------------------------
+
+def _blockwise_fwd(q, k, v, *, sm_scale: float, causal: bool,
+                   block_q: int, block_k: int
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Online-softmax attention via lax.scan. Returns (out, lse).
+
+    q: [B,H,S,D] (f32 compute), k/v already head-expanded to H.
+    """
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    nq = pl.cdiv(S, block_q)
+    nk = pl.cdiv(Sk, block_k)
+    q_pad = nq * block_q - S
+    k_pad = nk * block_k - Sk
+    qf = jnp.pad(q.astype(jnp.float32), ((0, 0), (0, 0), (0, q_pad), (0, 0)))
+    kf = jnp.pad(k.astype(jnp.float32), ((0, 0), (0, 0), (0, k_pad), (0, 0)))
+    vf = jnp.pad(v.astype(jnp.float32), ((0, 0), (0, 0), (0, k_pad), (0, 0)))
+    qf = qf.reshape(B, H, nq, block_q, D)
+    kf = kf.reshape(B, H, nk, block_k, D)
+    vf = vf.reshape(B, H, nk, block_k, D)
+
+    k_idx = jnp.arange(nk * block_k).reshape(nk, block_k)
+    q_idx = jnp.arange(nq * block_q).reshape(nq, block_q)
+    # bottom-right-aligned causal (matches mha_reference tril k=Sk-S): the
+    # last query attends to the last key — the KV-cache decode convention
+    causal_offset = Sk - S
+
+    def q_block_step(_, qi):
+        q_blk, qpos = qi  # [B,H,bq,D], [bq]
+
+        def k_block_step(carry, ki):
+            acc, m, l = carry
+            k_blk, v_blk, kpos = ki
+            s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, k_blk) * sm_scale
+            mask = (kpos[None, :] < Sk)
+            if causal:
+                mask = mask & (kpos[None, :] <= qpos[:, None] + causal_offset)
+            s = jnp.where(mask[None, None], s, DEFAULT_MASK_VALUE)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[..., None] + jnp.einsum(
+                "bhqk,bhkd->bhqd", p, v_blk)
+            return (acc_new, m_new, l_new), None
+
+        init = (jnp.zeros((B, H, block_q, D), jnp.float32),
+                jnp.full((B, H, block_q), -jnp.inf, jnp.float32),
+                jnp.zeros((B, H, block_q), jnp.float32))
+        (acc, m, l), _ = jax.lax.scan(
+            k_block_step, init,
+            (kf.transpose(2, 0, 1, 3, 4), vf.transpose(2, 0, 1, 3, 4), k_idx))
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))
+        return None, (out, lse)
+
+    _, (o_blocks, lse_blocks) = jax.lax.scan(
+        q_block_step, None, (qf.transpose(2, 0, 1, 3, 4), q_idx))
+    out = o_blocks.transpose(1, 2, 0, 3, 4).reshape(B, H, nq * block_q, D)
+    lse = lse_blocks.transpose(1, 2, 0, 3).reshape(B, H, nq * block_q)
+    return out[:, :, :S], lse[:, :, :S]
+
+
+def _blockwise_bwd(q, k, v, o, lse, do, *, sm_scale: float, causal: bool,
+                   block_q: int, block_k: int):
+    """Flash-attention-2 style backward: recompute P blockwise from lse."""
+    B, H, S, D = q.shape
+    Sk = k.shape[2]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    nq = pl.cdiv(S, block_q)
+    q_pad = nq * block_q - S
+
+    def pad_q(x, fill=0.0):
+        return jnp.pad(x.astype(jnp.float32),
+                       ((0, 0), (0, 0), (0, q_pad)) + ((0, 0),) * (x.ndim - 3),
+                       constant_values=fill)
+
+    causal_offset = Sk - S
+    qf = pad_q(q).reshape(B, H, nq, block_q, D)
+    dof = pad_q(do).reshape(B, H, nq, block_q, D)
+    # padded rows get lse=+inf → P = exp(-inf) = 0 → no gradient contribution
+    lsef = pad_q(lse, fill=jnp.inf).reshape(B, H, nq, block_q)
+    deltaf = pad_q(delta).reshape(B, H, nq, block_q)
+    q_idx = jnp.arange(nq * block_q).reshape(nq, block_q)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    k_pos = jnp.arange(Sk)
+
+    def q_block_step(carry, qi):
+        dk_acc, dv_acc = carry
+        q_blk, do_blk, lse_blk, delta_blk, qpos = qi
+        s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, kf) * sm_scale
+        mask = jnp.ones((block_q, Sk), dtype=bool)
+        if causal:
+            mask = k_pos[None, :] <= qpos[:, None] + causal_offset
+        s = jnp.where(mask[None, None], s, DEFAULT_MASK_VALUE)
+        p = jnp.exp(s - lse_blk[..., None])
+        dv_acc = dv_acc + jnp.einsum("bhqk,bhqd->bhkd", p, do_blk)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", do_blk, vf)
+        ds = p * (dp - delta_blk[..., None]) * sm_scale
+        dq_blk = jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+        dk_acc = dk_acc + jnp.einsum("bhqk,bhqd->bhkd", ds, q_blk)
+        return (dk_acc, dv_acc), dq_blk
+
+    init = (jnp.zeros((B, H, Sk, D), jnp.float32),
+            jnp.zeros((B, H, Sk, D), jnp.float32))
+    (dk, dv), dq_blocks = jax.lax.scan(
+        q_block_step, init,
+        (qf.transpose(2, 0, 1, 3, 4), dof.transpose(2, 0, 1, 3, 4),
+         lsef.transpose(2, 0, 1, 3), deltaf.transpose(2, 0, 1, 3), q_idx))
+    dq = dq_blocks.transpose(1, 2, 0, 3, 4).reshape(B, H, nq * block_q, D)
+    return dq[:, :, :S], dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_sc, m_sc, l_sc, *,
+                      sm_scale: float, causal: bool,
+                      block_q: int, block_k: int, seq_q: int, seq_k: int):
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+    i = pl.program_id(2)
+    # bottom-right-aligned causal diagonal (KV-cache decode convention)
+    causal_offset = seq_k - seq_q
+
+    @pl.when(j == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, -jnp.inf)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    # causal: block row i only reaches key blocks starting at or below its
+    # shifted diagonal
+    run = jnp.logical_or(
+        not causal, j * block_k <= (i + 1) * block_q - 1 + causal_offset)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)           # [bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        v = v_ref[0, 0].astype(jnp.float32)           # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk]
+        kpos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = kpos < seq_k
+        if causal:
+            qpos = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, kpos <= qpos + causal_offset)
+        s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+
+        m_prev = m_sc[:, 0]                            # [bq]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_sc[:] = (l_sc[:] * alpha[:, None] +
+                   jnp.broadcast_to(jnp.sum(p, axis=-1)[:, None],
+                                    l_sc.shape))
+        acc_sc[:] = acc_sc[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_sc[:] = jnp.broadcast_to(m_new[:, None], m_sc.shape)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_sc[:, 0], 1e-30)             # [bq]
+        o_ref[0, 0] = (acc_sc[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_sc[:, 0] + jnp.log(l))[None, :]
+
+
+def _flash_fwd_pallas(q, k, v, *, sm_scale: float, causal: bool,
+                      block_q: int, block_k: int,
+                      interpret: bool = False
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """q: [B,H,S,D]; k,v: [B,Hkv,Sk,D] (GQA: Hkv divides H)."""
+    B, H, S, D = q.shape
+    _, Hkv, Sk, _ = k.shape
+    groups = H // Hkv
+    block_q = min(block_q, S)
+    block_k = min(block_k, Sk)
+    nq = pl.cdiv(S, block_q)
+    nk = pl.cdiv(Sk, block_k)
+    q_pad = nq * block_q - S
+    k_pad = nk * block_k - Sk
+    if q_pad:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, q_pad), (0, 0)))
+    if k_pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, k_pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, k_pad), (0, 0)))
+
+    grid = (B, H, nq, nk)
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, sm_scale=sm_scale,
+                          causal=causal, block_q=block_q, block_k=block_k,
+                          seq_q=S, seq_k=Sk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h // groups, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D),
+                         lambda b, h, i, j: (b, h // groups, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda b, h, i, j: (b, h, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, nq * block_q, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, 1, nq * block_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+            pltpu.VMEM((block_q, _LANE), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+    return out[:, :, :S], lse[:, :, 0, :S]
+
+
+# ---------------------------------------------------------------------------
+# Public API with custom VJP
+# ---------------------------------------------------------------------------
+
+def _expand_kv(q, k, v):
+    groups = q.shape[1] // k.shape[1]
+    if groups > 1:
+        k = jnp.repeat(k, groups, axis=1)
+        v = jnp.repeat(v, groups, axis=1)
+    return k, v
+
+
+def _use_pallas() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _flash_fwd_dispatch(q, k, v, sm_scale, causal, block_q, block_k,
+                                 interpret)
+    return out
+
+
+def _flash_fwd_dispatch(q, k, v, sm_scale, causal, block_q, block_k,
+                        interpret):
+    if _use_pallas() or interpret:
+        return _flash_fwd_pallas(q, k, v, sm_scale=sm_scale, causal=causal,
+                                 block_q=block_q, block_k=block_k,
+                                 interpret=interpret)
+    ke, ve = _expand_kv(q, k, v)
+    out, lse = _blockwise_fwd(q, ke, ve, sm_scale=sm_scale, causal=causal,
+                              block_q=block_q, block_k=block_k)
+    return out.astype(q.dtype), lse
+
+
+def _flash_vjp_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd_dispatch(q, k, v, sm_scale, causal, block_q,
+                                   block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    n_kv = k.shape[1]
+    groups = q.shape[1] // n_kv
+    ke, ve = _expand_kv(q, k, v)
+    dq, dk, dv = _blockwise_bwd(q, ke, ve, out, lse, do, sm_scale=sm_scale,
+                                causal=causal, block_q=block_q,
+                                block_k=block_k)
+    if groups > 1:  # sum GQA group gradients back to the shared kv head
+        B, H, Sk, D = dk.shape
+        dk = dk.reshape(B, n_kv, groups, Sk, D).sum(axis=2)
+        dv = dv.reshape(B, n_kv, groups, Sk, D).sum(axis=2)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = True, sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False) -> jax.Array:
+    """Flash attention.  q: [B, H, S, D]; k, v: [B, Hkv, Sk, D] where Hkv
+    divides H (grouped-query attention).  Returns [B, H, S, D] in q.dtype.
+
+    Pallas kernel on TPU; blockwise-XLA everywhere else; O(S)-memory custom
+    backward in both cases.  ``interpret=True`` forces the Pallas kernel in
+    interpreter mode (CPU testing).
+    """
+    assert q.shape[1] % k.shape[1] == 0, (
+        f"q heads {q.shape[1]} not a multiple of kv heads {k.shape[1]}")
+    assert k.shape == v.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    return _flash(q, k, v, float(sm_scale), bool(causal), int(block_q),
+                  int(block_k), bool(interpret))

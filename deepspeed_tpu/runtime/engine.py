@@ -237,8 +237,8 @@ class DeepSpeedEngine:
             from deepspeed_tpu.monitor.monitor import MonitorMaster
 
             self.monitor = MonitorMaster(config.monitor_config)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.warning(f"monitor setup failed; metric logging disabled: {e}")
         dist.configure(config.comms_logger)
 
         self.optimizer = OptimizerHandle(self)
@@ -362,7 +362,9 @@ class DeepSpeedEngine:
                 skipped_steps=state.skipped_steps + overflow.astype(jnp.int32))
             metrics = {
                 "loss": loss_sum / (scale * gas),
-                "grad_norm": grad_norm / scale,
+                # grads were already unscaled by 1/(scale*gas) above, so the
+                # norm is reported as-is
+                "grad_norm": grad_norm,
                 "overflow": overflow,
                 "loss_scale": new_scale.loss_scale,
             }

@@ -1,0 +1,157 @@
+"""Flash attention kernel tests (reference: tests/unit/ops kernel tests).
+
+Runs the blockwise-XLA path natively on CPU and the Pallas kernel in
+interpreter mode, both against the naive O(S^2) reference.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.flash_attention import (flash_attention,
+                                               mha_reference,
+                                               _blockwise_fwd)
+
+
+def _make_qkv(rng, B=2, H=4, Hkv=None, S=128, D=32, dtype=jnp.float32):
+    Hkv = Hkv or H
+    q = jnp.asarray(rng.normal(size=(B, H, S, D)), dtype)
+    k = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), dtype)
+    v = jnp.asarray(rng.normal(size=(B, Hkv, S, D)), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_blockwise_matches_reference(causal):
+    rng = np.random.default_rng(0)
+    q, k, v = _make_qkv(rng, S=96, D=16)
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_blockwise_uneven_blocks():
+    rng = np.random.default_rng(1)
+    q, k, v = _make_qkv(rng, S=80, D=16)  # 80 not divisible by 32
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_gqa():
+    rng = np.random.default_rng(2)
+    q, k, v = _make_qkv(rng, H=8, Hkv=2, S=64, D=16)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_cross_attention_lengths():
+    rng = np.random.default_rng(3)
+    B, H, D = 1, 2, 16
+    q = jnp.asarray(rng.normal(size=(B, H, 48, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, 96, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, 96, D)), jnp.float32)
+    out = flash_attention(q, k, v, causal=False, block_q=32, block_k=32)
+    ref = mha_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_causal_cross_attention_decode_alignment():
+    """Bottom-right-aligned causal: a 1-token query over a 64-token KV cache
+    (decode step) must attend to ALL keys, and gradients must match."""
+    rng = np.random.default_rng(30)
+    B, H, D, Sk = 1, 2, 16, 64
+    k = jnp.asarray(rng.normal(size=(B, H, Sk, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, Sk, D)), jnp.float32)
+    for Sq in (1, 16, 48):
+        q = jnp.asarray(rng.normal(size=(B, H, Sq, D)), jnp.float32)
+        out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        out_i = flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                                interpret=True)
+        np.testing.assert_allclose(np.asarray(out_i), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        g = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, block_q=16, block_k=16) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(mha_reference(
+            q, k, v, causal=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_match_reference(causal):
+    rng = np.random.default_rng(4)
+    q, k, v = _make_qkv(rng, B=1, H=2, S=64, D=16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=32,
+                                       block_k=32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_gqa_gradients():
+    rng = np.random.default_rng(5)
+    q, k, v = _make_qkv(rng, B=1, H=4, Hkv=2, S=32, D=8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=16,
+                                       block_k=16) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_pallas_kernel_interpret_mode():
+    """The TPU kernel itself, run through the Pallas interpreter on CPU."""
+    rng = np.random.default_rng(6)
+    q, k, v = _make_qkv(rng, B=1, H=2, S=128, D=32)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                          interpret=True)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_pallas_kernel_interpret_gqa_noncausal():
+    rng = np.random.default_rng(7)
+    q, k, v = _make_qkv(rng, B=1, H=4, Hkv=2, S=128, D=32)
+    out = flash_attention(q, k, v, causal=False, block_q=64, block_k=64,
+                          interpret=True)
+    ref = mha_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_lse_matches_logsumexp():
+    rng = np.random.default_rng(8)
+    q, k, v = _make_qkv(rng, B=1, H=1, S=64, D=16)
+    _, lse = _blockwise_fwd(q, k, v, sm_scale=0.25, causal=False,
+                            block_q=32, block_k=32)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * 0.25
+    ref_lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               atol=1e-5, rtol=1e-5)
