@@ -80,6 +80,10 @@ class CausalSelfAttention(nn.Module):
 
         q, k, v = heads(q), heads(k), heads(v)
         if cfg.use_flash_attention:
+            assert cfg.dropout == 0.0 or deterministic, (
+                "flash attention has no attention-probability dropout; set "
+                "dropout=0 or use_flash_attention=False for training with "
+                "dropout")
             from deepspeed_tpu.ops.flash_attention import flash_attention
 
             y = flash_attention(q, k, v, causal=True)

@@ -1,0 +1,244 @@
+"""Fused optimizer kernels (Pallas).
+
+TPU-native equivalent of the reference's multi-tensor fused optimizer CUDA
+kernels (``csrc/adam/multi_tensor_adam.cu`` + ``fused_adam_frontend.cpp``
+behind ``deepspeed/ops/adam/fused_adam.py:18 FusedAdam``; ``csrc/lion/``).
+One Pallas kernel performs the whole update for a parameter tile — moment
+updates, bias correction, decoupled/L2 weight decay, and the update
+direction — in a single pass over HBM, which is exactly what the CUDA
+multi-tensor apply buys the reference (bandwidth-bound optimizer math with
+no intermediate round-trips).
+
+The kernels produce the *update direction* ``u`` and new moments; the engine
+applies ``p_new = p - lr * u`` inside the train step (lr stays outside so
+schedule changes never retrace).  Exposed as optax-compatible transforms
+(:func:`scale_by_fused_adam`, :func:`scale_by_fused_lion`) that the optimizer
+factory substitutes for the stock optax path when ``fused=true`` on TPU.
+
+CPU fallback: identical math in plain jnp (tests compare both, and run the
+Pallas kernel in interpreter mode).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128
+_BLOCK_ROWS = 512  # rows of 128 lanes per grid step
+
+
+class ScaleByFusedAdamState(NamedTuple):
+    count: jax.Array
+    mu: optax.Updates
+    nu: optax.Updates
+
+
+class ScaleByFusedLionState(NamedTuple):
+    count: jax.Array
+    mu: optax.Updates
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+def _adam_kernel(step_ref, g_ref, p_ref, m_ref, v_ref,
+                 u_ref, m_out_ref, v_out_ref, *,
+                 b1: float, b2: float, eps: float, wd: float, adam_w: bool):
+    t = step_ref[0].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    p = p_ref[:].astype(jnp.float32)
+    m = m_ref[:]
+    v = v_ref[:]
+    if wd and not adam_w:  # L2 mode: decay folded into the gradient
+        g = g + wd * p
+    m_new = b1 * m + (1.0 - b1) * g
+    v_new = b2 * v + (1.0 - b2) * g * g
+    bc1 = 1.0 - jnp.power(b1, t)
+    bc2 = 1.0 - jnp.power(b2, t)
+    u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    if wd and adam_w:  # decoupled (AdamW) decay joins the direction
+        u = u + wd * p
+    u_ref[:] = u
+    m_out_ref[:] = m_new
+    v_out_ref[:] = v_new
+
+
+def _lion_kernel(step_ref, g_ref, p_ref, m_ref, u_ref, m_out_ref, *,
+                 b1: float, b2: float, wd: float):
+    del step_ref  # lion has no bias correction
+    g = g_ref[:].astype(jnp.float32)
+    p = p_ref[:].astype(jnp.float32)
+    m = m_ref[:]
+    u = jnp.sign(b1 * m + (1.0 - b1) * g)
+    if wd:
+        u = u + wd * p
+    u_ref[:] = u
+    m_out_ref[:] = b2 * m + (1.0 - b2) * g
+
+
+def _block_rows(n: int) -> int:
+    """Per-leaf block size: 8-row aligned, capped at _BLOCK_ROWS, so small
+    leaves (biases, norms) pad to at most 8x128 instead of 512x128."""
+    rows = pl.cdiv(max(n, 1), _LANE)
+    rows = pl.cdiv(rows, 8) * 8
+    return min(rows, _BLOCK_ROWS)
+
+
+def _tile(x: jax.Array) -> jax.Array:
+    """Flatten to (rows, 128) padded to the leaf's block-row multiple."""
+    n = x.size
+    blk = _block_rows(n)
+    rows = pl.cdiv(max(n, 1), _LANE)
+    rows = pl.cdiv(rows, blk) * blk
+    flat = jnp.pad(x.reshape(-1).astype(jnp.float32),
+                   (0, rows * _LANE - n))
+    return flat.reshape(rows, _LANE)
+
+
+def _untile(x: jax.Array, shape, dtype) -> jax.Array:
+    n = int(np.prod(shape)) if shape else 1
+    return x.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+def _run_elementwise(kernel, step, tiles, n_outs: int, interpret: bool):
+    """Run an elementwise optimizer kernel over same-shape (R,128) tiles."""
+    rows = tiles[0].shape[0]
+    blk_rows = _block_rows(rows * _LANE)
+    grid = (rows // blk_rows,)
+    blk = pl.BlockSpec((blk_rows, _LANE), lambda i: (i, 0))
+    step_arr = jnp.asarray([step], jnp.int32)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] +
+                 [blk] * len(tiles),
+        out_specs=[blk] * n_outs,
+        out_shape=[jax.ShapeDtypeStruct((rows, _LANE), jnp.float32)
+                   ] * n_outs,
+        interpret=interpret,
+    )(step_arr, *tiles)
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Per-leaf updates (pallas on TPU / jnp elsewhere)
+# ---------------------------------------------------------------------------
+
+def adam_update_leaf(g, p, m, v, step, *, b1, b2, eps, wd, adam_w,
+                     interpret: bool = False):
+    """Returns (u, m_new, v_new) for one leaf."""
+    if _on_tpu() or interpret:
+        kern = functools.partial(_adam_kernel, b1=b1, b2=b2, eps=eps, wd=wd,
+                                 adam_w=adam_w)
+        u, m_new, v_new = _run_elementwise(
+            kern, step, [_tile(g), _tile(p), _tile(m), _tile(v)], 3,
+            interpret)
+        return (_untile(u, g.shape, jnp.float32),
+                _untile(m_new, g.shape, jnp.float32),
+                _untile(v_new, g.shape, jnp.float32))
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    if wd and not adam_w:
+        gf = gf + wd * pf
+    m_new = b1 * m + (1.0 - b1) * gf
+    v_new = b2 * v + (1.0 - b2) * gf * gf
+    t = step.astype(jnp.float32)
+    u = (m_new / (1.0 - jnp.power(b1, t))) / (
+        jnp.sqrt(v_new / (1.0 - jnp.power(b2, t))) + eps)
+    if wd and adam_w:
+        u = u + wd * pf
+    return u, m_new, v_new
+
+
+def lion_update_leaf(g, p, m, step, *, b1, b2, wd, interpret: bool = False):
+    if _on_tpu() or interpret:
+        kern = functools.partial(_lion_kernel, b1=b1, b2=b2, wd=wd)
+        u, m_new = _run_elementwise(
+            kern, step, [_tile(g), _tile(p), _tile(m)], 2, interpret)
+        return (_untile(u, g.shape, jnp.float32),
+                _untile(m_new, g.shape, jnp.float32))
+    gf = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    u = jnp.sign(b1 * m + (1.0 - b1) * gf)
+    if wd:
+        u = u + wd * pf
+    return u, b2 * m + (1.0 - b2) * gf
+
+
+# ---------------------------------------------------------------------------
+# optax-compatible transforms
+# ---------------------------------------------------------------------------
+
+def scale_by_fused_adam(b1: float = 0.9, b2: float = 0.999,
+                        eps: float = 1e-8, weight_decay: float = 0.0,
+                        adam_w_mode: bool = True,
+                        interpret: bool = False
+                        ) -> optax.GradientTransformation:
+    """Fused Adam/AdamW (``deepspeed/ops/adam/fused_adam.py`` equivalent).
+    Unlike stock optax chains, moments + bias correction + weight decay are
+    one kernel per leaf. Requires params to be passed to ``update``."""
+
+    def init_fn(params):
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return ScaleByFusedAdamState(
+            count=jnp.zeros([], jnp.int32), mu=zeros,
+            nu=jax.tree_util.tree_map(jnp.copy, zeros))
+
+    def update_fn(updates, state, params=None):
+        assert params is not None, "fused adam needs params"
+        count = state.count + 1
+        out = jax.tree_util.tree_map(
+            lambda g, p, m, v: adam_update_leaf(
+                g, p, m, v, count, b1=b1, b2=b2, eps=eps, wd=weight_decay,
+                adam_w=adam_w_mode, interpret=interpret),
+            updates, params, state.mu, state.nu)
+        u = jax.tree_util.tree_map(lambda t: t[0], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+        mu = jax.tree_util.tree_map(lambda t: t[1], out,
+                                    is_leaf=lambda t: isinstance(t, tuple))
+        nu = jax.tree_util.tree_map(lambda t: t[2], out,
+                                    is_leaf=lambda t: isinstance(t, tuple))
+        return u, ScaleByFusedAdamState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def scale_by_fused_lion(b1: float = 0.9, b2: float = 0.99,
+                        weight_decay: float = 0.0,
+                        interpret: bool = False
+                        ) -> optax.GradientTransformation:
+    """Fused Lion (``csrc/lion`` equivalent)."""
+
+    def init_fn(params):
+        return ScaleByFusedLionState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params))
+
+    def update_fn(updates, state, params=None):
+        assert params is not None, "fused lion needs params"
+        count = state.count + 1
+        out = jax.tree_util.tree_map(
+            lambda g, p, m: lion_update_leaf(
+                g, p, m, count, b1=b1, b2=b2, wd=weight_decay,
+                interpret=interpret),
+            updates, params, state.mu)
+        u = jax.tree_util.tree_map(lambda t: t[0], out,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+        mu = jax.tree_util.tree_map(lambda t: t[1], out,
+                                    is_leaf=lambda t: isinstance(t, tuple))
+        return u, ScaleByFusedLionState(count=count, mu=mu)
+
+    return optax.GradientTransformation(init_fn, update_fn)

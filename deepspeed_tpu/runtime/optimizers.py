@@ -54,18 +54,31 @@ def build_optimizer(name: Optional[str], params: Dict[str, Any]
             "TPU; using the uncompressed base optimizer (same convergence, "
             "full-precision gradients on the wire).")
 
+    # fused Pallas kernels (csrc/adam, csrc/lion equivalents). Opt-in:
+    # "FusedAdam"/"FusedLion" type or fused=true. The kernel has no GSPMD
+    # partitioning rule, so under ZeRO-sharded state it must run inside
+    # shard_map (engine integration pending) — with plain jit it would
+    # force an all-gather of the shards. fused=false always opts out.
+    fused_default = name in ("fusedadam", "fusedlion")
+    fused = bool(p.get("fused", fused_default))
+
     if name in ("adam", "adamw", "fusedadam", "onebitadam", "zerooneadam"):
         # adam_w_mode (reference FusedAdam flag): decoupled decay unless
         # explicitly plain Adam with adam_w_mode=False
         adam_w_mode = bool(p.get("adam_w_mode", name != "adam"))
+        if fused:
+            from deepspeed_tpu.ops.fused_adam import scale_by_fused_adam
+
+            tx = scale_by_fused_adam(b1=betas[0], b2=betas[1], eps=eps,
+                                     weight_decay=wd,
+                                     adam_w_mode=adam_w_mode)
+            return tx, base_lr
         chain = [optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps)]
         if wd:
-            if adam_w_mode:
-                chain.append(optax.add_decayed_weights(wd))
-            else:
-                # L2-style: fold decay into grads before the moment update —
-                # approximated by decoupled here; document the divergence
-                chain.append(optax.add_decayed_weights(wd))
+            # decoupled decay; true L2 mode (decay folded into grads before
+            # the moment update) exists only in the fused kernel — documented
+            # divergence of the optax fallback
+            chain.append(optax.add_decayed_weights(wd))
         tx = optax.chain(*chain)
     elif name in ("lamb", "onebitlamb"):
         # optax.lamb includes lr; rebuild lr-less: adam scaling + trust ratio
@@ -74,8 +87,12 @@ def build_optimizer(name: Optional[str], params: Dict[str, Any]
             chain.append(optax.add_decayed_weights(wd))
         chain.append(optax.scale_by_trust_ratio())
         tx = optax.chain(*chain)
-    elif name == "lion":
+    elif name in ("lion", "fusedlion"):
         b1, b2 = tuple(p.get("betas", (0.9, 0.99)))
+        if fused:
+            from deepspeed_tpu.ops.fused_adam import scale_by_fused_lion
+
+            return scale_by_fused_lion(b1=b1, b2=b2, weight_decay=wd), base_lr
         chain = [optax.scale_by_lion(b1=b1, b2=b2)]
         if wd:
             chain.append(optax.add_decayed_weights(wd))

@@ -1,0 +1,72 @@
+"""Quantization kernel tests (reference: tests/unit/ops quantizer tests)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.quantization import (dequantize, dequantize_fp8,
+                                            quantize, quantize_fp8)
+
+
+@pytest.mark.parametrize("symmetric", [True, False])
+@pytest.mark.parametrize("num_bits", [8, 4])
+def test_quantize_roundtrip_error(symmetric, num_bits):
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1000,)), jnp.float32)
+    qt = quantize(x, num_bits=num_bits, group_size=256, symmetric=symmetric)
+    y = dequantize(qt)
+    assert y.shape == x.shape and y.dtype == x.dtype
+    # error bounded by half a quantization step per group
+    q_max = 2 ** (num_bits - 1) - 1
+    xg = np.pad(np.asarray(x), (0, qt.values.shape[0] * 256 - x.size)
+                ).reshape(-1, 256)
+    if symmetric:
+        step = np.abs(xg).max(axis=1) / q_max
+    else:
+        step = (xg.max(axis=1) - xg.min(axis=1)) / (2 * q_max)
+    err = np.abs(np.asarray(y) - np.asarray(x)).reshape(-1)
+    per_group_tol = np.repeat(step * 0.51 + 1e-6, 256)[:x.size]
+    assert (err <= per_group_tol).all()
+
+
+def test_quantize_outlier_isolation():
+    """A huge outlier only degrades its own group."""
+    rng = np.random.default_rng(1)
+    x = np.asarray(rng.normal(size=(512,)), np.float32)
+    x[5] = 1000.0
+    qt = quantize(jnp.asarray(x), group_size=128)
+    y = np.asarray(dequantize(qt))
+    # groups 1..3 unaffected by the outlier in group 0
+    assert np.abs(y[128:] - x[128:]).max() < 0.02
+
+
+def test_quantize_kernel_interpret_matches_fallback():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(64, 64)), jnp.float32)
+    qt_k = quantize(x, group_size=512, interpret=True)
+    qt_j = quantize(x, group_size=512, interpret=False)
+    np.testing.assert_array_equal(np.asarray(qt_k.values),
+                                  np.asarray(qt_j.values))
+    np.testing.assert_allclose(np.asarray(qt_k.scale),
+                               np.asarray(qt_j.scale), rtol=1e-6)
+    y_k = dequantize(qt_k, interpret=True)
+    y_j = dequantize(qt_j, interpret=False)
+    np.testing.assert_allclose(np.asarray(y_k), np.asarray(y_j), atol=1e-6)
+
+
+def test_quantize_preserves_dtype_and_shape():
+    x = jnp.ones((3, 5, 7), jnp.bfloat16)
+    qt = quantize(x, group_size=64)
+    y = dequantize(qt)
+    assert y.shape == (3, 5, 7)
+    assert y.dtype == jnp.bfloat16
+
+
+def test_fp8_roundtrip():
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(256,)) * 10, jnp.float32)
+    ft = quantize_fp8(x)
+    assert ft.values.dtype == jnp.float8_e4m3fn
+    y = dequantize_fp8(ft)
+    # e4m3 has ~2 decimal digits; relative error bounded
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=0.08,
+                               atol=0.1)
