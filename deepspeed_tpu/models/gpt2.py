@@ -41,6 +41,7 @@ class GPT2Config:
     remat: bool = True
     use_flash_attention: bool = False
     tie_word_embeddings: bool = True
+    tensor_parallel: bool = False  # Megatron-style TP param annotations
 
     @property
     def head_dim(self) -> int:
@@ -64,6 +65,21 @@ def get_config(preset: str, **overrides) -> GPT2Config:
     return GPT2Config(**kw)
 
 
+def _tp_dense_kwargs(cfg, kind: str):
+    """kernel/bias init kwargs for Megatron-style TP ('col'umn or 'row')."""
+    if not cfg.tensor_parallel:
+        return {}
+    from deepspeed_tpu.parallel.tensor_parallel import (
+        column_parallel_bias_init, column_parallel_init, row_parallel_init)
+
+    kinit = nn.initializers.lecun_normal()
+    binit = nn.initializers.zeros_init()
+    if kind == "col":
+        return {"kernel_init": column_parallel_init(kinit),
+                "bias_init": column_parallel_bias_init(binit)}
+    return {"kernel_init": row_parallel_init(kinit)}
+
+
 class CausalSelfAttention(nn.Module):
     config: GPT2Config
 
@@ -72,7 +88,7 @@ class CausalSelfAttention(nn.Module):
         cfg = self.config
         B, S, E = x.shape
         qkv = nn.Dense(3 * E, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                       name="c_attn")(x)
+                       name="c_attn", **_tp_dense_kwargs(cfg, "col"))(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):
@@ -97,7 +113,7 @@ class CausalSelfAttention(nn.Module):
             y = jnp.einsum("bhqk,bhkd->bhqd", att, v)
         y = y.transpose(0, 2, 1, 3).reshape(B, S, E)
         y = nn.Dense(E, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                     name="c_proj")(y)
+                     name="c_proj", **_tp_dense_kwargs(cfg, "row"))(y)
         return nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
 
 
@@ -108,10 +124,12 @@ class MLP(nn.Module):
     def __call__(self, x, deterministic: bool = True):
         cfg = self.config
         h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype, name="c_fc")(x)
+                     param_dtype=cfg.param_dtype, name="c_fc",
+                     **_tp_dense_kwargs(cfg, "col"))(x)
         h = jax.nn.gelu(h)
         h = nn.Dense(cfg.n_embd, dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype, name="c_proj")(h)
+                     param_dtype=cfg.param_dtype, name="c_proj",
+                     **_tp_dense_kwargs(cfg, "row"))(h)
         return nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
 
 
@@ -146,10 +164,20 @@ class GPT2Model(nn.Module):
     def __call__(self, input_ids, deterministic: bool = True):
         cfg = self.config
         B, S = input_ids.shape
+        embed_kwargs = {}
+        if cfg.tensor_parallel:
+            from deepspeed_tpu.parallel.tensor_parallel import \
+                embed_parallel_init
+
+            embed_kwargs = {"embedding_init": embed_parallel_init(
+                nn.initializers.variance_scaling(1.0, "fan_in", "normal",
+                                                 out_axis=0))}
         wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
-                       param_dtype=cfg.param_dtype, name="wte")
+                       param_dtype=cfg.param_dtype, name="wte",
+                       **embed_kwargs)
         wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype,
-                       param_dtype=cfg.param_dtype, name="wpe")
+                       param_dtype=cfg.param_dtype, name="wpe",
+                       **embed_kwargs)
         x = wte(input_ids) + wpe(jnp.arange(S)[None, :])
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 

@@ -173,6 +173,22 @@ class DeepSpeedEngine:
             lr_scheduler = LRScheduler(sched_fn)
         self.lr_scheduler = lr_scheduler
 
+        # -- tensor-parallel base specs (flax metadata or AutoTP) ---------
+        from deepspeed_tpu.parallel import tensor_parallel as tp_lib
+
+        self.base_specs = None
+        if tp_lib.has_partitioning(model_parameters):
+            self.base_specs = tp_lib.extract_partition_specs(
+                model_parameters, self.mesh.axis_names)
+            model_parameters = tp_lib.unbox_params(model_parameters)
+        elif topology.tensor_parallel_size > 1:
+            # AutoTP (module_inject/auto_tp.py equivalent): infer specs from
+            # parameter names when the model carries no annotations
+            self.base_specs = tp_lib.auto_tp_specs(
+                model_parameters, topology.tensor_parallel_size)
+            log_dist("AutoTP: inferred tensor-parallel sharding from "
+                     "parameter names", ranks=[0])
+
         # -- ZeRO sharding plan + state materialization -------------------
         zcfg = config.zero_optimization
         self.zero_stage = zcfg.stage
@@ -186,12 +202,15 @@ class DeepSpeedEngine:
             lambda x: np.asarray(x, dtype=master_dtype)
             if np.issubdtype(np.asarray(x).dtype, np.floating) else np.asarray(x),
             model_parameters)
-        param_shardings = self.plan.param_shardings(host_params)
+        param_shardings = self.plan.param_shardings(host_params,
+                                                    self.base_specs)
         params = jax.tree_util.tree_map(jax.device_put, host_params,
                                         param_shardings)
+        self._grad_spec_tree = self.plan.grad_specs(params, self.base_specs)
 
         opt_shapes = jax.eval_shape(self.tx.init, params)
-        opt_shardings = self.plan.opt_state_shardings(opt_shapes)
+        opt_shardings = self.plan.opt_state_shardings(opt_shapes,
+                                                      self.base_specs)
         opt_state = jax.jit(self.tx.init, out_shardings=opt_shardings)(params)
 
         scale_state = prec.init_loss_scale(config.fp16)
@@ -202,7 +221,7 @@ class DeepSpeedEngine:
             scale=jax.device_put(scale_state),
             rng=rng,
             skipped_steps=jnp.asarray(0, jnp.int32))
-        log_dist(self.plan.describe(params), ranks=[0])
+        log_dist(self.plan.describe(params, self.base_specs), ranks=[0])
 
         self._state_shardings = TrainState(
             step=self._repl(), params=param_shardings,
@@ -283,15 +302,12 @@ class DeepSpeedEngine:
         clip = self.config.gradient_clipping
         fp16 = self.config.fp16
         dynamic = self.dynamic_loss_scale
-        grad_specs = None  # filled per params below
+        grad_specs = self._grad_spec_tree
 
         def cast_params(p):
             return prec.cast_tree(p, compute_dtype)
 
         def train_step(state: TrainState, batch, lr):
-            nonlocal grad_specs
-            if grad_specs is None:
-                grad_specs = plan.grad_specs(state.params)
             rng, new_rng = jax.random.split(state.rng)
             scale = state.scale.loss_scale
 
@@ -316,8 +332,7 @@ class DeepSpeedEngine:
 
             zero_grads = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            zero_grads = constrain_tree(zero_grads, plan.grad_specs(state.params),
-                                        mesh)
+            zero_grads = constrain_tree(zero_grads, grad_specs, mesh)
             idxs = jnp.arange(gas)
             (grads, loss_sum), _ = jax.lax.scan(
                 micro_step, (zero_grads, jnp.asarray(0.0, jnp.float32)),
@@ -393,8 +408,8 @@ class DeepSpeedEngine:
         """Imperative-mode micro step: grads for ONE micro-batch."""
         loss_fn = self.loss_fn
         compute_dtype = self.compute_dtype
-        plan = self.plan
         mesh = self.mesh
+        grad_spec_tree = self._grad_spec_tree
 
         def grad_step(state: TrainState, batch, rng):
             scale = state.scale.loss_scale
@@ -406,7 +421,7 @@ class DeepSpeedEngine:
             loss_s, grads = jax.value_and_grad(scaled_loss)(state.params)
             grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32),
                                            grads)
-            grads = constrain_tree(grads, plan.grad_specs(state.params), mesh)
+            grads = constrain_tree(grads, grad_spec_tree, mesh)
             return loss_s / scale, grads
 
         return jax.jit(grad_step)

@@ -53,50 +53,84 @@ class ZeroShardingPlan:
 
     # -- per-leaf spec ----------------------------------------------------
 
-    def _shardable_dim(self, shape: Tuple[int, ...]) -> Optional[int]:
-        """Pick the dimension to shard: largest dim divisible by the
-        partition count (ties → earliest)."""
-        best = None
-        best_size = 0
-        for i, d in enumerate(shape):
-            if d % self.partitions == 0 and d > best_size:
-                best, best_size = i, d
-        return best
+    def leaf_spec(self, shape: Tuple[int, ...], sharded: bool,
+                  base: Optional[P] = None) -> P:
+        """PartitionSpec for one array of ``shape``.
 
-    def leaf_spec(self, shape: Tuple[int, ...], sharded: bool) -> P:
-        """PartitionSpec for one array of ``shape``."""
-        if not sharded or not self.axes or len(shape) == 0:
-            return P()
-        if int(np.prod(shape)) <= self.persistence_threshold:
+        ``base`` carries pre-existing model-parallel sharding (TP/expert axis
+        names from flax metadata or AutoTP); ZeRO composes with it by
+        claiming one of the still-unsharded dims.  TP sharding is always
+        preserved, even when ZeRO itself doesn't shard this tree.
+        """
+        ndim = len(shape)
+        spec = list(base) if base is not None else []
+        spec = spec[:ndim] + [None] * (ndim - len(spec))
+        has_base = any(s is not None for s in spec)
+
+        def out():
+            return P(*spec) if has_base else P()
+
+        if not sharded or not self.axes or ndim == 0:
+            return out()
+        if int(np.prod(shape)) <= self.persistence_threshold and not has_base:
             return P()  # persistent (replicated) small param
-        dim = self._shardable_dim(shape)
-        if dim is None:
-            return P()
-        spec = [None] * len(shape)
-        spec[dim] = self.axes if len(self.axes) > 1 else self.axes[0]
+        best, best_size = None, 0
+        for i, d in enumerate(shape):
+            if spec[i] is None and d % self.partitions == 0 and d > best_size:
+                best, best_size = i, d
+        if best is None:
+            return out()
+        spec[best] = self.axes if len(self.axes) > 1 else self.axes[0]
         return P(*spec)
 
     # -- tree-level specs -------------------------------------------------
 
-    def param_specs(self, params):
-        """Stage 3 shards params; stages 0-2 replicate them."""
-        sharded = self.stage >= 3
+    def _specs(self, params, sharded: bool, base_specs):
+        if base_specs is None:
+            return jax.tree_util.tree_map(
+                lambda x: self.leaf_spec(x.shape, sharded), params)
         return jax.tree_util.tree_map(
-            lambda x: self.leaf_spec(x.shape, sharded), params)
+            lambda x, b: self.leaf_spec(x.shape, sharded, b), params,
+            base_specs)
 
-    def grad_specs(self, params):
+    def param_specs(self, params, base_specs=None):
+        """Stage 3 shards params; stages 0-2 keep only the base (TP) spec."""
+        return self._specs(params, self.stage >= 3, base_specs)
+
+    def grad_specs(self, params, base_specs=None):
         """Stage >= 2 keeps grads in the sharded layout (reduce-scatter)."""
-        sharded = self.stage >= 2
-        return jax.tree_util.tree_map(
-            lambda x: self.leaf_spec(x.shape, sharded), params)
+        return self._specs(params, self.stage >= 2, base_specs)
 
-    def opt_state_specs(self, opt_state):
-        """Stage >= 1 shards optimizer moments. Rule: any leaf big enough to
-        shard follows the same layout as a param of its shape; scalars and
-        small leaves replicate."""
+    @staticmethod
+    def _path_key(kp) -> Tuple[str, ...]:
+        return tuple(str(k) for k in kp)
+
+    def opt_state_specs(self, opt_state, base_specs=None):
+        """Stage >= 1 shards optimizer moments.
+
+        Moment trees inside optax states mirror the param tree, so each opt
+        leaf inherits the base (TP) spec of the param whose tree path is a
+        suffix of the opt leaf's path; scalars and unmatched leaves fall back
+        to shape-based ZeRO sharding only.
+        """
         sharded = self.stage >= 1
-        return jax.tree_util.tree_map(
-            lambda x: self.leaf_spec(getattr(x, "shape", ()), sharded), opt_state)
+        suffix_map = {}
+        if base_specs is not None:
+            for kp, spec in jax.tree_util.tree_flatten_with_path(
+                    base_specs, is_leaf=lambda x: isinstance(x, P))[0]:
+                suffix_map[self._path_key(kp)] = spec
+
+        def spec_for(kp, leaf):
+            shape = tuple(getattr(leaf, "shape", ()))
+            base = None
+            keys = self._path_key(kp)
+            for i in range(len(keys)):
+                if keys[i:] in suffix_map:
+                    base = suffix_map[keys[i:]]
+                    break
+            return self.leaf_spec(shape, sharded, base)
+
+        return jax.tree_util.tree_map_with_path(spec_for, opt_state)
 
     # -- shardings --------------------------------------------------------
 
@@ -106,14 +140,14 @@ class ZeroShardingPlan:
             lambda s: NamedSharding(mesh, s), spec_tree,
             is_leaf=lambda x: isinstance(x, P))
 
-    def param_shardings(self, params):
-        return self._to_sharding(self.param_specs(params))
+    def param_shardings(self, params, base_specs=None):
+        return self._to_sharding(self.param_specs(params, base_specs))
 
-    def grad_shardings(self, params):
-        return self._to_sharding(self.grad_specs(params))
+    def grad_shardings(self, params, base_specs=None):
+        return self._to_sharding(self.grad_specs(params, base_specs))
 
-    def opt_state_shardings(self, opt_state):
-        return self._to_sharding(self.opt_state_specs(opt_state))
+    def opt_state_shardings(self, opt_state, base_specs=None):
+        return self._to_sharding(self.opt_state_specs(opt_state, base_specs))
 
     def batch_spec(self, batch_ndim: int, has_gas_dim: bool = False) -> P:
         """Batch arrays shard their batch dim over (data, expert): each
@@ -134,14 +168,14 @@ class ZeroShardingPlan:
         return NamedSharding(self.topology.mesh,
                              self.batch_spec(batch_ndim, has_gas_dim))
 
-    def describe(self, params) -> str:
+    def describe(self, params, base_specs=None) -> str:
         n_sharded = 0
         n_total = 0
         bytes_sharded = 0
         bytes_total = 0
         for leaf, spec in zip(jax.tree_util.tree_leaves(params),
                               jax.tree_util.tree_leaves(
-                                  self.param_specs(params),
+                                  self.param_specs(params, base_specs),
                                   is_leaf=lambda x: isinstance(x, P))):
             n_total += 1
             sz = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
