@@ -67,17 +67,9 @@ def get_config(preset: str, **overrides) -> GPT2Config:
 
 def _tp_dense_kwargs(cfg, kind: str):
     """kernel/bias init kwargs for Megatron-style TP ('col'umn or 'row')."""
-    if not cfg.tensor_parallel:
-        return {}
-    from deepspeed_tpu.parallel.tensor_parallel import (
-        column_parallel_bias_init, column_parallel_init, row_parallel_init)
+    from deepspeed_tpu.parallel.tensor_parallel import tp_dense_kwargs
 
-    kinit = nn.initializers.lecun_normal()
-    binit = nn.initializers.zeros_init()
-    if kind == "col":
-        return {"kernel_init": column_parallel_init(kinit),
-                "bias_init": column_parallel_bias_init(binit)}
-    return {"kernel_init": row_parallel_init(kinit)}
+    return tp_dense_kwargs(cfg.tensor_parallel, kind, with_bias=True)
 
 
 class CausalSelfAttention(nn.Module):
@@ -164,14 +156,9 @@ class GPT2Model(nn.Module):
     def __call__(self, input_ids, deterministic: bool = True):
         cfg = self.config
         B, S = input_ids.shape
-        embed_kwargs = {}
-        if cfg.tensor_parallel:
-            from deepspeed_tpu.parallel.tensor_parallel import \
-                embed_parallel_init
+        from deepspeed_tpu.parallel.tensor_parallel import tp_embed_kwargs
 
-            embed_kwargs = {"embedding_init": embed_parallel_init(
-                nn.initializers.variance_scaling(1.0, "fan_in", "normal",
-                                                 out_axis=0))}
+        embed_kwargs = tp_embed_kwargs(cfg.tensor_parallel)
         wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype, name="wte",
                        **embed_kwargs)

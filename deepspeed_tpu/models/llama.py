@@ -1,0 +1,273 @@
+"""Llama model family (flax, TPU-first).
+
+The framework's flagship dense LLM (BASELINE.md north star: Llama-2-7B
+ZeRO-3 at >=45% MFU on v5p-128).  Architecture follows the Llama-2/-3
+lineage the reference serves through its inference model registry
+(``deepspeed/inference/v2/model_implementations/llama_v2``) and its AutoTP
+policies (``module_inject/auto_tp.py``): RMSNorm, rotary position
+embeddings, grouped-query attention, SwiGLU MLP, untied LM head.
+
+TPU-first choices mirror models/gpt2.py: ``nn.scan`` over blocks (O(1)
+compile depth; one layer's params live at a time under ZeRO-3), ``nn.remat``
+activation checkpointing, bf16 matmuls on the MXU, the Pallas flash
+attention kernel, and Megatron TP via flax partitioning metadata
+(q/k/v/gate/up column-parallel, o/down row-parallel — the same
+classification the reference's AutoTP applies by name).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_position_embeddings: int = 4096
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32          # < heads => GQA
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+    use_flash_attention: bool = True
+    tensor_parallel: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+PRESETS = {
+    "llama2-7b": dict(hidden_size=4096, intermediate_size=11008,
+                      num_hidden_layers=32, num_attention_heads=32,
+                      num_key_value_heads=32),
+    "llama2-13b": dict(hidden_size=5120, intermediate_size=13824,
+                       num_hidden_layers=40, num_attention_heads=40,
+                       num_key_value_heads=40),
+    "llama2-70b": dict(hidden_size=8192, intermediate_size=28672,
+                       num_hidden_layers=80, num_attention_heads=64,
+                       num_key_value_heads=8),
+    "llama3-8b": dict(vocab_size=128256, hidden_size=4096,
+                      intermediate_size=14336, num_hidden_layers=32,
+                      num_attention_heads=32, num_key_value_heads=8,
+                      rope_theta=500000.0, max_position_embeddings=8192),
+    "tinyllama": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64),
+}
+
+
+def get_config(preset: str, **overrides) -> LlamaConfig:
+    kw = dict(PRESETS[preset])
+    kw.update(overrides)
+    return LlamaConfig(**kw)
+
+
+def _tp_kwargs(cfg: LlamaConfig, kind: str):
+    from deepspeed_tpu.parallel.tensor_parallel import tp_dense_kwargs
+
+    return tp_dense_kwargs(cfg.tensor_parallel, kind)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones_init(),
+                           (x.shape[-1],), jnp.float32)
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        y = xf * jax.lax.rsqrt(var + self.eps)
+        return (y * scale).astype(self.dtype)
+
+
+def rotary_embedding(x: jax.Array, positions: jax.Array,
+                     theta: float) -> jax.Array:
+    """Apply RoPE.  x: [B, H, S, D] (D even); positions: [S] or [B, S]."""
+    D = x.shape[-1]
+    inv_freq = 1.0 / (theta ** (np.arange(0, D, 2, dtype=np.float32) / D))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [...,S,D/2]
+    if angles.ndim == 2:             # [S, D/2] -> broadcast over B, H
+        angles = angles[None, None]
+    else:                            # [B, S, D/2] -> broadcast over H
+        angles = angles[:, None]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic: bool = True):
+        cfg = self.config
+        B, S, E = x.shape
+        H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                      cfg.head_dim)
+        dense = dict(use_bias=False, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+        q = nn.Dense(H * Dh, name="q_proj", **dense,
+                     **_tp_kwargs(cfg, "col"))(x)
+        k = nn.Dense(Hkv * Dh, name="k_proj", **dense,
+                     **_tp_kwargs(cfg, "col"))(x)
+        v = nn.Dense(Hkv * Dh, name="v_proj", **dense,
+                     **_tp_kwargs(cfg, "col"))(x)
+
+        q = q.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
+        q = rotary_embedding(q, positions, cfg.rope_theta)
+        k = rotary_embedding(k, positions, cfg.rope_theta)
+
+        if cfg.use_flash_attention:
+            from deepspeed_tpu.ops.flash_attention import flash_attention
+
+            y = flash_attention(q, k, v, causal=True)
+        else:
+            from deepspeed_tpu.ops.flash_attention import mha_reference
+
+            y = mha_reference(q, k, v, causal=True)
+        y = y.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
+        return nn.Dense(E, name="o_proj", **dense,
+                        **_tp_kwargs(cfg, "row"))(y)
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = dict(use_bias=False, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+        gate = nn.Dense(cfg.intermediate_size, name="gate_proj", **dense,
+                        **_tp_kwargs(cfg, "col"))(x)
+        up = nn.Dense(cfg.intermediate_size, name="up_proj", **dense,
+                      **_tp_kwargs(cfg, "col"))(x)
+        return nn.Dense(cfg.hidden_size, name="down_proj", **dense,
+                        **_tp_kwargs(cfg, "row"))(nn.silu(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic: bool = True):
+        cfg = self.config
+        h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x)
+        x = x + LlamaAttention(cfg, name="self_attn")(h, positions,
+                                                      deterministic)
+        h = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                    name="post_attention_layernorm")(x)
+        return x + LlamaMLP(cfg, name="mlp")(h)
+
+
+class ScanLlamaBlock(nn.Module):
+    config: LlamaConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, positions = carry
+        x = LlamaBlock(self.config, name="block")(x, positions,
+                                                  self.deterministic)
+        return (x, positions), None
+
+
+class LlamaModel(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, deterministic: bool = True):
+        cfg = self.config
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.arange(S)
+        from deepspeed_tpu.parallel.tensor_parallel import tp_embed_kwargs
+
+        embed_kwargs = tp_embed_kwargs(cfg.tensor_parallel)
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="embed_tokens",
+                     **embed_kwargs)(input_ids)
+
+        if cfg.scan_layers:
+            block_cls = ScanLlamaBlock
+            if cfg.remat:
+                block_cls = nn.remat(ScanLlamaBlock, prevent_cse=False)
+            (x, _), _ = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, deterministic, name="layers")((x, positions), None)
+        else:
+            block_cls = LlamaBlock
+            if cfg.remat:
+                block_cls = nn.remat(LlamaBlock, prevent_cse=False)
+            for i in range(cfg.num_hidden_layers):
+                x = block_cls(cfg, name=f"layers_{i}")(x, positions,
+                                                       deterministic)
+        return RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
+
+
+class LlamaForCausalLM(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, deterministic: bool = True):
+        cfg = self.config
+        x = LlamaModel(cfg, name="model")(input_ids, positions, deterministic)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="lm_head",
+                        **_tp_kwargs(cfg, "col"))(x)
+
+
+class LlamaLMLoss(nn.Module):
+    """Loss-returning wrapper matching the engine's flax-module contract:
+    ``module(batch) -> scalar`` next-token cross entropy in fp32."""
+
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, batch):
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        logits = LlamaForCausalLM(self.config, name="lm")(input_ids)
+        logits = logits[:, :-1].astype(jnp.float32)
+        targets = input_ids[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(p.shape))
+               for p in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_token(cfg: LlamaConfig, seq_len: Optional[int] = None) -> float:
+    """Fwd+bwd FLOPs/token (PaLM convention), for MFU."""
+    E, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
+    Dh, H, Hkv = cfg.head_dim, cfg.num_attention_heads, cfg.num_key_value_heads
+    per_layer = (E * H * Dh + 2 * E * Hkv * Dh + H * Dh * E  # qkvo
+                 + 3 * E * I)                                # gate/up/down
+    n = L * per_layer + cfg.vocab_size * E                   # + lm head
+    s = seq_len or cfg.max_position_embeddings
+    attn = 12 * L * H * Dh * s
+    return 6.0 * n + attn

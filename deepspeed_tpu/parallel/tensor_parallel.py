@@ -67,6 +67,35 @@ def vocab_parallel_init(init_fn: Callable) -> Callable:
     return nn.with_partitioning(init_fn, (TENSOR_AXIS, None))
 
 
+def tp_dense_kwargs(enabled: bool, kind: str,
+                    with_bias: bool = False) -> Dict[str, Any]:
+    """nn.Dense init kwargs for a Megatron-TP layer ('col' or 'row').
+    Shared by the model zoo so the annotation policy lives in one place."""
+    if not enabled:
+        return {}
+    kinit = nn.initializers.lecun_normal()
+    if kind == "col":
+        kw: Dict[str, Any] = {"kernel_init": column_parallel_init(kinit)}
+        if with_bias:
+            kw["bias_init"] = column_parallel_bias_init(
+                nn.initializers.zeros_init())
+        return kw
+    assert kind == "row", kind
+    return {"kernel_init": row_parallel_init(kinit)}
+    # row-parallel bias replicates (added after the all-reduce)
+
+
+def tp_embed_kwargs(enabled: bool) -> Dict[str, Any]:
+    """nn.Embed init kwargs sharding the embedding dim; matches flax's
+    default embed initializer exactly so TP and non-TP models start from
+    identical weights."""
+    if not enabled:
+        return {}
+    return {"embedding_init": embed_parallel_init(
+        nn.initializers.variance_scaling(1.0, "fan_in", "normal",
+                                         out_axis=0))}
+
+
 # ---------------------------------------------------------------------------
 # Param-tree metadata extraction (engine-side)
 # ---------------------------------------------------------------------------
@@ -139,38 +168,37 @@ def auto_tp_specs(params, tp_size: int,
     specs: Dict[str, P] = {}
     for kp, leaf in flat:
         path = _path_str(kp).lower()
+        leaf_name = path.rsplit("/", 1)[-1]
         shape = np.shape(leaf)
-        spec = P()
-        if len(shape) >= 2:
-            def _try(dim_from_end_first: Tuple[int, ...]) -> Optional[P]:
-                for d in dim_from_end_first:
-                    if shape[d] % tp_size == 0:
-                        s = [None] * len(shape)
-                        s[d] = mesh_axis
-                        return P(*s)
-                return None
+        is_row = any(re.search(p, path) for p in _ROW_PATTERNS)
+        is_col = any(re.search(p, path) for p in _COL_PATTERNS)
+        is_embed = any(re.search(p, path) for p in _EMBED_PATTERNS)
 
-            if any(re.search(p, path) for p in _ROW_PATTERNS):
-                got = _try((-2,))
-            elif any(re.search(p, path) for p in _COL_PATTERNS):
-                got = _try((-1,))
-            elif any(re.search(p, path) for p in _EMBED_PATTERNS):
-                got = _try((-1,))
-            else:
-                got = None
-            if got is None and any(
-                    re.search(p, path)
-                    for pats in (_ROW_PATTERNS, _COL_PATTERNS) for p in pats):
-                logger.warning(
-                    f"auto_tp: {path} {shape} not divisible by tp={tp_size}; "
-                    "replicating")
-            spec = got or P()
-        elif len(shape) == 1 and any(re.search(p, path)
-                                     for p in _COL_PATTERNS):
-            # bias of a column-parallel layer follows the sharded output
-            if shape[0] % tp_size == 0:
-                spec = P(mesh_axis)
-        specs[_path_str(kp)] = spec
+        def _shard(dim: int) -> Optional[P]:
+            if shape[dim] % tp_size == 0:
+                s = [None] * len(shape)
+                s[dim] = mesh_axis
+                return P(*s)
+            logger.warning(
+                f"auto_tp: {path} {shape} dim {dim} not divisible by "
+                f"tp={tp_size}; replicating")
+            return None
+
+        got = None
+        if leaf_name == "kernel" and len(shape) >= 2:
+            # kernels are (..., in, out) — a leading scan-layer dim is fine
+            if is_row:
+                got = _shard(-2)
+            elif is_col:
+                got = _shard(-1)
+        elif leaf_name == "bias" and shape:
+            # column-parallel biases follow the sharded output; row-parallel
+            # biases are added after the all-reduce and must replicate
+            if is_col:
+                got = _shard(-1)
+        elif leaf_name == "embedding" and len(shape) >= 2 and is_embed:
+            got = _shard(-1)
+        specs[_path_str(kp)] = got or P()
 
     return jax.tree_util.tree_map_with_path(
         lambda kp, _: specs[_path_str(kp)], params)

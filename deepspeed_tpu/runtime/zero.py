@@ -74,13 +74,22 @@ class ZeroShardingPlan:
             return out()
         if int(np.prod(shape)) <= self.persistence_threshold and not has_base:
             return P()  # persistent (replicated) small param
+        # ZeRO may only claim axes the base spec doesn't already use
+        base_axes = set()
+        for s in spec:
+            for ax in (s,) if isinstance(s, str) else (s or ()):
+                base_axes.add(ax)
+        axes = tuple(a for a in self.axes if a not in base_axes)
+        if not axes:
+            return out()
+        partitions = int(np.prod([self.topology.axis_size(a) for a in axes]))
         best, best_size = None, 0
         for i, d in enumerate(shape):
-            if spec[i] is None and d % self.partitions == 0 and d > best_size:
+            if spec[i] is None and d % partitions == 0 and d > best_size:
                 best, best_size = i, d
         if best is None:
             return out()
-        spec[best] = self.axes if len(self.axes) > 1 else self.axes[0]
+        spec[best] = axes if len(axes) > 1 else axes[0]
         return P(*spec)
 
     # -- tree-level specs -------------------------------------------------
@@ -197,5 +206,3 @@ def constrain_tree(tree, spec_tree, mesh: Mesh):
         is_leaf=lambda x: isinstance(x, P))
 
 
-def log_plan(plan: ZeroShardingPlan, params) -> None:
-    log_dist(plan.describe(params), ranks=[0])

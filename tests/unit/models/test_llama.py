@@ -1,0 +1,149 @@
+"""Llama model tests (fixture philosophy of tests/unit/simple_model.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                        LlamaLMLoss, get_config,
+                                        rotary_embedding)
+
+
+def _cfg(**kw):
+    base = dict(dtype=jnp.float32, param_dtype=jnp.float32, remat=False,
+                use_flash_attention=False)
+    base.update(kw)
+    return get_config("tinyllama", **base)
+
+
+def _batch(rng, B=4, S=32):
+    return {"input_ids": rng.integers(0, 256, size=(B, S), dtype=np.int32)}
+
+
+def test_rope_properties():
+    """RoPE preserves norms and is relative: q.k depends on distance only."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1, 2, 8, 16)), jnp.float32)
+    pos = jnp.arange(8)
+    y = rotary_embedding(x, pos, 10000.0)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(y), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+    # relative property: <R_m q, R_n k> == <R_{m+d} q, R_{n+d} k>
+    q = jnp.asarray(rng.normal(size=(1, 1, 1, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, 1, 1, 16)), jnp.float32)
+
+    def dot_at(m, n):
+        qm = rotary_embedding(q, jnp.asarray([m]), 10000.0)
+        kn = rotary_embedding(k, jnp.asarray([n]), 10000.0)
+        return float(jnp.sum(qm * kn))
+
+    assert abs(dot_at(3, 5) - dot_at(10, 12)) < 1e-4
+
+
+def test_forward_shapes_and_loss():
+    cfg = _cfg()
+    model = LlamaLMLoss(cfg)
+    rng = np.random.default_rng(1)
+    batch = _batch(rng)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    loss = model.apply(params, batch)
+    assert np.isfinite(float(loss))
+    # random init ≈ uniform over vocab
+    assert abs(float(loss) - np.log(cfg.vocab_size)) < 1.0
+
+    lm = LlamaForCausalLM(cfg)
+    logits = lm.apply({"params": params["params"]["lm"]},
+                      batch["input_ids"])
+    assert logits.shape == (4, 32, cfg.vocab_size)
+
+
+def test_gqa_head_counts():
+    cfg = _cfg()
+    assert cfg.num_key_value_heads == 2 and cfg.num_attention_heads == 4
+    model = LlamaLMLoss(cfg)
+    rng = np.random.default_rng(2)
+    batch = _batch(rng, B=2, S=16)
+    params = model.init(jax.random.PRNGKey(0), batch)
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    kv = [l for kp, l in flat if "k_proj" in str(kp) and "kernel" in str(kp)]
+    q = [l for kp, l in flat if "q_proj" in str(kp) and "kernel" in str(kp)]
+    assert kv[0].shape[-1] == q[0].shape[-1] // 2  # Hkv = H/2
+
+
+def test_flash_matches_naive_attention():
+    rng = np.random.default_rng(3)
+    batch = _batch(rng, B=2, S=32)
+    cfg_naive = _cfg(use_flash_attention=False)
+    cfg_flash = _cfg(use_flash_attention=True)
+    m_naive, m_flash = LlamaLMLoss(cfg_naive), LlamaLMLoss(cfg_flash)
+    params = m_naive.init(jax.random.PRNGKey(0), batch)
+    l_naive = float(m_naive.apply(params, batch))
+    l_flash = float(m_flash.apply(params, batch))
+    assert abs(l_naive - l_flash) < 1e-4
+
+
+def test_scan_matches_unrolled():
+    rng = np.random.default_rng(4)
+    batch = _batch(rng, B=2, S=16)
+    cfg_s = _cfg(scan_layers=True)
+    cfg_u = _cfg(scan_layers=False)
+    m_s, m_u = LlamaLMLoss(cfg_s), LlamaLMLoss(cfg_u)
+    p_s = m_s.init(jax.random.PRNGKey(0), batch)
+    # map scanned params [L, ...] onto unrolled layer names
+    p_u = m_u.init(jax.random.PRNGKey(0), batch)
+
+    def stack_unrolled(pu):
+        lm = pu["params"]["lm"]["model"]
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs),
+            *[lm[f"layers_{i}"] for i in range(2)])
+        return stacked
+
+    scanned = p_s["params"]["lm"]["model"]["layers"]["block"]
+    import flax.linen as nn
+    stacked = stack_unrolled(p_u)
+    chex_tree_s = jax.tree_util.tree_leaves(scanned)
+    chex_tree_u = jax.tree_util.tree_leaves(stacked)
+    assert all(a.shape == b.shape for a, b in zip(chex_tree_s, chex_tree_u))
+    # copy unrolled weights into the scanned layout and compare losses
+    p_s2 = jax.tree_util.tree_map(lambda x: x, p_s)  # shallow copy ok
+    p_s2["params"]["lm"]["model"]["layers"]["block"] = stacked
+    p_s2["params"]["lm"]["model"]["embed_tokens"] = \
+        p_u["params"]["lm"]["model"]["embed_tokens"]
+    p_s2["params"]["lm"]["model"]["norm"] = p_u["params"]["lm"]["model"]["norm"]
+    p_s2["params"]["lm"]["lm_head"] = p_u["params"]["lm"]["lm_head"]
+    np.testing.assert_allclose(float(m_s.apply(p_s2, batch)),
+                               float(m_u.apply(p_u, batch)), rtol=1e-5)
+
+
+def test_llama_trains_with_zero3_tp(devices):
+    topo = dist.initialize_mesh(dp=4, tp=2)
+    cfg = _cfg(tensor_parallel=True)
+    ds_config = {
+        "train_batch_size": 8,
+        "zero_optimization": {"stage": 3,
+                              "stage3_param_persistence_threshold": 64},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3,
+                                                  "fused": False}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10000,
+    }
+    rng = np.random.default_rng(5)
+    batch = _batch(rng, B=8, S=32)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=LlamaLMLoss(cfg), config=ds_config, topology=topo,
+        example_batch=batch, rng=jax.random.PRNGKey(0))
+    losses = [float(jax.device_get(engine.train_batch(batch=batch)))
+              for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses
+
+
+def test_presets_resolve():
+    for name in ("llama2-7b", "llama2-70b", "llama3-8b"):
+        cfg = get_config(name)
+        assert cfg.hidden_size % cfg.num_attention_heads == 0
+        assert cfg.num_attention_heads % cfg.num_key_value_heads == 0

@@ -159,6 +159,40 @@ def test_auto_tp_specs_infer_llama_style_names(devices):
     assert specs["model"]["embed_tokens"]["embedding"] == P(None, "tensor")
 
 
+def test_zero_skips_axes_claimed_by_base_spec(devices):
+    """A base spec already on a ZeRO axis (e.g. expert) must not be claimed
+    again — regression test for DuplicateSpecError at engine init."""
+    from jax.sharding import NamedSharding
+    from deepspeed_tpu.parallel.topology import MeshTopology
+    from deepspeed_tpu.runtime.zero import ZeroShardingPlan
+
+    topo = MeshTopology(dp=2, ep=2, tp=2)
+    plan = ZeroShardingPlan(topo, stage=3, persistence_threshold=0)
+    spec = plan.leaf_spec((256, 128), sharded=True, base=P("expert", None))
+    # must be a valid sharding (no duplicate axes)
+    NamedSharding(topo.mesh, spec)
+    axes = [ax for s in spec for ax in ((s,) if isinstance(s, str)
+                                        else (s or ()))]
+    assert len(axes) == len(set(axes))
+    assert "expert" in axes  # base preserved
+    assert "data" in axes    # zero claimed the remaining free axis
+
+
+def test_auto_tp_row_bias_replicates(devices):
+    """Scanned row-parallel biases (L, E) replicate; scanned col biases
+    shard on the output dim."""
+    params = {"h": {"attn": {"c_proj": {"kernel": np.zeros((2, 64, 64)),
+                                        "bias": np.zeros((2, 64))},
+                             "c_attn": {"kernel": np.zeros((2, 64, 192)),
+                                        "bias": np.zeros((2, 192))}}}}
+    specs = auto_tp_specs(params, tp_size=4)
+    s = specs["h"]["attn"]
+    assert s["c_proj"]["kernel"] == P(None, "tensor", None)
+    assert s["c_proj"]["bias"] == P()              # after the all-reduce
+    assert s["c_attn"]["kernel"] == P(None, None, "tensor")
+    assert s["c_attn"]["bias"] == P(None, "tensor")
+
+
 def test_auto_tp_engine_end_to_end(devices):
     """Un-annotated model + tp axis in the mesh → AutoTP shards by name."""
     topo = dist.initialize_mesh(dp=2, tp=4)
