@@ -42,6 +42,13 @@ class LlamaConfig:
     remat: bool = True
     use_flash_attention: bool = True
     tensor_parallel: bool = False
+    # sequence parallelism: "none", "ulysses" (all-to-all), "ring" (ppermute)
+    sequence_parallel: str = "none"
+
+    def __post_init__(self):
+        assert self.sequence_parallel in ("none", "ulysses", "ring"), (
+            f"sequence_parallel={self.sequence_parallel!r}: expected 'none', "
+            "'ulysses' or 'ring'")
 
     @property
     def head_dim(self) -> int:
@@ -135,7 +142,15 @@ class LlamaAttention(nn.Module):
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
 
-        if cfg.use_flash_attention:
+        if cfg.sequence_parallel == "ulysses":
+            from deepspeed_tpu.sequence import ulysses_attention
+
+            y = ulysses_attention(q, k, v, causal=True)
+        elif cfg.sequence_parallel == "ring":
+            from deepspeed_tpu.sequence import ring_attention
+
+            y = ring_attention(q, k, v, causal=True)
+        elif cfg.use_flash_attention:
             from deepspeed_tpu.ops.flash_attention import flash_attention
 
             y = flash_attention(q, k, v, causal=True)

@@ -185,19 +185,25 @@ def auto_tp_specs(params, tp_size: int,
             return None
 
         got = None
-        if leaf_name == "kernel" and len(shape) >= 2:
-            # kernels are (..., in, out) — a leading scan-layer dim is fine
+        if leaf_name in ("kernel", "weight", "w") and len(shape) >= 2:
+            # kernels are (..., in, out) — a leading scan-layer dim is fine.
+            # "weight"/"w" cover trees converted from torch state dicts.
             if is_row:
                 got = _shard(-2)
             elif is_col:
                 got = _shard(-1)
-        elif leaf_name == "bias" and shape:
+        elif leaf_name in ("bias", "b") and shape:
             # column-parallel biases follow the sharded output; row-parallel
             # biases are added after the all-reduce and must replicate
             if is_col:
                 got = _shard(-1)
-        elif leaf_name == "embedding" and len(shape) >= 2 and is_embed:
+        elif leaf_name in ("embedding", "weight") and len(shape) >= 2 \
+                and is_embed:
             got = _shard(-1)
+        elif (is_row or is_col) and len(shape) >= 2:
+            logger.warning(
+                f"auto_tp: {path} {shape} matches a TP pattern but leaf name "
+                f"{leaf_name!r} is not recognised; replicating")
         specs[_path_str(kp)] = got or P()
 
     return jax.tree_util.tree_map_with_path(

@@ -76,10 +76,13 @@ def initialize(args=None,
     else:
         dist.set_topology(topology)
 
+    # batch accounting: samples split over data x expert ranks only — seq
+    # ranks hold the same samples and split the sequence dim (Ulysses input
+    # contract), so sp does NOT divide the batch
     ds_config = load_config(
         config if config is not None else config_params,
         dp_world_size=topology.data_parallel_size *
-        topology.expert_parallel_size * topology.sequence_parallel_size)
+        topology.expert_parallel_size)
 
     engine = DeepSpeedEngine(model=model,
                              model_parameters=model_parameters,
@@ -144,7 +147,9 @@ class DeepSpeedEngine:
                 "flax-module path needs example_batch for init"
             init_rng, rng = jax.random.split(rng)
             if model_parameters is None:
-                model_parameters = model.init(
+                # jit the init: partial-manual shard_map (Ulysses/ring SP)
+                # only traces under jit, and XLA frees intermediates eagerly
+                model_parameters = jax.jit(model.init)(
                     {"params": init_rng, "dropout": init_rng}, example_batch)
 
             def loss_fn(params, batch, step_rng):

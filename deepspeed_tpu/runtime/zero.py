@@ -159,16 +159,19 @@ class ZeroShardingPlan:
         return self._to_sharding(self.opt_state_specs(opt_state, base_specs))
 
     def batch_spec(self, batch_ndim: int, has_gas_dim: bool = False) -> P:
-        """Batch arrays shard their batch dim over (data, expert): each
-        data-parallel (and expert-parallel) member sees different samples.
-        The ``seq`` axis shards the sequence dim when sequence parallelism is
-        active (handled by the sequence engine; here seq stays on batch)."""
+        """Batch arrays shard their batch dim over (data, expert); with
+        sequence parallelism active the dim after batch (the sequence dim of
+        [B, S] token arrays) shards over ``seq`` — inputs then arrive
+        seq-sharded exactly like the reference's Ulysses input contract
+        ([s/P, b, h], ``sequence/layer.py``)."""
         axes = tuple(a for a in ("data", "expert")
                      if self.topology.axis_size(a) > 1)
         specs = []
         if has_gas_dim:
             specs.append(None)  # scan (GAS) dim never sharded
         specs.append(axes if len(axes) > 1 else (axes[0] if axes else None))
+        if len(specs) < batch_ndim and self.topology.axis_size("seq") > 1:
+            specs.append("seq")
         while len(specs) < batch_ndim:
             specs.append(None)
         return P(*specs)

@@ -1,0 +1,8 @@
+from deepspeed_tpu.sequence.layer import (DistributedAttention,
+                                          ulysses_attention)
+from deepspeed_tpu.sequence.ring import ring_attention
+from deepspeed_tpu.sequence.cross_entropy import \
+    vocab_sequence_parallel_cross_entropy
+
+__all__ = ["DistributedAttention", "ulysses_attention", "ring_attention",
+           "vocab_sequence_parallel_cross_entropy"]

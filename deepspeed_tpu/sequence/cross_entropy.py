@@ -1,0 +1,71 @@
+"""Sequence/vocab-parallel cross entropy.
+
+Reference: ``deepspeed/sequence/cross_entropy.py``
+(``vocab_sequence_parallel_cross_entropy``) — cross entropy where the logits
+are sharded over both the sequence axis (Ulysses) and the vocab axis
+(Megatron TP).  On TPU the fused, sharding-aware form is a shard_map over
+both axes: each device reduces its local vocab shard (max + masked gather +
+sum-exp), psums the three partials over ``tensor``, computes local token
+losses, and the mean over ``seq``/batch is a final psum — no device ever
+materializes the full [B, S, V] log-softmax.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import SEQ_AXIS, TENSOR_AXIS
+
+
+def vocab_sequence_parallel_cross_entropy(
+        logits: jax.Array, targets: jax.Array,
+        mesh: Optional[Mesh] = None,
+        seq_axis: str = SEQ_AXIS,
+        vocab_axis: str = TENSOR_AXIS) -> jax.Array:
+    """Mean next-token CE.  logits: [B, S, V] sharded (seq on S, optionally
+    tensor on V); targets: [B, S] int sharded on S.  Returns a replicated
+    scalar."""
+    from deepspeed_tpu.sequence.layer import resolve_mesh
+
+    mesh = resolve_mesh(mesh, seq_axis)
+    tp = mesh.shape[vocab_axis]
+    sp = mesh.shape[seq_axis]
+
+    def body(logits, targets):
+        lg = logits.astype(jnp.float32)   # [Bl, Sl, Vl]
+        v_local = lg.shape[-1]
+        v_start = jax.lax.axis_index(vocab_axis) * v_local if tp > 1 else 0
+
+        local_max = jnp.max(lg, axis=-1)
+        gmax = jax.lax.pmax(local_max, vocab_axis) if tp > 1 else local_max
+        e = jnp.exp(lg - gmax[..., None])
+        denom = jnp.sum(e, axis=-1)
+        if tp > 1:
+            denom = jax.lax.psum(denom, vocab_axis)
+
+        # logit of the target id, if it falls in our vocab shard
+        local_ids = targets - v_start
+        in_shard = (local_ids >= 0) & (local_ids < v_local)
+        picked = jnp.take_along_axis(
+            lg, jnp.clip(local_ids, 0, v_local - 1)[..., None], axis=-1
+        )[..., 0]
+        picked = jnp.where(in_shard, picked, 0.0)
+        if tp > 1:
+            picked = jax.lax.psum(picked, vocab_axis)
+
+        tok_loss = jnp.log(denom) + gmax - picked
+        loss = jnp.mean(tok_loss)
+        if sp > 1:
+            loss = jax.lax.pmean(loss, seq_axis)
+        return loss
+
+    in_specs = (P(None, seq_axis, vocab_axis if tp > 1 else None),
+                P(None, seq_axis))
+    # both axes stay manual even at size 1 — in_specs may only name manual
+    # axes, and size-1 manual axes are legal
+    return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=P(),
+                         axis_names={seq_axis, vocab_axis},
+                         check_vma=False)(logits, targets)

@@ -1,0 +1,114 @@
+"""Ulysses sequence parallelism.
+
+TPU-native re-design of DeepSpeed-Ulysses (``deepspeed/sequence/layer.py``:
+``_SeqAllToAll:257``, ``DistributedAttention:311``, ``single_all_to_all:221``).
+The mechanism is identical — all-to-all that scatters heads and gathers
+sequence before attention, and the inverse after — but expressed as
+``jax.shard_map`` manual over the ``seq`` mesh axis with
+``jax.lax.all_to_all`` riding ICI, while every other axis (data/tensor/...)
+stays under automatic GSPMD partitioning (``axis_names={"seq"}``).
+
+Inside the shard_map body each device holds the full sequence for its head
+group, so the local attention can be the Pallas flash kernel (Pallas composes
+with shard_map, not with GSPMD auto-sharding).
+
+GQA: when kv heads don't divide the seq group, kv is expanded to the query
+head count first (the reference handles this case with
+``uneven_heads_all2all:111``; head replication is the simpler TPU-friendly
+equivalent — same math, denser layout).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import SEQ_AXIS
+from deepspeed_tpu.ops.flash_attention import flash_attention
+
+
+def _default_attn(q, k, v, causal):
+    return flash_attention(q, k, v, causal=causal)
+
+
+def resolve_mesh(mesh: Optional[Mesh], axis: str) -> Mesh:
+    """Mesh to shard_map over: explicit arg > ambient jax mesh context >
+    the process-global topology (deepspeed_tpu.comm)."""
+    if mesh is not None:
+        return mesh
+    am = jax.sharding.get_abstract_mesh()
+    if am is not None and axis in (am.axis_names or ()):
+        return am
+    import deepspeed_tpu.comm as dist
+
+    return dist.get_topology().mesh
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      mesh: Optional[Mesh] = None,
+                      axis: str = SEQ_AXIS,
+                      causal: bool = True,
+                      attn_fn: Optional[Callable] = None) -> jax.Array:
+    """Sequence-parallel attention.  q: [B, H, S, D], k/v: [B, Hkv, S, D]
+    global shapes with S sharded over ``axis``; returns [B, H, S, D] sharded
+    the same way.
+
+    all-to-all #1: [B, H, S/sp, D] -> [B, H/sp, S, D]  (scatter heads)
+    local attention over the full sequence
+    all-to-all #2: inverse                             (gather heads)
+    """
+    if attn_fn is None:
+        attn_fn = _default_attn
+    mesh = resolve_mesh(mesh, axis)
+    sp = mesh.shape[axis]
+    if sp == 1:
+        return attn_fn(q, k, v, causal)
+
+    H, Hkv = q.shape[1], k.shape[1]
+    assert H % sp == 0, f"q heads {H} must divide seq-parallel size {sp}"
+    if Hkv % sp != 0:
+        groups = H // Hkv
+        k = jnp.repeat(k, groups, axis=1)
+        v = jnp.repeat(v, groups, axis=1)
+
+    def body(q, k, v):
+        # local: [B, H, S/sp, D] -> heads scattered, seq gathered
+        def scatter_heads(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+
+        def gather_heads(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+
+        ql, kl, vl = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+        out = attn_fn(ql, kl, vl, causal)
+        return gather_heads(out)
+
+    spec = P(None, None, axis, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={axis},
+                         check_vma=False)(q, k, v)
+
+
+class DistributedAttention:
+    """Reference ``DistributedAttention`` (``sequence/layer.py:311``) shape:
+    a callable wrapping any local attention with the Ulysses all-to-alls.
+
+    ``scatter_idx``/``gather_idx`` are fixed to the head/seq dims of the
+    [B, H, S, D] layout (the reference's defaults express the same choice for
+    its [s, b, h] layout).
+    """
+
+    def __init__(self, local_attention: Optional[Callable] = None,
+                 mesh: Optional[Mesh] = None, axis: str = SEQ_AXIS):
+        self.local_attention = local_attention
+        self.mesh = mesh
+        self.axis = axis
+
+    def __call__(self, query, key, value, causal: bool = True, **kwargs):
+        return ulysses_attention(query, key, value, mesh=self.mesh,
+                                 axis=self.axis, causal=causal,
+                                 attn_fn=self.local_attention)

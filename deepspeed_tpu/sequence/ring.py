@@ -1,0 +1,110 @@
+"""Ring attention over the ``seq`` mesh axis.
+
+The reference has no ring attention (SURVEY §2.3: long-sequence scaling is
+Ulysses + FPDT chunking); this is the planned TPU-native extension — ring
+attention maps directly onto ICI ``ppermute``: each device keeps its query
+block resident and the K/V blocks rotate around the ring, one hop per step,
+with online-softmax accumulation (blockwise attention a la
+Liu et al., Ring Attention, 2023).
+
+Compared to Ulysses (2 all-to-alls, needs heads % sp == 0), the ring scales
+to any head count and overlaps the K/V hop with the block computation
+(XLA schedules the collective-permute concurrently with the matmuls), at the
+cost of sp sequential steps.
+
+Differentiable by construction: the body is jnp + ``ppermute`` inside
+``lax.scan`` (each step rematerialized via ``jax.checkpoint`` to keep
+activation memory at one block).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import SEQ_AXIS
+from deepspeed_tpu.ops.flash_attention import DEFAULT_MASK_VALUE
+
+
+def _block_attn_update(carry, q, k, v, mask, sm_scale):
+    """One online-softmax accumulation step (GQA-grouped layout).
+    q: [B, Hkv, G, Sq, D]; k/v: [B, Hkv, Sk, D]; mask: [Sq, Sk] bool.
+    K/V stay at Hkv heads — the whole point of GQA is that the ring hops
+    and the resident blocks carry only Hkv*D bytes per position."""
+    acc, m, l = carry
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    s = jnp.where(mask[None, None, None], s, DEFAULT_MASK_VALUE)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    acc_new = acc * alpha[..., None] + jnp.einsum(
+        "bhgqk,bhkd->bhgqd", p, v.astype(jnp.float32))
+    return acc_new, m_new, l_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   mesh: Optional[Mesh] = None,
+                   axis: str = SEQ_AXIS,
+                   causal: bool = True,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Ring attention.  q: [B, H, S, D], k/v: [B, Hkv, S, D] global shapes
+    with S sharded over ``axis``; output [B, H, S, D] sharded the same way.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    from deepspeed_tpu.sequence.layer import resolve_mesh
+
+    mesh = resolve_mesh(mesh, axis)
+    sp = mesh.shape[axis]
+    groups = q.shape[1] // k.shape[1]
+    if sp == 1:
+        from deepspeed_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, sm_scale=sm_scale)
+
+    def body(q, k, v):
+        # locals: q [B, H, S/sp, D]; k/v [B, Hkv, S/sp, D]
+        B, H, Sl, D = q.shape
+        Hkv = k.shape[1]
+        q = q.reshape(B, Hkv, groups, Sl, D)
+        my = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]  # send k/v to the right
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (Sl, Sl), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (Sl, Sl), 1)
+
+        def step(carry, j):
+            acc, m, l, kj, vj = carry
+            # K/V block j hops originated from device (my - j) mod sp
+            src = (my - j) % sp
+            if causal:
+                # src < my: full block; src == my: causal diag; src > my: skip
+                mask = jnp.where(
+                    src == my, k_pos <= q_pos,
+                    jnp.broadcast_to(src < my, (Sl, Sl)))
+            else:
+                mask = jnp.ones((Sl, Sl), dtype=bool)
+            acc, m, l = _block_attn_update((acc, m, l), q, kj, vj, mask,
+                                           sm_scale)
+            kj = jax.lax.ppermute(kj, axis, perm)
+            vj = jax.lax.ppermute(vj, axis, perm)
+            return (acc, m, l, kj, vj), None
+
+        init = (jnp.zeros((B, Hkv, groups, Sl, D), jnp.float32),
+                jnp.full((B, Hkv, groups, Sl), -jnp.inf, jnp.float32),
+                jnp.zeros((B, Hkv, groups, Sl), jnp.float32),
+                k, v)
+        (acc, m, l, _, _), _ = jax.lax.scan(
+            jax.checkpoint(step), init, jnp.arange(sp))
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        return out.reshape(B, H, Sl, D).astype(q.dtype)
+
+    spec = P(None, None, axis, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={axis},
+                         check_vma=False)(q, k, v)

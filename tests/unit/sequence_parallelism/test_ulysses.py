@@ -1,0 +1,184 @@
+"""Sequence-parallelism tests (reference:
+tests/unit/sequence_parallelism/test_ulysses.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.ops.flash_attention import mha_reference
+from deepspeed_tpu.sequence import (ring_attention, ulysses_attention,
+                                    vocab_sequence_parallel_cross_entropy)
+
+
+def _qkv(rng, B=2, H=4, Hkv=None, S=64, D=16):
+    Hkv = Hkv or H
+    return (jnp.asarray(rng.normal(size=(B, H, S, D)), jnp.float32),
+            jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.float32),
+            jnp.asarray(rng.normal(size=(B, Hkv, S, D)), jnp.float32))
+
+
+@pytest.fixture
+def sp_mesh(devices):
+    return dist.initialize_mesh(dp=2, sp=4)
+
+
+def _shard_seq(topo, x):
+    return jax.device_put(x, NamedSharding(topo.mesh,
+                                           P("data", None, "seq", None)))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_reference(sp_mesh, causal):
+    rng = np.random.default_rng(0)
+    q, k, v = _qkv(rng)
+    qs, ks, vs = (_shard_seq(sp_mesh, t) for t in (q, k, v))
+    out = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, mesh=sp_mesh.mesh, causal=causal))(qs, ks, vs)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_ulysses_gqa_kv_expansion(sp_mesh):
+    """Hkv=2 < sp=4: kv heads expanded so the all-to-all stays even."""
+    rng = np.random.default_rng(1)
+    q, k, v = _qkv(rng, H=8, Hkv=2)
+    qs, ks, vs = (_shard_seq(sp_mesh, t) for t in (q, k, v))
+    out = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, mesh=sp_mesh.mesh))(qs, ks, vs)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_reference(sp_mesh, causal):
+    rng = np.random.default_rng(2)
+    q, k, v = _qkv(rng)
+    qs, ks, vs = (_shard_seq(sp_mesh, t) for t in (q, k, v))
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh=sp_mesh.mesh, causal=causal))(qs, ks, vs)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_ring_gqa(sp_mesh):
+    """Ring keeps K/V at Hkv heads through the hops; output matches MHA."""
+    rng = np.random.default_rng(21)
+    q, k, v = _qkv(rng, H=8, Hkv=2)
+    qs, ks, vs = (_shard_seq(sp_mesh, t) for t in (q, k, v))
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh=sp_mesh.mesh, causal=True))(qs, ks, vs)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
+                               rtol=2e-5)
+
+
+def test_ring_gradients_match(sp_mesh):
+    rng = np.random.default_rng(3)
+    q, k, v = _qkv(rng, B=1, H=2, S=32, D=8)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh=sp_mesh.mesh,
+                                      causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g1 = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
+                                   rtol=5e-4)
+
+
+def test_ulysses_gradients_match(sp_mesh):
+    rng = np.random.default_rng(4)
+    q, k, v = _qkv(rng, B=1, H=4, S=32, D=8)
+
+    def loss_uly(q, k, v):
+        return jnp.sum(ulysses_attention(q, k, v, mesh=sp_mesh.mesh,
+                                         causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g1 = jax.jit(jax.grad(loss_uly, argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4,
+                                   rtol=5e-4)
+
+
+def test_vocab_seq_parallel_cross_entropy(devices):
+    topo = dist.initialize_mesh(dp=1, sp=4, tp=2)
+    rng = np.random.default_rng(5)
+    B, S, V = 2, 16, 64
+    logits = jnp.asarray(rng.normal(size=(B, S, V)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, V, size=(B, S)), jnp.int32)
+    ls = jax.device_put(logits, NamedSharding(topo.mesh,
+                                              P(None, "seq", "tensor")))
+    ts = jax.device_put(targets, NamedSharding(topo.mesh, P(None, "seq")))
+    loss = jax.jit(lambda l, t: vocab_sequence_parallel_cross_entropy(
+        l, t, mesh=topo.mesh))(ls, ts)
+    ref_logp = jax.nn.log_softmax(logits, axis=-1)
+    ref = -jnp.mean(jnp.take_along_axis(ref_logp, targets[..., None],
+                                        axis=-1))
+    np.testing.assert_allclose(float(loss), float(ref), rtol=1e-5)
+
+
+@pytest.mark.parametrize("backend", ["ulysses", "ring"])
+def test_llama_trains_with_sequence_parallel(devices, backend):
+    from deepspeed_tpu.models.llama import LlamaLMLoss, get_config
+
+    topo = dist.initialize_mesh(dp=2, sp=4)
+    cfg = get_config("tinyllama", dtype=jnp.float32, param_dtype=jnp.float32,
+                     remat=False, use_flash_attention=False,
+                     sequence_parallel=backend)
+    ds_config = {
+        "train_batch_size": 8,
+        "zero_optimization": {"stage": 2},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3,
+                                                  "fused": False}},
+        "steps_per_print": 10000,
+    }
+    rng = np.random.default_rng(6)
+    batch = {"input_ids": rng.integers(0, 256, size=(8, 32), dtype=np.int32)}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=LlamaLMLoss(cfg), config=ds_config, topology=topo,
+        example_batch=batch, rng=jax.random.PRNGKey(0))
+    losses = [float(jax.device_get(engine.train_batch(batch=batch)))
+              for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], losses
+
+
+def test_sp_loss_matches_dp_loss(devices):
+    """Same model/seed: sp=4 x dp=2 loss == pure dp=8 loss (first step)."""
+    from deepspeed_tpu.models.llama import LlamaLMLoss, get_config
+
+    rng = np.random.default_rng(7)
+    batch = {"input_ids": rng.integers(0, 256, size=(8, 32), dtype=np.int32)}
+    results = {}
+    for name, (kw, sp_mode) in {
+        "dp": (dict(dp=8), "none"),
+        "sp": (dict(dp=2, sp=4), "ulysses"),
+    }.items():
+        topo = dist.initialize_mesh(**kw)
+        cfg = get_config("tinyllama", dtype=jnp.float32,
+                         param_dtype=jnp.float32, remat=False,
+                         use_flash_attention=False, sequence_parallel=sp_mode)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=LlamaLMLoss(cfg),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "AdamW",
+                                  "params": {"lr": 1e-3, "fused": False}},
+                    "steps_per_print": 10000},
+            topology=topo, example_batch=batch, rng=jax.random.PRNGKey(3))
+        results[name] = [float(jax.device_get(
+            engine.train_batch(batch=batch))) for _ in range(3)]
+    np.testing.assert_allclose(results["dp"], results["sp"], rtol=2e-4)
