@@ -39,6 +39,11 @@ class GPT2Config:
     param_dtype: Any = jnp.float32
     scan_layers: bool = True
     remat: bool = True
+    # selective activation checkpointing (runtime/activation_checkpointing
+    # equivalent): "full" recomputes everything, "dots" saves matmul outputs
+    # with no batch dims (XLA recomputes only cheap elementwise ops — the
+    # reference's partitioned-activations sweet spot), "none" disables remat
+    remat_policy: str = "full"
     use_flash_attention: bool = False
     tie_word_embeddings: bool = True
     tensor_parallel: bool = False  # Megatron-style TP param annotations
@@ -138,6 +143,27 @@ class Block(nn.Module):
         return x
 
 
+def remat_policy_fn(name: str):
+    """Map a policy name to a jax.checkpoint policy (None = save nothing)."""
+    policies = {
+        "full": None,
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "checkpoint_dots": jax.checkpoint_policies.checkpoint_dots,
+        "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    }
+    if name not in policies:
+        raise ValueError(f"unknown remat policy {name!r}; "
+                         f"one of {sorted(policies)} or 'none'")
+    return policies[name]
+
+
+def _maybe_remat(block_cls, cfg):
+    if not cfg.remat or cfg.remat_policy == "none":
+        return block_cls
+    return nn.remat(block_cls, prevent_cse=False,
+                    policy=remat_policy_fn(cfg.remat_policy))
+
+
 class ScanBlock(nn.Module):
     """Block adapted to nn.scan carry signature."""
 
@@ -169,9 +195,7 @@ class GPT2Model(nn.Module):
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
         if cfg.scan_layers:
-            block_cls = ScanBlock
-            if cfg.remat:
-                block_cls = nn.remat(ScanBlock, prevent_cse=False)
+            block_cls = _maybe_remat(ScanBlock, cfg)
             x, _ = nn.scan(
                 block_cls,
                 variable_axes={"params": 0},
@@ -180,9 +204,7 @@ class GPT2Model(nn.Module):
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, deterministic, name="h")(x, None)
         else:
-            block_cls = Block
-            if cfg.remat:
-                block_cls = nn.remat(Block, prevent_cse=False)
+            block_cls = _maybe_remat(Block, cfg)
             for i in range(cfg.n_layer):
                 x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
 
@@ -208,11 +230,21 @@ class GPT2LMLoss(nn.Module):
         deterministic = self.config.dropout == 0.0
         logits = GPT2Model(self.config, name="transformer")(
             input_ids, deterministic=deterministic)
-        logits = logits[:, :-1].astype(jnp.float32)
-        targets = input_ids[:, 1:]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
+        return next_token_loss(logits, input_ids)
+
+
+def next_token_loss(logits: jax.Array, input_ids: jax.Array) -> jax.Array:
+    """Next-token cross entropy without materializing an fp32 [B, S, V]
+    log-softmax: loss = mean(lse - target_logit).  The [B, S, V] tensor stays
+    in the model compute dtype (bf16); only the logsumexp reduction and the
+    gathered target logits are fp32 (XLA fuses the upcast into the reduce,
+    so nothing V-sized is ever written in fp32).  Backward is the standard
+    softmax-minus-onehot, likewise fused from the bf16 logits."""
+    logits = logits[:, :-1]
+    targets = input_ids[:, 1:]
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt.astype(jnp.float32))
 
 
 def count_params(params) -> int:

@@ -40,6 +40,7 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     scan_layers: bool = True
     remat: bool = True
+    remat_policy: str = "full"             # see gpt2.remat_policy_fn
     use_flash_attention: bool = True
     tensor_parallel: bool = False
     # sequence parallelism: "none", "ulysses" (all-to-all), "ring" (ppermute)
@@ -221,10 +222,10 @@ class LlamaModel(nn.Module):
                      param_dtype=cfg.param_dtype, name="embed_tokens",
                      **embed_kwargs)(input_ids)
 
+        from deepspeed_tpu.models.gpt2 import _maybe_remat
+
         if cfg.scan_layers:
-            block_cls = ScanLlamaBlock
-            if cfg.remat:
-                block_cls = nn.remat(ScanLlamaBlock, prevent_cse=False)
+            block_cls = _maybe_remat(ScanLlamaBlock, cfg)
             (x, _), _ = nn.scan(
                 block_cls,
                 variable_axes={"params": 0},
@@ -233,9 +234,7 @@ class LlamaModel(nn.Module):
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(cfg, deterministic, name="layers")((x, positions), None)
         else:
-            block_cls = LlamaBlock
-            if cfg.remat:
-                block_cls = nn.remat(LlamaBlock, prevent_cse=False)
+            block_cls = _maybe_remat(LlamaBlock, cfg)
             for i in range(cfg.num_hidden_layers):
                 x = block_cls(cfg, name=f"layers_{i}")(x, positions,
                                                        deterministic)
@@ -262,13 +261,11 @@ class LlamaLMLoss(nn.Module):
 
     @nn.compact
     def __call__(self, batch):
+        from deepspeed_tpu.models.gpt2 import next_token_loss
+
         input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
         logits = LlamaForCausalLM(self.config, name="lm")(input_ids)
-        logits = logits[:, :-1].astype(jnp.float32)
-        targets = input_ids[:, 1:]
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
+        return next_token_loss(logits, input_ids)
 
 
 def count_params(params) -> int:

@@ -1,0 +1,6 @@
+from deepspeed_tpu.moe.layer import MoE
+from deepspeed_tpu.moe.sharded_moe import (top1gating, top2gating, topkgating,
+                                           moe_combine, moe_dispatch)
+
+__all__ = ["MoE", "top1gating", "top2gating", "topkgating", "moe_combine",
+           "moe_dispatch"]

@@ -1,0 +1,137 @@
+"""Mixture-of-Experts layer.
+
+TPU-native re-design of the reference MoE stack (``deepspeed/moe/layer.py:17
+MoE``, ``sharded_moe.py:533 MOELayer``, ``TopKGate:449``): the reference
+builds per-rank expert modules and issues explicit all-to-alls
+(``_AllToAll:96``) between gate, experts, and combine; here the experts are
+ONE stacked parameter tensor ``[E, ...]`` whose leading axis is annotated
+onto the ``expert`` mesh axis, dispatch/combine are einsums against the
+gating tensors, and XLA/GSPMD inserts the all-to-alls when the ``[E, C, M]``
+dispatched activations are sharding-constrained onto the expert axis — the
+same wire traffic, riding ICI, without hand-rolled comm.
+
+Expert-parallel composition mirrors ``groups.py:236
+_create_expert_and_data_parallel``: the ``expert`` mesh axis carries both
+the expert shards and (being a ZeRO axis) a slice of the data batch, so
+ep_size experts x dp replicas works out of the box; MoE-aware ZeRO
+(``stage_1_and_2.py:616 _configure_moe_settings``) falls out of the
+sharding-plan composition — expert params keep their ``expert`` axis and
+ZeRO claims a *different* dim.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.moe.sharded_moe import moe_combine, moe_dispatch, topkgating
+
+EXPERT_AXIS = "expert"
+
+
+def _maybe_constrain(x: jax.Array, spec) -> jax.Array:
+    """Sharding-constrain when a mesh is installed (no-op in meshless
+    tests); this is what makes GSPMD emit the dispatch all-to-all."""
+    try:
+        import deepspeed_tpu.comm as dist
+
+        topo = dist.get_topology()
+        if topo is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(topo.mesh, P(*spec)))
+    except Exception:
+        return x
+
+
+class MoE(nn.Module):
+    """Top-k routed MoE FFN: gate -> dispatch -> experts -> combine.
+
+    Returns ``(y, l_aux)``; the caller plumbs ``l_aux`` into the training
+    loss (reference stores it on the layer and the engine collects it).
+    """
+
+    hidden_size: int
+    num_experts: int
+    intermediate_size: int
+    k: int = 2
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    drop_tokens: bool = True
+    activation: str = "swiglu"             # "swiglu" (Mixtral) | "gelu"
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    expert_parallel: bool = True           # annotate the expert mesh axis
+    tensor_parallel: bool = False          # shard expert FFN over `tensor`
+    noisy_gate_policy: Optional[str] = None  # None | "Jitter"
+
+    @nn.compact
+    def __call__(self, x: jax.Array, is_training: bool = True
+                 ) -> Tuple[jax.Array, jax.Array]:
+        cfg = self
+        orig_shape = x.shape
+        M, E, I = cfg.hidden_size, cfg.num_experts, cfg.intermediate_size
+        x = x.reshape(-1, M)                                     # [G, M]
+
+        # router in fp32 (reference TopKGate keeps the gate fp32,
+        # sharded_moe.py:449) — routing decisions are precision-sensitive
+        wg = self.param("gate", nn.initializers.lecun_normal(), (M, E),
+                        jnp.float32)
+        logits = x.astype(jnp.float32) @ wg                      # [G, E]
+
+        noise_rng = None
+        if (cfg.noisy_gate_policy == "Jitter" and is_training
+                and self.has_rng("gating")):
+            noise_rng = self.make_rng("gating")
+        gr = topkgating(
+            logits, k=cfg.k,
+            capacity_factor=(cfg.capacity_factor if is_training
+                             else cfg.eval_capacity_factor),
+            min_capacity=cfg.min_capacity, drop_tokens=cfg.drop_tokens,
+            noise_rng=noise_rng)
+
+        ep = EXPERT_AXIS if cfg.expert_parallel else None
+        tp = "tensor" if cfg.tensor_parallel else None
+
+        def expert_param(name, shape, spec, bias: bool = False):
+            init = (nn.initializers.zeros_init() if bias else
+                    nn.initializers.lecun_normal(in_axis=-2, out_axis=-1,
+                                                 batch_axis=(0,)))
+            if any(s is not None for s in spec):
+                init = nn.with_partitioning(init, spec)
+            return self.param(name, init, shape, cfg.param_dtype)
+
+        # dispatch: [G, M] -> [E, C, M]; the sharding constraint onto the
+        # expert axis is the reference's first all-to-all (_AllToAll fwd)
+        disp = moe_dispatch(x, gr.dispatch.astype(cfg.dtype))
+        disp = _maybe_constrain(disp, (ep, None, None))
+
+        if cfg.activation == "swiglu":                           # Mixtral
+            w1 = expert_param("w1", (E, M, I), (ep, None, tp))
+            w3 = expert_param("w3", (E, M, I), (ep, None, tp))
+            w2 = expert_param("w2", (E, I, M), (ep, tp, None))
+            h = jnp.einsum("ecm,emi->eci", disp, w1.astype(cfg.dtype))
+            u = jnp.einsum("ecm,emi->eci", disp, w3.astype(cfg.dtype))
+            out = jnp.einsum("eci,eim->ecm", nn.silu(h) * u,
+                             w2.astype(cfg.dtype))
+        elif cfg.activation == "gelu":
+            w1 = expert_param("w1", (E, M, I), (ep, None, tp))
+            b1 = expert_param("b1", (E, I), (ep, tp), bias=True)
+            w2 = expert_param("w2", (E, I, M), (ep, tp, None))
+            b2 = expert_param("b2", (E, M), (ep, None), bias=True)
+            h = jnp.einsum("ecm,emi->eci", disp, w1.astype(cfg.dtype))
+            h = jax.nn.gelu(h + b1.astype(cfg.dtype)[:, None])
+            out = jnp.einsum("eci,eim->ecm", h, w2.astype(cfg.dtype))
+            out = out + b2.astype(cfg.dtype)[:, None]
+        else:
+            raise ValueError(f"unknown MoE activation {cfg.activation!r}")
+
+        out = _maybe_constrain(out, (ep, None, None))
+        # combine: [E, C, M] -> [G, M] (the second all-to-all)
+        y = moe_combine(out, gr.combine.astype(cfg.dtype))
+        return y.reshape(orig_shape), gr.l_aux.astype(jnp.float32)

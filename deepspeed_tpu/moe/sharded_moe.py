@@ -76,22 +76,28 @@ def topkgating(logits: jax.Array, k: int = 1,
     else:
         C = G  # worst case: every token to one expert
 
-    # gate values of the selected experts, normalized over the selection
-    gate_k = [jnp.sum(gates * m, axis=-1) for m in masks]       # k x [G]
-    denom = sum(gate_k)
-    denom = jnp.maximum(denom, jnp.finfo(jnp.float32).eps)
-    gate_k = [g / denom for g in gate_k]
-
     # position of each token within its expert's capacity buffer: cumsum
     # over tokens, with later choices placed after all earlier choices
-    combine = jnp.zeros((G, E, C), jnp.float32)
+    positions, keeps = [], []
     offset = jnp.zeros((E,), jnp.float32)
-    for mask, g in zip(masks, gate_k):
+    for mask in masks:
         loc = jnp.cumsum(mask, axis=0) - mask + offset[None, :]  # [G, E]
         offset = offset + jnp.sum(mask, axis=0)
         pos = jnp.sum(loc * mask, axis=-1).astype(jnp.int32)     # [G]
-        keep = (pos < C)
-        w = g * keep.astype(jnp.float32)                          # [G]
+        positions.append(pos)
+        keeps.append((pos < C).astype(jnp.float32))
+
+    # gate values of the selected experts, normalized over the *surviving*
+    # selection: the reference zeroes capacity-dropped choices in the masks
+    # BEFORE computing gates1_s/gates2_s (top2gating, sharded_moe.py:290), so
+    # when one choice drops the other absorbs the full weight (sums to 1)
+    gate_k = [jnp.sum(gates * m, axis=-1) for m in masks]        # k x [G]
+    denom = sum(g * keep for g, keep in zip(gate_k, keeps))
+    denom = jnp.maximum(denom, jnp.finfo(jnp.float32).eps)
+
+    combine = jnp.zeros((G, E, C), jnp.float32)
+    for mask, g, pos, keep in zip(masks, gate_k, positions, keeps):
+        w = g * keep / denom                                      # [G]
         combine = combine + (w[:, None, None] * mask[:, :, None] *
                              jax.nn.one_hot(pos, C, dtype=jnp.float32
                                             )[:, None, :])

@@ -49,10 +49,13 @@ class ScaleByFusedLionState(NamedTuple):
 # Kernels
 # ---------------------------------------------------------------------------
 
-def _adam_kernel(step_ref, g_ref, p_ref, m_ref, v_ref,
+def _adam_kernel(sc_ref, g_ref, p_ref, m_ref, v_ref,
                  u_ref, m_out_ref, v_out_ref, *,
                  b1: float, b2: float, eps: float, wd: float, adam_w: bool):
-    t = step_ref[0].astype(jnp.float32)
+    # sc_ref: [bc1, bc2] bias corrections, precomputed outside the kernel
+    # (Mosaic has no pow lowering; they're scalars anyway)
+    bc1 = sc_ref[0]
+    bc2 = sc_ref[1]
     g = g_ref[:].astype(jnp.float32)
     p = p_ref[:].astype(jnp.float32)
     m = m_ref[:]
@@ -61,8 +64,6 @@ def _adam_kernel(step_ref, g_ref, p_ref, m_ref, v_ref,
         g = g + wd * p
     m_new = b1 * m + (1.0 - b1) * g
     v_new = b2 * v + (1.0 - b2) * g * g
-    bc1 = 1.0 - jnp.power(b1, t)
-    bc2 = 1.0 - jnp.power(b2, t)
     u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
     if wd and adam_w:  # decoupled (AdamW) decay joins the direction
         u = u + wd * p
@@ -71,9 +72,9 @@ def _adam_kernel(step_ref, g_ref, p_ref, m_ref, v_ref,
     v_out_ref[:] = v_new
 
 
-def _lion_kernel(step_ref, g_ref, p_ref, m_ref, u_ref, m_out_ref, *,
+def _lion_kernel(sc_ref, g_ref, p_ref, m_ref, u_ref, m_out_ref, *,
                  b1: float, b2: float, wd: float):
-    del step_ref  # lion has no bias correction
+    del sc_ref  # lion has no bias correction
     g = g_ref[:].astype(jnp.float32)
     p = p_ref[:].astype(jnp.float32)
     m = m_ref[:]
@@ -108,13 +109,13 @@ def _untile(x: jax.Array, shape, dtype) -> jax.Array:
     return x.reshape(-1)[:n].reshape(shape).astype(dtype)
 
 
-def _run_elementwise(kernel, step, tiles, n_outs: int, interpret: bool):
-    """Run an elementwise optimizer kernel over same-shape (R,128) tiles."""
+def _run_elementwise(kernel, scalars, tiles, n_outs: int, interpret: bool):
+    """Run an elementwise optimizer kernel over same-shape (R,128) tiles.
+    ``scalars`` is a small f32 vector handed to the kernel via SMEM."""
     rows = tiles[0].shape[0]
     blk_rows = _block_rows(rows * _LANE)
     grid = (rows // blk_rows,)
     blk = pl.BlockSpec((blk_rows, _LANE), lambda i: (i, 0))
-    step_arr = jnp.asarray([step], jnp.int32)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -124,7 +125,7 @@ def _run_elementwise(kernel, step, tiles, n_outs: int, interpret: bool):
         out_shape=[jax.ShapeDtypeStruct((rows, _LANE), jnp.float32)
                    ] * n_outs,
         interpret=interpret,
-    )(step_arr, *tiles)
+    )(scalars, *tiles)
 
 
 def _on_tpu() -> bool:
@@ -139,10 +140,13 @@ def adam_update_leaf(g, p, m, v, step, *, b1, b2, eps, wd, adam_w,
                      interpret: bool = False):
     """Returns (u, m_new, v_new) for one leaf."""
     if _on_tpu() or interpret:
+        t = step.astype(jnp.float32)
+        scalars = jnp.stack([1.0 - jnp.power(jnp.float32(b1), t),
+                             1.0 - jnp.power(jnp.float32(b2), t)])
         kern = functools.partial(_adam_kernel, b1=b1, b2=b2, eps=eps, wd=wd,
                                  adam_w=adam_w)
         u, m_new, v_new = _run_elementwise(
-            kern, step, [_tile(g), _tile(p), _tile(m), _tile(v)], 3,
+            kern, scalars, [_tile(g), _tile(p), _tile(m), _tile(v)], 3,
             interpret)
         return (_untile(u, g.shape, jnp.float32),
                 _untile(m_new, g.shape, jnp.float32),
@@ -165,7 +169,8 @@ def lion_update_leaf(g, p, m, step, *, b1, b2, wd, interpret: bool = False):
     if _on_tpu() or interpret:
         kern = functools.partial(_lion_kernel, b1=b1, b2=b2, wd=wd)
         u, m_new = _run_elementwise(
-            kern, step, [_tile(g), _tile(p), _tile(m)], 2, interpret)
+            kern, jnp.zeros((2,), jnp.float32),
+            [_tile(g), _tile(p), _tile(m)], 2, interpret)
         return (_untile(u, g.shape, jnp.float32),
                 _untile(m_new, g.shape, jnp.float32))
     gf = g.astype(jnp.float32)

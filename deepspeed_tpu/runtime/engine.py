@@ -32,7 +32,8 @@ from deepspeed_tpu.runtime import precision as prec
 from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
                                               RepeatingLoader, shard_batch)
 from deepspeed_tpu.runtime.lr_schedules import LRScheduler, get_schedule_fn
-from deepspeed_tpu.runtime.optimizers import build_optimizer
+from deepspeed_tpu.runtime.optimizers import (build_optimizer,
+                                              is_fused_optimizer)
 from deepspeed_tpu.runtime.train_state import TrainState
 from deepspeed_tpu.runtime.zero import ZeroShardingPlan, constrain_tree
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -94,6 +95,16 @@ def initialize(args=None,
                              example_batch=example_batch,
                              rng=rng)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+class DeviceBatch:
+    """Marker wrapper for a batch already staged on device in the engine's
+    [gas, micro, ...] layout (see ``DeepSpeedEngine.put_batch``)."""
+
+    __slots__ = ("tree",)
+
+    def __init__(self, tree):
+        self.tree = tree
 
 
 class OptimizerHandle:
@@ -214,9 +225,25 @@ class DeepSpeedEngine:
         self._grad_spec_tree = self.plan.grad_specs(params, self.base_specs)
 
         opt_shapes = jax.eval_shape(self.tx.init, params)
+        opt_specs = self.plan.opt_state_specs(opt_shapes, self.base_specs)
         opt_shardings = self.plan.opt_state_shardings(opt_shapes,
                                                       self.base_specs)
         opt_state = jax.jit(self.tx.init, out_shardings=opt_shardings)(params)
+
+        # Fused Pallas optimizers have no GSPMD partitioning rule; run the
+        # update inside shard_map over the ZeRO moment layout so each device
+        # updates only its own shard (stage_1_and_2.py step semantics: shard
+        # update + all-gather of the result, which XLA inserts when the
+        # engine applies p - lr*u against less-sharded params).
+        self._tx_update = self.tx.update
+        if is_fused_optimizer(self.optimizer_name,
+                              opt_cfg.params if opt_cfg else {}):
+            moment_specs = self.plan.moment_specs(params, self.base_specs)
+            self._tx_update = jax.shard_map(
+                self.tx.update, mesh=self.mesh,
+                in_specs=(moment_specs, opt_specs, moment_specs),
+                out_specs=(moment_specs, opt_specs),
+                check_vma=False)
 
         scale_state = prec.init_loss_scale(config.fp16)
         self.state = TrainState(
@@ -250,6 +277,8 @@ class DeepSpeedEngine:
         self._apply_step_fn = None
         self._pending_grads = None
         self._pending_loss = None
+        self._lr_cached_value = None
+        self._lr_cached_dev = None
 
         # -- observability -------------------------------------------------
         self.timers = SynchronizedWallClockTimer()
@@ -301,7 +330,7 @@ class DeepSpeedEngine:
         plan = self.plan
         mesh = self.mesh
         loss_fn = self.loss_fn
-        tx = self.tx
+        tx_update = self._tx_update
         gas = self.gas
         compute_dtype = self.compute_dtype
         clip = self.config.gradient_clipping
@@ -312,13 +341,16 @@ class DeepSpeedEngine:
         def cast_params(p):
             return prec.cast_tree(p, compute_dtype)
 
+        # overflow scanning exists for fp16 loss-scaling; bf16/fp32 training
+        # never skips steps (reference bf16_optimizer has no overflow path),
+        # so skip the full-gradient inf/nan sweep there
+        check_overflow = self.config.fp16.enabled
+
         def train_step(state: TrainState, batch, lr):
             rng, new_rng = jax.random.split(state.rng)
             scale = state.scale.loss_scale
 
-            def micro_step(carry, xs):
-                grads_acc, loss_acc = carry
-                mb, idx = xs
+            def micro_grads(mb, idx):
                 mrng = jax.random.fold_in(rng, idx)
 
                 def scaled_loss(p):
@@ -331,40 +363,61 @@ class DeepSpeedEngine:
                 # ZeRO >= 2: keep accumulated grads in the sharded layout so
                 # XLA reduce-scatters each micro-batch (stage_1_and_2.py
                 # average_tensor hot loop equivalent)
-                grads = constrain_tree(grads, grad_specs, mesh)
-                grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
-                return (grads_acc, loss_acc + loss_s), None
+                return constrain_tree(grads, grad_specs, mesh), loss_s
 
-            zero_grads = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            zero_grads = constrain_tree(zero_grads, grad_specs, mesh)
-            idxs = jnp.arange(gas)
-            (grads, loss_sum), _ = jax.lax.scan(
-                micro_step, (zero_grads, jnp.asarray(0.0, jnp.float32)),
-                (batch, idxs))
+            if gas == 1:
+                # fast path: no accumulation buffers, no scan
+                grads, loss_sum = micro_grads(
+                    jax.tree_util.tree_map(lambda x: x[0], batch), 0)
+            else:
+                def micro_step(carry, xs):
+                    grads_acc, loss_acc = carry
+                    mb, idx = xs
+                    grads, loss_s = micro_grads(mb, idx)
+                    grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc,
+                                                       grads)
+                    return (grads_acc, loss_acc + loss_s), None
+
+                zero_grads = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                zero_grads = constrain_tree(zero_grads, grad_specs, mesh)
+                idxs = jnp.arange(gas)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    micro_step, (zero_grads, jnp.asarray(0.0, jnp.float32)),
+                    (batch, idxs))
 
             # unscale (loss scale) and average (GAS); data-parallel averaging
             # already happened inside the mean loss over the global batch
-            inv = 1.0 / (scale * gas)
-            grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+            if check_overflow or gas > 1:  # loss was scaled / accumulated
+                inv = 1.0 / (scale * gas)
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
 
-            overflow = prec.has_inf_or_nan(grads)
             grad_norm = prec.global_norm(grads)
             if clip and clip > 0:
                 grads, _ = prec.clip_by_global_norm(grads, clip, grad_norm)
 
-            safe_grads = jax.tree_util.tree_map(
-                lambda g: jnp.where(overflow, jnp.zeros_like(g), g), grads)
-            updates, new_opt = tx.update(safe_grads, state.opt_state,
-                                         state.params)
-            new_params = jax.tree_util.tree_map(
-                lambda p, u: jnp.where(overflow, p,
-                                       (p - lr * u.astype(jnp.float32)
-                                        ).astype(p.dtype)),
-                state.params, updates)
-            new_opt = jax.tree_util.tree_map(
-                lambda n, o: jnp.where(overflow, o, n), new_opt,
-                state.opt_state)
+            if check_overflow:
+                overflow = prec.has_inf_or_nan(grads)
+                safe_grads = jax.tree_util.tree_map(
+                    lambda g: jnp.where(overflow, jnp.zeros_like(g), g), grads)
+                updates, new_opt = tx_update(safe_grads, state.opt_state,
+                                             state.params)
+                new_params = jax.tree_util.tree_map(
+                    lambda p, u: jnp.where(overflow, p,
+                                           (p - lr * u.astype(jnp.float32)
+                                            ).astype(p.dtype)),
+                    state.params, updates)
+                new_opt = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(overflow, o, n), new_opt,
+                    state.opt_state)
+            else:
+                overflow = jnp.asarray(False)
+                updates, new_opt = tx_update(grads, state.opt_state,
+                                             state.params)
+                new_params = jax.tree_util.tree_map(
+                    lambda p, u: (p - lr * u.astype(jnp.float32)
+                                  ).astype(p.dtype),
+                    state.params, updates)
 
             new_scale = prec.update_loss_scale(
                 state.scale, overflow, dynamic,
@@ -432,7 +485,7 @@ class DeepSpeedEngine:
         return jax.jit(grad_step)
 
     def _build_apply_step(self):
-        tx = self.tx
+        tx_update = self._tx_update
         plan = self.plan
         clip = self.config.gradient_clipping
         fp16 = self.config.fp16
@@ -449,7 +502,7 @@ class DeepSpeedEngine:
                 grads, _ = prec.clip_by_global_norm(grads, clip, grad_norm)
             safe = jax.tree_util.tree_map(
                 lambda g: jnp.where(overflow, jnp.zeros_like(g), g), grads)
-            updates, new_opt = tx.update(safe, state.opt_state, state.params)
+            updates, new_opt = tx_update(safe, state.opt_state, state.params)
             new_params = jax.tree_util.tree_map(
                 lambda p, u: jnp.where(overflow, p,
                                        (p - lr * u.astype(jnp.float32)
@@ -481,6 +534,8 @@ class DeepSpeedEngine:
 
     def _to_gas_batch(self, batch):
         """[train_batch, ...] -> [gas, micro_global, ...] sharded arrays."""
+        if isinstance(batch, DeviceBatch):
+            return batch.tree
         gas = self.gas
 
         def reshape(x):
@@ -494,6 +549,22 @@ class DeepSpeedEngine:
         return jax.tree_util.tree_map(
             lambda x: jax.device_put(x, self.plan.batch_sharding(
                 x.ndim, has_gas_dim=True)), batch)
+
+    def put_batch(self, batch) -> "DeviceBatch":
+        """Pre-stage a [train_batch, ...] batch on device in the engine's
+        gas-sharded layout.  ``train_batch(batch=put_batch(b))`` then skips
+        all per-step host work — useful when iterating over device-resident
+        data or re-using a batch (benchmarks)."""
+        return DeviceBatch(self._to_gas_batch(batch))
+
+    def _lr_device(self) -> jax.Array:
+        """Device scalar for the current LR, re-transferred only on change."""
+        lr = float(self.get_lr()[0])
+        if self._lr_cached_value != lr:
+            self._lr_cached_value = lr
+            self._lr_cached_dev = jax.device_put(
+                np.float32(lr), NamedSharding(self.mesh, P()))
+        return self._lr_cached_dev
 
     def _next_batch(self, data_iter):
         if data_iter is not None:
@@ -519,7 +590,7 @@ class DeepSpeedEngine:
         gbatch = self._to_gas_batch(batch)
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
-        lr = jnp.asarray(self.get_lr()[0], jnp.float32)
+        lr = self._lr_device()
 
         self.tput_timer.start()
         self.state, metrics = self._train_step_fn(self.state, gbatch, lr)

@@ -110,6 +110,13 @@ class ZeroShardingPlan:
         """Stage >= 2 keeps grads in the sharded layout (reduce-scatter)."""
         return self._specs(params, self.stage >= 2, base_specs)
 
+    def moment_specs(self, params, base_specs=None):
+        """Per-param layout of the optimizer moments (stage >= 1 sharded) —
+        the layout the fused-optimizer shard_map runs in: each device updates
+        its own shard of (g, p, m, v), the reference's stage-1/2 ``step``
+        partition semantics (stage_1_and_2.py ~1800s)."""
+        return self._specs(params, self.stage >= 1, base_specs)
+
     @staticmethod
     def _path_key(kp) -> Tuple[str, ...]:
         return tuple(str(k) for k in kp)

@@ -1,0 +1,90 @@
+"""Isolate where the train-step time goes on the current device."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, n=20, warmup=1):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind}", flush=True)
+
+    # 1. dispatch overhead
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8, 8))
+    dt = timeit(f, x, n=100)
+    print(f"dispatch overhead (tiny jit): {dt * 1e3:.2f} ms", flush=True)
+
+    # 2. raw matmul peak, bf16
+    N = 8192
+    a = jnp.ones((N, N), jnp.bfloat16)
+
+    @jax.jit
+    def mm(a):
+        def body(c, _):
+            return jnp.dot(c, c, preferred_element_type=jnp.bfloat16), None
+        c, _ = jax.lax.scan(body, a, None, length=20)
+        return c
+
+    dt = timeit(mm, a, n=5)
+    tf = 20 * 2 * N**3 / dt / 1e12
+    print(f"raw bf16 matmul: {tf:.0f} TFLOPS", flush=True)
+
+    # 3. model fwd / fwd+bwd
+    from deepspeed_tpu.models.gpt2 import (GPT2LMLoss, get_config,
+                                           flops_per_token)
+    for label, kw in [
+        ("flash,remat=none", dict(use_flash_attention=True, remat=False)),
+        ("flash,remat=dots", dict(use_flash_attention=True, remat=True,
+                                  remat_policy="dots")),
+        ("naive,remat=dots", dict(use_flash_attention=False, remat=True,
+                                  remat_policy="dots")),
+    ]:
+        cfg = get_config("gpt2-125m", n_positions=1024, dtype=jnp.bfloat16,
+                         scan_layers=True, **kw)
+        model = GPT2LMLoss(cfg)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, cfg.vocab_size, size=(8, 1024),
+                                           dtype=np.int32)}
+        params = jax.jit(model.init)({"params": jax.random.PRNGKey(0)}, batch)
+        params_bf16 = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+
+        ftok = flops_per_token(cfg, 1024) * 8 * 1024
+        try:
+            fwd = jax.jit(lambda p, b: model.apply(p, b))
+            dt_f = timeit(fwd, params_bf16, batch, n=10)
+            print(f"{label}: fwd {dt_f * 1e3:.0f} ms "
+                  f"({ftok / 3 / dt_f / 1e12:.0f} TF)", flush=True)
+        except Exception as e:
+            print(f"{label}: fwd FAILED {type(e).__name__}", flush=True)
+        try:
+            grad = jax.jit(jax.value_and_grad(lambda p, b: model.apply(p, b)))
+            dt_g = timeit(grad, params_bf16, batch, n=10)
+            print(f"{label}: fwd+bwd {dt_g * 1e3:.0f} ms "
+                  f"(mfu={ftok / dt_g / 1e12 / 197 * 100:.1f}%)", flush=True)
+        except Exception as e:
+            print(f"{label}: fwd+bwd FAILED {type(e).__name__}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

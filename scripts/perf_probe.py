@@ -1,0 +1,91 @@
+"""Perf probe: time GPT-2 train-step variants on the current devices.
+
+Usage: python scripts/perf_probe.py [variant ...]
+Variants are comma-separated key=value overrides, e.g.
+    python scripts/perf_probe.py flash=1,remat=none flash=1,remat=dots,micro=16
+Defaults to a small sweep. Prints one line per variant with tokens/s + MFU.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def run_variant(spec: str) -> None:
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models.gpt2 import (GPT2LMLoss, flops_per_token,
+                                           get_config)
+    from bench import peak_flops
+
+    kv = dict(item.split("=") for item in spec.split(",") if item)
+    flash = bool(int(kv.get("flash", 1)))
+    remat = kv.get("remat", "none")
+    micro = int(kv.get("micro", 8))
+    seq = int(kv.get("seq", 1024))
+    steps = int(kv.get("steps", 20))
+    preset = kv.get("preset", "gpt2-125m")
+    zero = int(kv.get("zero", 0))
+    opt = kv.get("opt", "AdamW")
+
+    cfg_model = get_config(preset, n_positions=seq, dtype=jnp.bfloat16,
+                           remat=remat != "none", remat_policy=remat,
+                           scan_layers=True, use_flash_attention=flash)
+    topo = dist.initialize_mesh()
+    dp = topo.zero_partition_count()
+    ds_config = {
+        "train_batch_size": micro * dp,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": zero},
+        "optimizer": {"type": opt, "params": {"lr": 1e-4,
+                                              "weight_decay": 0.01}},
+        "steps_per_print": 1000000,
+    }
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(
+        0, cfg_model.vocab_size, size=(micro * dp, seq), dtype=np.int32)}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=GPT2LMLoss(cfg_model), config=ds_config, topology=topo,
+        example_batch={"input_ids": batch["input_ids"][:1]},
+        rng=jax.random.PRNGKey(0))
+
+    dbatch = engine.put_batch(batch)
+    t_c0 = time.perf_counter()
+    loss = engine.train_batch(batch=dbatch)
+    float(jax.device_get(loss))
+    compile_s = time.perf_counter() - t_c0
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch=dbatch)
+    float(jax.device_get(loss))
+    dt = time.perf_counter() - t0
+    dev = jax.devices()[0]
+    n_chips = len(jax.devices())
+    tokens_per_sec = steps * micro * dp * seq / dt
+    mfu = 100.0 * tokens_per_sec * flops_per_token(cfg_model, seq) / (
+        peak_flops(dev.device_kind) * n_chips)
+    print(f"PROBE {spec!r}: {tokens_per_sec:,.0f} tok/s  mfu={mfu:.2f}%  "
+          f"step={dt / steps * 1e3:.1f}ms  compile={compile_s:.0f}s",
+          flush=True)
+
+
+if __name__ == "__main__":
+    variants = sys.argv[1:] or [
+        "flash=1,remat=none,micro=8,opt=FusedAdam",
+        "flash=1,remat=none,micro=8,opt=AdamW",
+        "flash=1,remat=dots,micro=16,opt=AdamW",
+        "flash=1,remat=dots,micro=16,opt=FusedAdam",
+        "flash=1,remat=dots,micro=32,opt=AdamW",
+    ]
+    for v in variants:
+        run_variant(v)

@@ -155,3 +155,61 @@ def test_lse_matches_logsumexp():
     ref_lse = jax.scipy.special.logsumexp(logits, axis=-1)
     np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
                                atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_pallas_bwd_interpret(causal):
+    """The Pallas dq / dkv kernels, via the interpreter on CPU."""
+    rng = np.random.default_rng(9)
+    q, k, v = _make_qkv(rng, B=1, H=2, S=96, D=32)  # 96: uneven vs block 64
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=64,
+                                       block_k=64, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_pallas_bwd_interpret_gqa():
+    rng = np.random.default_rng(10)
+    q, k, v = _make_qkv(rng, B=1, H=4, Hkv=2, S=64, D=32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=32,
+                                       block_k=32, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_fully_masked_rows_zero_output():
+    """Causal with Sk < S: query rows with zero valid keys must output 0
+    (not a uniform average of masked values) and carry zero gradient."""
+    rng = np.random.default_rng(11)
+    B, H, D, Sq, Sk = 1, 2, 16, 8, 4
+    q = jnp.asarray(rng.normal(size=(B, H, Sq, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, Sk, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, Sk, D)), jnp.float32)
+    # causal_offset = Sk - Sq = -4: rows 0-3 see no keys
+    for interp in (False, True):
+        out = flash_attention(q, k, v, causal=True, block_q=4, block_k=4,
+                              interpret=interp)
+        np.testing.assert_allclose(np.asarray(out[:, :, :4]), 0.0, atol=1e-6)
+        g = jax.grad(lambda q: jnp.sum(flash_attention(
+            q, k, v, causal=True, block_q=4, block_k=4,
+            interpret=interp) ** 2))(q)
+        assert np.all(np.isfinite(np.asarray(g)))
+        np.testing.assert_allclose(np.asarray(g[:, :, :4]), 0.0, atol=1e-6)
