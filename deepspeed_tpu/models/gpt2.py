@@ -47,6 +47,10 @@ class GPT2Config:
     use_flash_attention: bool = False
     tie_word_embeddings: bool = True
     tensor_parallel: bool = False  # Megatron-style TP param annotations
+    # pipeline parallelism: >1 pipelines the blocks over the `pipe` mesh
+    # axis (embedding/head replicate across stages — SURVEY §7 divergence)
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 0  # 0 -> pipeline_stages
 
     @property
     def head_dim(self) -> int:
@@ -175,6 +179,17 @@ class ScanBlock(nn.Module):
         return Block(self.config, name="block")(x, self.deterministic), None
 
 
+class PipeBlock(nn.Module):
+    """GPipe block adapter: ``(x) -> x`` with deterministic baked in."""
+
+    config: GPT2Config
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        return Block(self.config, name="block")(x, self.deterministic)
+
+
 class GPT2Model(nn.Module):
     config: GPT2Config
 
@@ -194,7 +209,16 @@ class GPT2Model(nn.Module):
         x = wte(input_ids) + wpe(jnp.arange(S)[None, :])
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
-        if cfg.scan_layers:
+        if cfg.pipeline_stages > 1:
+            from deepspeed_tpu.parallel.pipeline import GPipe
+
+            x = GPipe(
+                PipeBlock, (cfg, deterministic), n_layer=cfg.n_layer,
+                n_stages=cfg.pipeline_stages,
+                n_micro=cfg.pipeline_microbatches or cfg.pipeline_stages,
+                remat_policy=cfg.remat_policy if cfg.remat else "none",
+                name="h")(x)
+        elif cfg.scan_layers:
             block_cls = _maybe_remat(ScanBlock, cfg)
             x, _ = nn.scan(
                 block_cls,

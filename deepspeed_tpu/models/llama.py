@@ -45,6 +45,8 @@ class LlamaConfig:
     tensor_parallel: bool = False
     # sequence parallelism: "none", "ulysses" (all-to-all), "ring" (ppermute)
     sequence_parallel: str = "none"
+    pipeline_stages: int = 1               # see gpt2.GPT2Config
+    pipeline_microbatches: int = 0
 
     def __post_init__(self):
         assert self.sequence_parallel in ("none", "ulysses", "ring"), (
@@ -194,6 +196,18 @@ class LlamaBlock(nn.Module):
         return x + LlamaMLP(cfg, name="mlp")(h)
 
 
+class PipeLlamaBlock(nn.Module):
+    """GPipe block adapter: ``(x, positions) -> x``."""
+
+    config: LlamaConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, positions):
+        return LlamaBlock(self.config, name="block")(x, positions,
+                                                     self.deterministic)
+
+
 class ScanLlamaBlock(nn.Module):
     config: LlamaConfig
     deterministic: bool = True
@@ -224,7 +238,17 @@ class LlamaModel(nn.Module):
 
         from deepspeed_tpu.models.gpt2 import _maybe_remat
 
-        if cfg.scan_layers:
+        if cfg.pipeline_stages > 1:
+            from deepspeed_tpu.parallel.pipeline import GPipe
+
+            x = GPipe(
+                PipeLlamaBlock, (cfg, deterministic),
+                n_layer=cfg.num_hidden_layers,
+                n_stages=cfg.pipeline_stages,
+                n_micro=cfg.pipeline_microbatches or cfg.pipeline_stages,
+                remat_policy=cfg.remat_policy if cfg.remat else "none",
+                name="layers")(x, positions)
+        elif cfg.scan_layers:
             block_cls = _maybe_remat(ScanLlamaBlock, cfg)
             (x, _), _ = nn.scan(
                 block_cls,

@@ -1,0 +1,198 @@
+"""Pipeline parallelism, TPU-native.
+
+Re-design of the reference pipeline engine (``runtime/pipe/module.py:86
+PipelineModule``, ``runtime/pipe/schedule.py:189 TrainSchedule`` (1F1B),
+``runtime/pipe/engine.py:338 PipelineEngine.train_batch``, p2p meta
+handshake ``engine.py:928``).  The reference is an imperative instruction
+interpreter: per-rank 1F1B instruction streams issuing torch p2p sends/recvs
+between stage processes.  On TPU the whole pipeline compiles into ONE jitted
+program:
+
+- the transformer blocks become a stacked parameter tree ``[S, L/S, ...]``
+  whose stage axis is annotated onto the ``pipe`` mesh axis;
+- the microbatch schedule is a ``lax.scan`` over ``M + S - 1`` ticks of a
+  GPipe pipeline: every tick, all S stages run in parallel (each pipe rank
+  computes its stage), then the activation buffer rolls one stage forward —
+  ``jnp.roll`` on a pipe-sharded axis, which XLA lowers to the
+  ``collective-permute`` that ``p2p.send/recv`` does by hand;
+- the backward pipeline comes from AD through the scan: reverse-order ticks
+  with the transposed permute, no hand-written schedule.
+
+Why GPipe ticks instead of literal 1F1B: 1F1B exists to bound live
+activation memory in an eager runtime by interleaving hand-issued fwd/bwd
+micro-steps.  Under XLA the same bound comes from ``nn.remat`` over the
+stage body (stash = one stage input per in-flight microbatch) and the
+schedule itself is the compiler's; the bubble fraction (S-1)/(M+S-1) is
+identical.  Fill/drain ticks compute on zero buffers and are masked out of
+the collected outputs — that waste IS the pipeline bubble.
+
+Composition: batch (microbatch) dim stays sharded over ``data`` (DP/ZeRO),
+parameters keep TP annotations inside each block, and ZeRO claims dims the
+``pipe``/``tensor`` axes don't use — PP x DP x TP x ZeRO in one mesh.
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.parallel.topology import DATA_AXIS, PIPE_AXIS
+from deepspeed_tpu.utils.sharding import maybe_constrain
+
+
+def apply_pipeline_specs(params, base_specs):
+    """Overlay base PartitionSpecs for pipeline-stage parameters.
+
+    Stage-stacked leaves (path contains ``ticks/stages``) get their leading
+    (stage) dim sharded over ``pipe``.  Boxed (TP-annotated) leaves already
+    carry the axis name via flax metadata; this covers the un-annotated
+    case so PP models always stage-shard their parameters (the reference
+    ``PipelineModule`` builds only the local stage's layers —
+    ``pipe/module.py:86``; here the sharding achieves the same residency).
+    Returns a base-spec tree (creating one if ``base_specs`` is None); the
+    ZeRO plan then composes on the remaining dims.
+    """
+    import jax.tree_util as jtu
+    from jax.sharding import PartitionSpec as P
+
+    flat, treedef = jtu.tree_flatten_with_path(params)
+    if not any("ticks/stages" in _kp_str(kp) for kp, _ in flat):
+        return base_specs
+    if base_specs is None:
+        base_specs = jtu.tree_unflatten(treedef, [P()] * len(flat))
+    spec_flat = jtu.tree_flatten(
+        base_specs, is_leaf=lambda x: isinstance(x, P))[0]
+
+    out = []
+    for (kp, leaf), spec in zip(flat, spec_flat):
+        if "ticks/stages" in _kp_str(kp):
+            ndim = len(leaf.shape)
+            s = list(spec) + [None] * (ndim - len(spec))
+            used = {a for e in s if e is not None
+                    for a in ((e,) if isinstance(e, str) else e)}
+            if PIPE_AXIS not in used and s and s[0] is None:
+                s[0] = PIPE_AXIS
+            out.append(P(*s))
+        else:
+            out.append(spec)
+    return jtu.tree_unflatten(treedef, out)
+
+
+def _kp_str(kp) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+
+
+class _LayerScan(nn.Module):
+    """Scan-over-layers adapter: carry = (x, bcast)."""
+
+    block_cls: Any
+    block_args: Tuple
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, bcast = carry
+        x = self.block_cls(*self.block_args, name="block")(x, *bcast)
+        return (x, bcast), None
+
+
+class _Stage(nn.Module):
+    """One pipeline stage: L/S sequential blocks (params [L/S, ...])."""
+
+    block_cls: Any
+    block_args: Tuple
+    layers_per_stage: int
+    remat_policy: str
+
+    @nn.compact
+    def __call__(self, x, *bcast):
+        body = _LayerScan
+        if self.remat_policy != "none":
+            from deepspeed_tpu.models.gpt2 import remat_policy_fn
+
+            body = nn.remat(_LayerScan, prevent_cse=False,
+                            policy=remat_policy_fn(self.remat_policy))
+        (x, _), _ = nn.scan(
+            body,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            length=self.layers_per_stage,
+            metadata_params={nn.PARTITION_NAME: "layers"},
+        )(self.block_cls, self.block_args, name="layers")((x, bcast), None)
+        return x
+
+
+class _Tick(nn.Module):
+    """One pipeline tick: run all stages, shift the activation ring."""
+
+    block_cls: Any
+    block_args: Tuple
+    layers_per_stage: int
+    n_stages: int
+    remat_policy: str
+
+    @nn.compact
+    def __call__(self, carry, inp):
+        state, bcast = carry                       # prev tick's outputs [S,..]
+        # ring shift stage s -> s+1 (collective-permute over `pipe`) and
+        # feed this tick's microbatch into stage 0 — shift BEFORE compute so
+        # microbatch t enters stage 0 at tick t and exits at tick t + S - 1
+        staged = jnp.roll(state, 1, axis=0).at[0].set(inp)
+        staged = maybe_constrain(
+            staged, (PIPE_AXIS, DATA_AXIS) + (None,) * (staged.ndim - 2))
+        stage = nn.vmap(
+            _Stage,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=(0,) + (None,) * len(bcast),
+            metadata_params={nn.PARTITION_NAME: PIPE_AXIS},
+        )(self.block_cls, self.block_args, self.layers_per_stage,
+          self.remat_policy, name="stages")
+        out = stage(staged, *bcast)                # [S, mb, ...]
+        out = maybe_constrain(
+            out, (PIPE_AXIS, DATA_AXIS) + (None,) * (out.ndim - 2))
+        return (out, bcast), out[-1]               # finished microbatch
+
+
+class GPipe(nn.Module):
+    """Pipeline ``n_layer`` blocks over ``n_stages`` pipe ranks with
+    ``n_micro`` microbatches.  ``block_cls(*block_args)(x, *bcast) -> x``
+    is one transformer block; ``bcast`` values (e.g. RoPE positions) are
+    broadcast to every stage and tick.
+    """
+
+    block_cls: Any
+    block_args: Tuple
+    n_layer: int
+    n_stages: int
+    n_micro: int
+    remat_policy: str = "none"
+
+    @nn.compact
+    def __call__(self, x, *bcast):
+        S, M, L = self.n_stages, self.n_micro, self.n_layer
+        assert L % S == 0, f"n_layer {L} not divisible by stages {S}"
+        B = x.shape[0]
+        assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+        mb = B // M
+        xm = x.reshape(M, mb, *x.shape[1:])
+        T = M + S - 1                              # ticks incl. fill/drain
+        inputs = jnp.concatenate(
+            [xm, jnp.zeros((S - 1,) + xm.shape[1:], xm.dtype)], axis=0)
+
+        state0 = jnp.zeros((S, mb) + x.shape[1:], x.dtype)
+        state0 = maybe_constrain(
+            state0, (PIPE_AXIS, DATA_AXIS) + (None,) * (state0.ndim - 2))
+
+        (_, _), outs = nn.scan(
+            _Tick,
+            variable_broadcast="params",
+            split_rngs={"params": False, "dropout": True},
+            length=T,
+        )(self.block_cls, self.block_args, L // S, S, self.remat_policy,
+          name="ticks")((state0, tuple(bcast)), inputs)
+
+        # microbatch m exits the last stage at tick m + S - 1
+        out = outs[S - 1:]                         # [M, mb, ...]
+        return out.reshape((B,) + out.shape[2:])

@@ -204,6 +204,11 @@ class DeepSpeedEngine:
                 model_parameters, topology.tensor_parallel_size)
             log_dist("AutoTP: inferred tensor-parallel sharding from "
                      "parameter names", ranks=[0])
+        # pipeline-stage params: stage dim -> `pipe` axis (no-op otherwise)
+        from deepspeed_tpu.parallel.pipeline import apply_pipeline_specs
+
+        self.base_specs = apply_pipeline_specs(model_parameters,
+                                               self.base_specs)
 
         # -- ZeRO sharding plan + state materialization -------------------
         zcfg = config.zero_optimization

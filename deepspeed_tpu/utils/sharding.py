@@ -1,0 +1,22 @@
+"""Sharding-constraint helper shared by layers that need explicit GSPMD
+placement (MoE dispatch, pipeline state)."""
+from __future__ import annotations
+
+import jax
+
+
+def maybe_constrain(x: jax.Array, spec) -> jax.Array:
+    """``with_sharding_constraint`` against the installed topology's mesh;
+    no-op when no topology is initialized (meshless unit tests)."""
+    try:
+        import deepspeed_tpu.comm as dist
+
+        topo = dist.get_topology()
+        if topo is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(topo.mesh, P(*spec)))
+    except Exception:
+        return x

@@ -1,0 +1,183 @@
+"""Pipeline-parallel tests (reference: tests/unit/pipe/test_pipe.py,
+runtime/pipe/schedule.py TrainSchedule semantics).
+
+The reference asserts 1F1B pipelined training matches the unpipelined
+baseline (test_pipe.py topology sweeps); here the GPipe scan must match a
+plain sequential stack bit-for-bit given identical parameters, and an
+engine run on a ``pipe``-axis mesh must shard stage params and train.
+"""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMLoss
+from deepspeed_tpu.parallel.pipeline import GPipe, apply_pipeline_specs
+
+
+class ToyBlock(nn.Module):
+    """A residual MLP block: distinct params per layer matter."""
+
+    width: int
+
+    @nn.compact
+    def __call__(self, x):
+        return x + nn.Dense(self.width)(nn.gelu(nn.Dense(self.width)(x)))
+
+
+class ToyBcastBlock(nn.Module):
+    """Block taking a broadcast operand (like RoPE positions)."""
+
+    width: int
+
+    @nn.compact
+    def __call__(self, x, scale):
+        return x + scale * nn.Dense(self.width)(x)
+
+
+def _stacked_to_layers(params):
+    """GPipe params [S, L/S, ...] -> list of L per-layer param trees."""
+    flat = jax.tree_util.tree_map(
+        lambda a: a.reshape((-1,) + a.shape[2:]), params)
+    n_layer = jax.tree_util.tree_leaves(flat)[0].shape[0]
+    return [jax.tree_util.tree_map(lambda a, i=i: a[i], flat)
+            for i in range(n_layer)]
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 2), (4, 8)])
+def test_gpipe_matches_sequential(devices, n_stages, n_micro):
+    W, L, B = 16, 8, 8
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(B, 4, W)),
+                    jnp.float32)
+    pipe = GPipe(ToyBlock, (W,), n_layer=L, n_stages=n_stages,
+                 n_micro=n_micro)
+    params = pipe.init(jax.random.PRNGKey(0), x)
+    out = pipe.apply(params, x)
+
+    # same params applied sequentially, one layer at a time
+    block = ToyBlock(W)
+    layers = _stacked_to_layers(
+        params["params"]["ticks"]["stages"]["layers"])
+    ref = x
+    for lp in layers:
+        ref = block.apply({"params": lp["block"]}, ref)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gpipe_broadcast_operand(devices):
+    W, L = 8, 4
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 3, W)),
+                    jnp.float32)
+    scale = jnp.float32(0.5)
+    pipe = GPipe(ToyBcastBlock, (W,), n_layer=L, n_stages=2, n_micro=2)
+    params = pipe.init(jax.random.PRNGKey(0), x, scale)
+    out = pipe.apply(params, x, scale)
+    block = ToyBcastBlock(W)
+    ref = x
+    for lp in _stacked_to_layers(
+            params["params"]["ticks"]["stages"]["layers"]):
+        ref = block.apply({"params": lp["block"]}, ref, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gpipe_gradients_match_sequential(devices):
+    """AD through the pipeline scan == AD through the plain stack."""
+    W, L = 8, 4
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(4, 3, W)),
+                    jnp.float32)
+    pipe = GPipe(ToyBlock, (W,), n_layer=L, n_stages=2, n_micro=2)
+    params = pipe.init(jax.random.PRNGKey(3), x)
+
+    def pipe_loss(p):
+        return jnp.sum(pipe.apply(p, x) ** 2)
+
+    def seq_loss(p):
+        block = ToyBlock(W)
+        stacked = p["params"]["ticks"]["stages"]["layers"]
+        flat = jax.tree_util.tree_map(
+            lambda a: a.reshape((-1,) + a.shape[2:]), stacked)
+
+        def body(h, lp):
+            return block.apply({"params": lp["block"]}, h), None
+
+        h, _ = jax.lax.scan(body, x, flat)
+        return jnp.sum(h ** 2)
+
+    np.testing.assert_allclose(pipe_loss(params), seq_loss(params),
+                               rtol=1e-5)
+    g_pipe = jax.grad(pipe_loss)(params)
+    g_seq = jax.grad(seq_loss)(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+        g_pipe, g_seq)
+
+
+def _pp_cfg(**kw):
+    return GPT2Config(vocab_size=128, n_positions=32, n_embd=64, n_layer=4,
+                      n_head=4, dtype=jnp.float32, param_dtype=jnp.float32,
+                      remat=False, **kw)
+
+
+def _ds_cfg(stage=0):
+    return {
+        "train_batch_size": 8,
+        "gradient_accumulation_steps": 1,
+        "zero_optimization": {"stage": stage},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3,
+                                                  "fused": False}},
+        "steps_per_print": 10000,
+    }
+
+
+def test_engine_pp_params_sharded_on_pipe_axis(devices):
+    topo = dist.initialize_mesh(dp=4, pp=2)
+    rng = np.random.default_rng(5)
+    batch = {"input_ids": rng.integers(0, 128, size=(8, 32),
+                                       dtype=np.int32)}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=GPT2LMLoss(_pp_cfg(pipeline_stages=2)), config=_ds_cfg(0),
+        topology=topo, example_batch=batch, rng=jax.random.PRNGKey(0))
+    flat = jax.tree_util.tree_flatten_with_path(engine.state.params)[0]
+    pipe_sharded = [kp for kp, l in flat
+                    if "pipe" in str(l.sharding.spec)]
+    assert pipe_sharded, "no param sharded over the pipe axis"
+    # stage-stacked block kernels live under ticks/stages
+    assert any("stages" in "/".join(map(str, kp)) for kp in pipe_sharded)
+    losses = [float(jax.device_get(engine.train_batch(batch=batch)))
+              for _ in range(4)]
+    assert losses[-1] < losses[0]
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_engine_pp_zero1_tp_composes(devices):
+    """pp=2 x tp=2 x dp=2 with ZeRO-1: the full 3D-parallel stack."""
+    topo = dist.initialize_mesh(dp=2, tp=2, pp=2)
+    rng = np.random.default_rng(6)
+    batch = {"input_ids": rng.integers(0, 128, size=(8, 32),
+                                       dtype=np.int32)}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=GPT2LMLoss(_pp_cfg(pipeline_stages=2, tensor_parallel=True)),
+        config=_ds_cfg(1), topology=topo, example_batch=batch,
+        rng=jax.random.PRNGKey(0))
+    flat = jax.tree_util.tree_flatten_with_path(engine.state.params)[0]
+    specs = {"/".join(str(getattr(k, "key", k)) for k in kp):
+             str(l.sharding.spec) for kp, l in flat}
+    assert any("pipe" in s for s in specs.values())
+    assert any("tensor" in s for s in specs.values())
+    losses = [float(jax.device_get(engine.train_batch(batch=batch)))
+              for _ in range(3)]
+    assert losses[-1] < losses[0]
+
+
+def test_apply_pipeline_specs_no_op_without_stages(devices):
+    from jax.sharding import PartitionSpec as P
+    params = {"dense": {"kernel": np.zeros((4, 4))}}
+    assert apply_pipeline_specs(params, None) is None
+    base = {"dense": {"kernel": P(None, "tensor")}}
+    assert apply_pipeline_specs(params, base) is base
