@@ -121,6 +121,12 @@ def get_topology() -> MeshTopology:
     return _state.topology
 
 
+def peek_topology() -> Optional[MeshTopology]:
+    """The installed topology, or None — never auto-installs a default mesh
+    (unlike ``get_topology``)."""
+    return _state.topology
+
+
 def get_mesh() -> Mesh:
     return get_topology().mesh
 

@@ -27,25 +27,9 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.moe.sharded_moe import moe_combine, moe_dispatch, topkgating
+from deepspeed_tpu.utils.sharding import maybe_constrain as _maybe_constrain
 
 EXPERT_AXIS = "expert"
-
-
-def _maybe_constrain(x: jax.Array, spec) -> jax.Array:
-    """Sharding-constrain when a mesh is installed (no-op in meshless
-    tests); this is what makes GSPMD emit the dispatch all-to-all."""
-    try:
-        import deepspeed_tpu.comm as dist
-
-        topo = dist.get_topology()
-        if topo is None:
-            return x
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        return jax.lax.with_sharding_constraint(
-            x, NamedSharding(topo.mesh, P(*spec)))
-    except Exception:
-        return x
 
 
 class MoE(nn.Module):

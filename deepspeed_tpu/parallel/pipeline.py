@@ -80,6 +80,33 @@ def apply_pipeline_specs(params, base_specs):
     return jtu.tree_unflatten(treedef, out)
 
 
+def validate_pipeline_layout(params, topology) -> None:
+    """Catch stage-count/mesh mismatches at setup instead of deep inside
+    GSPMD.  The reference fails equivalently in ``PipelineModule`` when
+    ``num_stages`` doesn't divide the topology (``pipe/module.py:144``)."""
+    import jax.tree_util as jtu
+
+    from deepspeed_tpu.utils.logging import logger
+
+    pp = topology.pipe_parallel_size
+    stage_dims = {leaf.shape[0]
+                  for kp, leaf in jtu.tree_flatten_with_path(params)[0]
+                  if "ticks/stages" in _kp_str(kp)}
+    if not stage_dims:
+        if pp > 1:
+            logger.warning(
+                f"mesh has pipe={pp} but the model has no pipeline-stage "
+                "parameters (pipeline_stages<=1?): the whole computation "
+                "will be REPLICATED across the pipe axis, wasting "
+                f"{pp - 1}/{pp} of the devices")
+        return
+    n_stages = max(stage_dims)
+    if pp > 1 and n_stages % pp != 0:
+        raise ValueError(
+            f"model pipeline_stages={n_stages} is not divisible by the "
+            f"mesh pipe axis size {pp}")
+
+
 def _kp_str(kp) -> str:
     return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
 

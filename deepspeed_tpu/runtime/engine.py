@@ -205,10 +205,12 @@ class DeepSpeedEngine:
             log_dist("AutoTP: inferred tensor-parallel sharding from "
                      "parameter names", ranks=[0])
         # pipeline-stage params: stage dim -> `pipe` axis (no-op otherwise)
-        from deepspeed_tpu.parallel.pipeline import apply_pipeline_specs
+        from deepspeed_tpu.parallel.pipeline import (apply_pipeline_specs,
+                                                     validate_pipeline_layout)
 
         self.base_specs = apply_pipeline_specs(model_parameters,
                                                self.base_specs)
+        validate_pipeline_layout(model_parameters, topology)
 
         # -- ZeRO sharding plan + state materialization -------------------
         zcfg = config.zero_optimization

@@ -7,16 +7,18 @@ import jax
 
 def maybe_constrain(x: jax.Array, spec) -> jax.Array:
     """``with_sharding_constraint`` against the installed topology's mesh;
-    no-op when no topology is initialized (meshless unit tests)."""
-    try:
-        import deepspeed_tpu.comm as dist
+    no-op when no topology is installed (meshless unit tests) or when the
+    mesh lacks one of the spec's axes."""
+    import deepspeed_tpu.comm as dist
 
-        topo = dist.get_topology()
-        if topo is None:
-            return x
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        return jax.lax.with_sharding_constraint(
-            x, NamedSharding(topo.mesh, P(*spec)))
-    except Exception:
+    topo = dist.peek_topology()
+    if topo is None:
         return x
+    axes = {a for e in spec if e is not None
+            for a in ((e,) if isinstance(e, str) else e)}
+    if not axes.issubset(set(topo.mesh.axis_names)):
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(topo.mesh, P(*spec)))
