@@ -19,3 +19,10 @@ def initialize(*args, **kwargs):
     from deepspeed_tpu.runtime.engine import initialize as _init
 
     return _init(*args, **kwargs)
+
+
+def init_inference(*args, **kwargs):
+    """Create an inference engine (reference ``deepspeed.init_inference``)."""
+    from deepspeed_tpu.inference.engine import init_inference as _init
+
+    return _init(*args, **kwargs)

@@ -1,0 +1,6 @@
+from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
+                                            load_inference_config)
+from deepspeed_tpu.inference.engine import InferenceEngine, init_inference
+
+__all__ = ["DeepSpeedInferenceConfig", "load_inference_config",
+           "InferenceEngine", "init_inference"]

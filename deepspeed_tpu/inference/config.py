@@ -1,0 +1,74 @@
+"""Inference config.
+
+Mirrors the reference ``DeepSpeedInferenceConfig``
+(``deepspeed/inference/config.py``) with the same JSON key names where the
+knob exists on TPU.  GPU-only knobs (kernel injection, CUDA graphs) are
+accepted and warned about: under XLA every jitted function IS a captured
+graph and the fused kernels are the Pallas/XLA ops the models already use,
+so there is nothing to inject.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+from pydantic import Field, model_validator
+
+from deepspeed_tpu.config.config_utils import ConfigModel
+from deepspeed_tpu.utils.logging import logger
+
+
+class InferenceTPConfig(ConfigModel):
+    """``tensor_parallel`` subtree (reference ``DeepSpeedTPConfig``)."""
+
+    enabled: bool = True
+    tp_size: int = 1
+
+
+class QuantConfig(ConfigModel):
+    """Weight quantization for serving (reference ``QuantizationConfig``):
+    int8 group-wise via ops/quantization.py; weights are stored quantized
+    and dequantized on the fly in the matmul's prologue."""
+
+    enabled: bool = False
+    qtype: str = "int8"          # "int8" | "fp8"
+    group_size: int = 128
+
+
+class DeepSpeedInferenceConfig(ConfigModel):
+    """Top-level inference config (``deepspeed.init_inference`` arg)."""
+
+    dtype: str = "bfloat16"                 # bfloat16 | float16 | float32
+    tensor_parallel: InferenceTPConfig = Field(
+        default_factory=InferenceTPConfig, alias="tp")
+    max_out_tokens: int = 1024              # KV-cache length bound
+    min_out_tokens: int = 1
+    replace_with_kernel_inject: bool = False
+    enable_cuda_graph: bool = False
+    max_batch_size: int = 0                 # 0 = unbounded (shape-compiled)
+    quant: QuantConfig = Field(default_factory=QuantConfig)
+    # reference knobs accepted for config compat, consumed elsewhere
+    replace_method: str = "auto"
+    checkpoint: Optional[str] = None
+
+    @model_validator(mode="after")
+    def _warn_gpu_only(self):
+        if self.replace_with_kernel_inject:
+            logger.warning(
+                "replace_with_kernel_inject=True is a no-op on TPU: the "
+                "models already run fused Pallas/XLA kernels; AutoTP-style "
+                "sharding is applied regardless")
+        if self.enable_cuda_graph:
+            logger.warning(
+                "enable_cuda_graph is a no-op on TPU: every jitted "
+                "function is a captured XLA program")
+        return self
+
+
+def load_inference_config(
+        config: Union[None, Dict[str, Any], DeepSpeedInferenceConfig],
+        **kwargs) -> DeepSpeedInferenceConfig:
+    if isinstance(config, DeepSpeedInferenceConfig):
+        return config
+    merged = dict(config or {})
+    merged.update(kwargs)
+    return DeepSpeedInferenceConfig(**merged)

@@ -1,0 +1,262 @@
+"""Inference engine (v1-equivalent).
+
+TPU-native re-design of the reference ``InferenceEngine``
+(``deepspeed/inference/engine.py:40``, entry ``deepspeed.init_inference``,
+``deepspeed/__init__.py:291``).  The reference wraps an HF torch module,
+injects fused CUDA kernel containers or AutoTP-shards it, optionally
+captures a CUDA graph, and defers generation to HF ``generate``.  Here:
+
+- kernel injection collapses: the flax models already run the fused
+  Pallas/XLA ops (``replace_with_kernel_inject`` warns and no-ops);
+- TP sharding is the same GSPMD story as training: param PartitionSpecs
+  from flax metadata or AutoTP name rules, over the ``tensor`` mesh axis;
+- the CUDA graph is the jit: prefill, decode step, and the whole generate
+  loop (a ``lax.scan`` over decode steps with the KV cache as carry)
+  compile into single XLA programs per shape;
+- generation is native: greedy/temperature/top-k/top-p sampling fused into
+  the loop (``inference/sampling.py``), KV cache per layer
+  (``inference/kv_cache.py``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
+                                            load_inference_config)
+from deepspeed_tpu.inference.kv_cache import init_cache
+from deepspeed_tpu.inference.sampling import sample_logits
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+_DTYPES = {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+           "float16": jnp.float16, "fp16": jnp.float16,
+           "float32": jnp.float32, "fp32": jnp.float32}
+
+
+def init_inference(model: Any, config: Any = None, params: Any = None,
+                   topology=None, rng: Optional[jax.Array] = None,
+                   **kwargs) -> "InferenceEngine":
+    """Create an :class:`InferenceEngine` (reference
+    ``deepspeed.init_inference``, ``deepspeed/__init__.py:291``).
+
+    ``model``: a flax causal-LM module returning ``[B, S, V]`` logits (or a
+    ``(logits, aux)`` tuple, e.g. Mixtral).  If its ``config`` dataclass has
+    a ``decode`` field, a decode-mode twin is constructed automatically.
+    ``params``: trained parameters; randomly initialized when omitted
+    (benchmarking).
+    """
+    cfg = load_inference_config(config, **kwargs)
+    return InferenceEngine(model, cfg, params=params, topology=topology,
+                           rng=rng)
+
+
+class InferenceEngine:
+    def __init__(self, model, config: DeepSpeedInferenceConfig, params=None,
+                 topology=None, rng: Optional[jax.Array] = None):
+        self.config = config
+        self.dtype = _DTYPES[config.dtype]
+        self.module = model                      # API parity with reference
+
+        tp_size = config.tensor_parallel.tp_size if config.tensor_parallel.enabled else 1
+        dist.init_distributed()
+        if topology is None:
+            topology = (dist.initialize_mesh(tp=tp_size) if tp_size > 1
+                        else dist.get_topology())
+        else:
+            dist.set_topology(topology)
+        self.topology = topology
+        self.mesh = topology.mesh
+
+        # decode-mode twin of the model (KV cache threaded through attention)
+        mcfg = getattr(model, "config", None)
+        if (dataclasses.is_dataclass(mcfg) and
+                any(f.name == "decode" for f in dataclasses.fields(mcfg))):
+            # learned/rotary position tables bound usable positions; clamp
+            # the cache so generate() can't run past them into silently
+            # clamped embedding gathers
+            pos_bound = (getattr(mcfg, "n_positions", None) or
+                         getattr(mcfg, "max_position_embeddings", None))
+            cache_len = getattr(mcfg, "max_cache_len", 0) or config.max_out_tokens
+            if pos_bound is not None and cache_len > pos_bound:
+                logger.warning(
+                    f"max_out_tokens={cache_len} exceeds the model's "
+                    f"position bound {pos_bound}; clamping the KV cache")
+                cache_len = pos_bound
+            dcfg = dataclasses.replace(
+                mcfg, decode=True, dtype=self.dtype,
+                max_cache_len=cache_len)
+            self._decode_model = type(model)(dcfg)
+            self._plain_model = (model if mcfg.dtype == self.dtype
+                                 else type(model)(
+                                     dataclasses.replace(mcfg,
+                                                         dtype=self.dtype)))
+            self.max_cache_len = dcfg.max_cache_len
+        else:
+            raise TypeError(
+                "init_inference needs a model whose config dataclass has a "
+                "'decode' field (models/gpt2.py, models/llama.py, "
+                "models/mixtral.py do)")
+
+        # -- params: init if absent, cast to serving dtype, TP-shard -------
+        from deepspeed_tpu.parallel import tensor_parallel as tp_lib
+
+        if params is None:
+            if rng is None:
+                rng = jax.random.PRNGKey(0)
+            dummy = np.zeros((1, 8), np.int32)
+            params = jax.jit(self._plain_model.init)(rng, dummy)
+            log_dist("init_inference: params randomly initialized "
+                     "(none provided)", ranks=[0])
+        if isinstance(params, dict) and "params" in params:
+            params = params["params"]
+
+        specs = None
+        if tp_lib.has_partitioning(params):
+            specs = tp_lib.extract_partition_specs({"params": params},
+                                                   self.mesh.axis_names)
+            specs = specs["params"]
+            params = tp_lib.unbox_params(params)
+        elif topology.tensor_parallel_size > 1:
+            specs = tp_lib.auto_tp_specs(params,
+                                         topology.tensor_parallel_size)
+            log_dist("init_inference AutoTP: inferred tensor-parallel "
+                     "sharding from parameter names", ranks=[0])
+
+        if config.quant.enabled:
+            logger.warning("inference weight quantization is not applied "
+                           "in-engine yet; serving in %s", config.dtype)
+
+        def cast(x):
+            x = jnp.asarray(x)
+            return x.astype(self.dtype) if jnp.issubdtype(
+                x.dtype, jnp.floating) else x
+
+        params = jax.tree_util.tree_map(cast, params)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if specs is not None:
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(self.mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P))
+            params = jax.tree_util.tree_map(jax.device_put, params,
+                                            shardings)
+        else:
+            params = jax.device_put(params,
+                                    NamedSharding(self.mesh, P()))
+        self.params = params
+
+        self._generate_cache: Dict[Tuple, Any] = {}
+        self._forward_fn = None
+        self._cache_shapes: Dict[int, Any] = {}
+        log_dist(f"InferenceEngine: dtype={config.dtype} tp={tp_size} "
+                 f"max_cache_len={self.max_cache_len}", ranks=[0])
+
+    # ------------------------------------------------------------------
+
+    def _logits(self, out):
+        return out[0] if isinstance(out, tuple) else out
+
+    def _zero_cache_shapes(self, B: int, S: int):
+        if B not in self._cache_shapes:
+            self._cache_shapes[B] = jax.tree_util.tree_map(
+                lambda l: (l.shape, l.dtype),
+                init_cache(self._decode_model, np.zeros((B, S), np.int32)))
+        return self._cache_shapes[B]
+
+    def forward(self, input_ids) -> jax.Array:
+        """Full-sequence logits (reference ``InferenceEngine.forward``,
+        ``engine.py:554``) — no KV cache, one fused program."""
+        if self._forward_fn is None:
+            model = self._plain_model
+
+            def fwd(params, ids):
+                return self._logits(model.apply({"params": params}, ids))
+
+            self._forward_fn = jax.jit(fwd)
+        return self._forward_fn(self.params, jnp.asarray(input_ids))
+
+    __call__ = forward
+
+    # ------------------------------------------------------------------
+
+    def _build_generate(self, B: int, P: int, max_new: int, do_sample: bool,
+                        temperature: float, top_k: int, top_p: float,
+                        eos_id: Optional[int]):
+        model = self._decode_model
+        logits_of = self._logits
+        cache_shapes = self._zero_cache_shapes(B, P)
+
+        def sample(lg, rng):
+            return sample_logits(lg, rng, do_sample=do_sample,
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p)
+
+        def gen(params, prompt, rng):
+            cache = jax.tree_util.tree_map(
+                lambda sd: jnp.zeros(*sd), cache_shapes,
+                is_leaf=lambda x: isinstance(x, tuple))
+            out, vars_ = model.apply(
+                {"params": params, "cache": cache}, prompt,
+                positions=jnp.arange(P), mutable=["cache"])
+            cache = vars_["cache"]
+            rng, sub = jax.random.split(rng)
+            tok = sample(logits_of(out)[:, -1], sub)
+            done = (jnp.zeros((B,), bool) if eos_id is None
+                    else tok == eos_id)
+
+            def step(carry, _):
+                cache, tok, pos, rng, done = carry
+                out, vars_ = model.apply(
+                    {"params": params, "cache": cache}, tok[:, None],
+                    positions=pos[None, None], mutable=["cache"])
+                rng, sub = jax.random.split(rng)
+                nxt = sample(logits_of(out)[:, -1], sub)
+                if eos_id is not None:
+                    nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+                    done = done | (nxt == eos_id)
+                return (vars_["cache"], nxt, pos + 1, rng, done), nxt
+
+            (_, _, _, _, done), toks = jax.lax.scan(
+                step, (cache, tok, jnp.int32(P), rng, done),
+                length=max_new - 1)
+            new = jnp.concatenate([tok[:, None], toks.T], axis=1)
+            return jnp.concatenate([prompt, new.astype(prompt.dtype)],
+                                   axis=1)
+
+        return jax.jit(gen)
+
+    def generate(self, input_ids, max_new_tokens: int = 128,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None,
+                 rng: Optional[jax.Array] = None) -> np.ndarray:
+        """Autoregressive generation: prefill + ``max_new_tokens`` fused
+        decode steps in one compiled program per (batch, prompt-len,
+        max-new) shape.  Returns ``[B, P + max_new_tokens]`` token ids."""
+        prompt = jnp.asarray(np.asarray(input_ids), jnp.int32)
+        assert prompt.ndim == 2, "input_ids must be [batch, prompt_len]"
+        B, P = prompt.shape
+        if self.config.max_batch_size and B > self.config.max_batch_size:
+            raise ValueError(f"batch {B} exceeds max_batch_size "
+                             f"{self.config.max_batch_size}")
+        assert max_new_tokens >= self.config.min_out_tokens, (
+            f"max_new_tokens {max_new_tokens} < min_out_tokens "
+            f"{self.config.min_out_tokens}")
+        assert P + max_new_tokens <= self.max_cache_len, (
+            f"prompt {P} + max_new_tokens {max_new_tokens} exceeds "
+            f"max_cache_len {self.max_cache_len} (raise max_out_tokens)")
+        key = (B, P, max_new_tokens, do_sample, temperature, top_k, top_p,
+               eos_token_id)
+        if key not in self._generate_cache:
+            self._generate_cache[key] = self._build_generate(
+                B, P, max_new_tokens, do_sample, temperature, top_k, top_p,
+                eos_token_id)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return np.asarray(jax.device_get(
+            self._generate_cache[key](self.params, prompt, rng)))

@@ -1,0 +1,86 @@
+"""KV cache for autoregressive decode.
+
+TPU-native re-design of the reference's inference KV-cache machinery
+(v1: ``csrc/transformer/inference/includes/inference_context.h`` workspace
+slabs + per-layer K/V pointers; v2: blocked ragged KV in
+``inference/v2/ragged/``).  Here the cache is an explicit flax ``"cache"``
+variable collection: one ``[B, Hkv, max_len, Dh]`` buffer pair per attention
+layer (stacked ``[L, ...]`` under the model's ``nn.scan``), updated in place
+with ``dynamic_update_slice`` and threaded functionally through the jitted
+generate loop — no pointer arithmetic, no allocator; XLA double-buffers the
+donated cache.
+
+Dense rectangular batches only (every sequence shares one length); the
+ragged/continuous-batching engine (FastGen equivalent) builds on top.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def update_kv_cache(mdl, k: jax.Array, v: jax.Array, max_len: int):
+    """Append this call's K/V ``[B, Hkv, S, Dh]`` to the layer's cache.
+
+    Returns ``(k_full, v_full, start)`` where the full buffers are
+    ``[B, Hkv, max_len, Dh]`` and ``start`` is the write offset (number of
+    tokens cached before this call).  Call inside an attention module with
+    ``mutable=["cache"]`` applies; ``model.init`` creates zeroed buffers.
+    """
+    B, Hkv, S, Dh = k.shape
+    assert S <= max_len, (
+        f"chunk of {S} tokens exceeds the {max_len}-slot cache; "
+        "dynamic_update_slice would clamp and silently corrupt it")
+    ck = mdl.variable("cache", "cached_key", jnp.zeros,
+                      (B, Hkv, max_len, Dh), k.dtype)
+    cv = mdl.variable("cache", "cached_value", jnp.zeros,
+                      (B, Hkv, max_len, Dh), v.dtype)
+    ci = mdl.variable("cache", "cache_index",
+                      lambda: jnp.zeros((), jnp.int32))
+    start = ci.value
+    ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, start, 0))
+    cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, start, 0))
+    ci.value = start + S
+    return ck.value, cv.value, start
+
+
+def cached_attention(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
+                     q_positions: jax.Array) -> jax.Array:
+    """Attention of ``q`` [B, H, S, Dh] against the full cache buffers
+    [B, Hkv, L, Dh], masking key slots beyond each query's absolute
+    position.  ``q_positions``: [S] or [B, S] absolute positions.  Used for
+    decode steps (S=1); prefill attends within its chunk via the normal
+    causal kernels.
+    """
+    B, H, S, Dh = q.shape
+    Hkv, L = k_full.shape[1], k_full.shape[2]
+    if Hkv != H:                                   # GQA: expand KV heads
+        rep = H // Hkv
+        k_full = jnp.repeat(k_full, rep, axis=1)
+        v_full = jnp.repeat(v_full, rep, axis=1)
+    att = jnp.einsum("bhsd,bhld->bhsl", q, k_full) / np.sqrt(Dh)
+    qpos = q_positions if q_positions.ndim == 2 else q_positions[None]
+    mask = jnp.arange(L)[None, None, None, :] <= qpos[:, None, :, None]
+    att = jnp.where(mask, att.astype(jnp.float32), jnp.float32(-1e30))
+    p = jax.nn.softmax(att, axis=-1).astype(v_full.dtype)
+    return jnp.einsum("bhsl,bhld->bhsd", p, v_full)
+
+
+def init_cache(model, example_ids: np.ndarray, positions=None):
+    """Zeroed cache pytree for ``model`` (decode-mode config) shaped for
+    ``example_ids`` [B, S], computed without materializing params."""
+    import numpy as _np
+
+    ids = jnp.asarray(_np.zeros(_np.asarray(example_ids).shape, _np.int32))
+
+    def _init():
+        kw = {} if positions is None else {"positions": positions}
+        return model.init(jax.random.PRNGKey(0), ids, **kw)
+
+    shapes = jax.eval_shape(_init)
+    assert "cache" in shapes, (
+        "model has no 'cache' collection — construct it with a decode=True "
+        "config (inference engine does this automatically)")
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])

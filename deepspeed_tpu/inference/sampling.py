@@ -1,0 +1,44 @@
+"""Token sampling for the generate loop.
+
+The reference defers sampling to HuggingFace ``generate`` (v1,
+``inference/engine.py:554``) or implements greedy/top-p in its ragged
+logits-gather kernels (v2).  Here sampling is a pure jittable function over
+the last-position logits so the whole generate loop stays inside one XLA
+program.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_logits(logits: jax.Array, rng: Optional[jax.Array], *,
+                  do_sample: bool = False, temperature: float = 1.0,
+                  top_k: int = 0, top_p: float = 1.0) -> jax.Array:
+    """Next token ids [B] from logits [B, V].
+
+    ``do_sample``/``top_k`` are static (change recompiles); temperature and
+    top_p are folded in as constants of the compiled program too since they
+    arrive as Python floats.
+    """
+    logits = logits.astype(jnp.float32)
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if temperature != 1.0:
+        logits = logits / jnp.float32(max(temperature, 1e-6))
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]      # [B, 1]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # desc
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens while the mass BEFORE them is < top_p (always >=1 kept)
+        keep_sorted = (cum - probs) < top_p
+        kth_idx = jnp.sum(keep_sorted, axis=-1, keepdims=True) - 1
+        cutoff = jnp.take_along_axis(sorted_logits, kth_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    assert rng is not None, "sampling needs an rng"
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -51,6 +51,15 @@ class GPT2Config:
     # axis (embedding/head replicate across stages — SURVEY §7 divergence)
     pipeline_stages: int = 1
     pipeline_microbatches: int = 0  # 0 -> pipeline_stages
+    # inference: thread a KV cache through attention (flax "cache"
+    # collection); max_cache_len=0 -> n_positions
+    decode: bool = False
+    max_cache_len: int = 0
+
+    def __post_init__(self):
+        if self.decode:
+            assert self.pipeline_stages <= 1, (
+                "decode mode does not compose with pipeline parallelism")
 
     @property
     def head_dim(self) -> int:
@@ -96,6 +105,20 @@ class CausalSelfAttention(nn.Module):
             return t.reshape(B, S, cfg.n_head, cfg.head_dim).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
+        if cfg.decode:
+            from deepspeed_tpu.inference.kv_cache import (cached_attention,
+                                                          update_kv_cache)
+
+            max_len = cfg.max_cache_len or cfg.n_positions
+            k_full, v_full, start = update_kv_cache(self, k, v, max_len)
+            if S == 1:                     # decode step: attend to the cache
+                y = cached_attention(q, k_full, v_full,
+                                     (start + jnp.arange(S))[None])
+                y = y.transpose(0, 2, 1, 3).reshape(B, S, E)
+                return nn.Dense(E, dtype=cfg.dtype,
+                                param_dtype=cfg.param_dtype, name="c_proj",
+                                **_tp_dense_kwargs(cfg, "row"))(y)
+            # prefill: cache written above; attend within the chunk below
         if cfg.use_flash_attention:
             assert cfg.dropout == 0.0 or deterministic, (
                 "flash attention has no attention-probability dropout; set "
@@ -194,7 +217,8 @@ class GPT2Model(nn.Module):
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, input_ids, deterministic: bool = True):
+    def __call__(self, input_ids, deterministic: bool = True,
+                 positions=None):
         cfg = self.config
         B, S = input_ids.shape
         from deepspeed_tpu.parallel.tensor_parallel import tp_embed_kwargs
@@ -206,7 +230,9 @@ class GPT2Model(nn.Module):
         wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype,
                        param_dtype=cfg.param_dtype, name="wpe",
                        **embed_kwargs)
-        x = wte(input_ids) + wpe(jnp.arange(S)[None, :])
+        if positions is None:
+            positions = jnp.arange(S)[None, :]
+        x = wte(input_ids) + wpe(positions)
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
         if cfg.pipeline_stages > 1:
@@ -220,9 +246,12 @@ class GPT2Model(nn.Module):
                 name="h")(x)
         elif cfg.scan_layers:
             block_cls = _maybe_remat(ScanBlock, cfg)
+            vaxes = {"params": 0}
+            if cfg.decode:
+                vaxes["cache"] = 0         # per-layer KV buffers, stacked
             x, _ = nn.scan(
                 block_cls,
-                variable_axes={"params": 0},
+                variable_axes=vaxes,
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layer,
                 metadata_params={nn.PARTITION_NAME: "layers"},

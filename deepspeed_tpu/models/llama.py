@@ -47,11 +47,20 @@ class LlamaConfig:
     sequence_parallel: str = "none"
     pipeline_stages: int = 1               # see gpt2.GPT2Config
     pipeline_microbatches: int = 0
+    # inference: thread a KV cache through attention (flax "cache"
+    # collection); max_cache_len=0 -> max_position_embeddings
+    decode: bool = False
+    max_cache_len: int = 0
 
     def __post_init__(self):
         assert self.sequence_parallel in ("none", "ulysses", "ring"), (
             f"sequence_parallel={self.sequence_parallel!r}: expected 'none', "
             "'ulysses' or 'ring'")
+        if self.decode:
+            assert self.sequence_parallel == "none", (
+                "decode mode does not compose with sequence parallelism")
+            assert self.pipeline_stages <= 1, (
+                "decode mode does not compose with pipeline parallelism")
 
     @property
     def head_dim(self) -> int:
@@ -144,6 +153,19 @@ class LlamaAttention(nn.Module):
         v = v.reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
+
+        if cfg.decode:
+            from deepspeed_tpu.inference.kv_cache import (cached_attention,
+                                                          update_kv_cache)
+
+            max_len = cfg.max_cache_len or cfg.max_position_embeddings
+            k_full, v_full, _ = update_kv_cache(self, k, v, max_len)
+            if S == 1:                     # decode step: attend to the cache
+                y = cached_attention(q, k_full, v_full, positions)
+                y = y.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
+                return nn.Dense(E, name="o_proj", **dense,
+                                **_tp_kwargs(cfg, "row"))(y)
+            # prefill: cache written above; attend within the chunk below
 
         if cfg.sequence_parallel == "ulysses":
             from deepspeed_tpu.sequence import ulysses_attention
@@ -250,9 +272,12 @@ class LlamaModel(nn.Module):
                 name="layers")(x, positions)
         elif cfg.scan_layers:
             block_cls = _maybe_remat(ScanLlamaBlock, cfg)
+            vaxes = {"params": 0}
+            if cfg.decode:
+                vaxes["cache"] = 0         # per-layer KV buffers, stacked
             (x, _), _ = nn.scan(
                 block_cls,
-                variable_axes={"params": 0},
+                variable_axes=vaxes,
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},

@@ -116,9 +116,12 @@ class MixtralModel(nn.Module):
 
         if cfg.scan_layers:
             block_cls = _maybe_remat(ScanMixtralBlock, cfg)
+            vaxes = {"params": 0}
+            if cfg.decode:
+                vaxes["cache"] = 0         # per-layer KV buffers, stacked
             (x, _, aux), _ = nn.scan(
                 block_cls,
-                variable_axes={"params": 0},
+                variable_axes=vaxes,
                 split_rngs={"params": True, "dropout": True, "gating": True},
                 length=cfg.num_hidden_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
