@@ -3,15 +3,15 @@
 Covers the reference's engine checkpoint path (``engine.py:3213
 save_checkpoint`` / ``:2867 load_checkpoint`` +
 ``runtime/checkpoint_engine/torch_checkpoint_engine.py``), redesigned for
-TPU: the canonical on-disk layout is **topology-independent** ("universal by
-default", SURVEY §5 checkpoint notes) — full unsharded host arrays keyed by
-pytree path, so a checkpoint written on any (dp, tp, pp) mesh loads onto any
-other; resharding happens on ``device_put`` against the destination
-topology's sharding plan.  The directory layout mirrors the reference
+TPU around the sharded, topology-independent store in
+``checkpoint/sharded.py`` (universal-by-default: any mesh loads any
+checkpoint; per-process shard writes bound host memory by the largest
+shard, not the model).  Async save (Nebula-equivalent,
+``nebula_checkpoint_engine.py``) runs file IO on a background thread after
+a synchronous D2H snapshot.  The directory layout mirrors the reference
 (``<dir>/<tag>/...`` + a ``latest`` file).
 
-Async save (Nebula-equivalent) and tensorstore/OCDBT streaming for
-beyond-host-memory models are planned extensions of this module.
+Legacy single-pickle checkpoints (the round-1 format) still load.
 """
 from __future__ import annotations
 
@@ -24,10 +24,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.checkpoint import sharded
 from deepspeed_tpu.runtime.train_state import TrainState
 from deepspeed_tpu.utils.logging import log_dist, logger
 
-MODEL_FILE = "model_states.pt"
+MODEL_FILE = "model_states.pt"          # legacy consolidated format
+EXTRA_FILE = "extra_states.pt"          # scalars + lr scheduler + client
 META_FILE = "ds_meta.json"
 LATEST_FILE = "latest"
 
@@ -36,57 +38,89 @@ def _tag_of(engine, tag: Optional[str]) -> str:
     return tag if tag is not None else f"global_step{engine.global_steps}"
 
 
+def _saver(engine) -> sharded.AsyncSaver:
+    if getattr(engine, "_ckpt_saver", None) is None:
+        engine._ckpt_saver = sharded.AsyncSaver()
+    return engine._ckpt_saver
+
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[Dict] = None,
-                    save_latest: bool = True) -> str:
+                    save_latest: bool = True,
+                    async_save: Optional[bool] = None) -> str:
+    """Sharded save.  Each process writes only its addressable shards
+    (never the consolidated state); with ``async_save`` (default from
+    ``checkpoint.async_save`` config) file IO runs on a background thread
+    and :func:`wait_checkpoint` / the next save joins it."""
+    if async_save is None:
+        async_save = engine.config.checkpoint.async_save
     tag = _tag_of(engine, tag)
     path = os.path.join(save_dir, tag)
     os.makedirs(path, exist_ok=True)
 
-    # single-writer: process 0 owns the canonical full-state file.  On
-    # multi-host meshes, sharded leaves span non-addressable devices; gather
-    # them to fully-replicated before the host transfer.
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
-        host_state: TrainState = multihost_utils.process_allgather(
-            engine.state)
-    else:
-        host_state = jax.device_get(engine.state)
-    ckpt = {
-        "module": host_state.params,
-        "optimizer": host_state.opt_state,
-        "loss_scale": host_state.scale,
-        "step": host_state.step,
-        "rng": host_state.rng,
-        "skipped_steps": host_state.skipped_steps,
+    _saver(engine).wait()                     # one in-flight save at a time
+    legacy = os.path.join(path, MODEL_FILE)
+    if os.path.exists(legacy) and jax.process_index() == 0:
+        os.remove(legacy)                     # would shadow the new format
+    # async: copy shards to host up front (training mutates/donates the
+    # state buffers); sync: stream shard-by-shard, bounded host memory
+    snap = sharded.save_tree(
+        {"module": engine.state.params, "optimizer": engine.state.opt_state},
+        path, materialize=bool(async_save))
+    extra = {
+        "loss_scale": jax.device_get(engine.state.scale),
+        "step": int(jax.device_get(engine.state.step)),
+        "rng": np.asarray(jax.device_get(engine.state.rng)),
+        "skipped_steps": int(jax.device_get(engine.state.skipped_steps)),
         "lr_scheduler": engine.lr_scheduler.state_dict(),
         "global_steps": engine.global_steps,
         "global_samples": engine.global_samples,
         "client_state": client_state or {},
     }
-    if jax.process_index() == 0:
-        with open(os.path.join(path, MODEL_FILE), "wb") as f:
-            pickle.dump(ckpt, f)
-        with open(os.path.join(path, META_FILE), "w") as f:
-            json.dump({
-                "tag": tag,
-                "zero_stage": engine.zero_stage,
-                "world_size": engine.topology.world_size,
-                "mesh": engine.topology.shape,
-                "dtype": str(engine.compute_dtype.__name__),
-            }, f, indent=2)
-        if save_latest:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(tag)
-    log_dist(f"saved checkpoint {path}", ranks=[0])
+    meta = {
+        "tag": tag,
+        "format": "sharded-v1",
+        "zero_stage": engine.zero_stage,
+        "world_size": engine.topology.world_size,
+        "process_count": jax.process_count(),
+        "mesh": engine.topology.shape,
+        "dtype": str(engine.compute_dtype.__name__),
+    }
+
+    def finish():
+        sharded.write_snapshot(snap)
+        if jax.process_index() == 0:
+            with open(os.path.join(path, EXTRA_FILE), "wb") as f:
+                pickle.dump(extra, f)
+            with open(os.path.join(path, META_FILE), "w") as f:
+                json.dump(meta, f, indent=2)
+            if save_latest:
+                # completeness is signalled by per-process done markers
+                # (sharded.is_complete), not by this pointer: other
+                # processes may still be writing their shards
+                with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                    f.write(tag)
+
+    if async_save:
+        _saver(engine).submit(finish)
+        log_dist(f"async checkpoint {path} snapshot taken; writing in "
+                 "background", ranks=[0])
+    else:
+        finish()
+        log_dist(f"saved checkpoint {path}", ranks=[0])
     return path
+
+
+def wait_checkpoint(engine) -> None:
+    """Join an in-flight async save (no-op otherwise)."""
+    _saver(engine).wait()
 
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True,
                     load_lr_scheduler_states: bool = True
                     ) -> Tuple[Optional[str], Optional[Dict]]:
+    _saver(engine).wait()
     if tag is None:
         latest = os.path.join(load_dir, LATEST_FILE)
         if not os.path.exists(latest):
@@ -95,53 +129,101 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         with open(latest) as f:
             tag = f.read().strip()
     path = os.path.join(load_dir, tag)
-    model_file = os.path.join(path, MODEL_FILE)
-    if not os.path.exists(model_file):
-        logger.warning(f"checkpoint file {model_file} missing; nothing loaded")
+    if not os.path.exists(os.path.join(path, EXTRA_FILE)):
+        # not the sharded format; fall back to the round-1 pickle
+        if os.path.exists(os.path.join(path, MODEL_FILE)):
+            return _load_legacy(engine, path, load_optimizer_states,
+                                load_lr_scheduler_states)
+        logger.warning(f"checkpoint {path} missing; nothing loaded")
         return None, None
 
-    with open(model_file, "rb") as f:
-        ckpt = pickle.load(f)
+    meta_path = os.path.join(path, META_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            saved_procs = json.load(f).get("process_count", 1)
+        if not sharded.is_complete(path, saved_procs):
+            raise RuntimeError(
+                f"checkpoint {path} is incomplete: not all of its "
+                f"{saved_procs} processes finished writing (crashed or "
+                "still-running save?)")
 
+    with open(os.path.join(path, EXTRA_FILE), "rb") as f:
+        extra = pickle.load(f)
+
+    shardings = engine._state_shardings
+    if load_optimizer_states:
+        tree = sharded.load_tree(
+            {"module": engine.state.params,
+             "optimizer": engine.state.opt_state},
+            {"module": shardings.params, "optimizer": shardings.opt_state},
+            path)
+        params, opt_state = tree["module"], tree["optimizer"]
+    else:
+        params = sharded.load_tree(
+            {"module": engine.state.params},
+            {"module": shardings.params}, path)["module"]
+        opt_state = engine.state.opt_state
+
+    engine.state = TrainState(
+        step=jnp.asarray(extra["step"], jnp.int32),
+        params=params,
+        opt_state=opt_state,
+        scale=jax.device_put(extra["loss_scale"]),
+        rng=jnp.asarray(extra["rng"]),
+        skipped_steps=jnp.asarray(extra["skipped_steps"], jnp.int32))
+    engine.global_steps = int(extra["global_steps"])
+    engine.global_samples = int(extra.get("global_samples", 0))
+    if load_lr_scheduler_states and engine.lr_scheduler is not None:
+        engine.lr_scheduler.load_state_dict(extra["lr_scheduler"])
+    log_dist(f"loaded checkpoint {path} (global_steps="
+             f"{engine.global_steps})", ranks=[0])
+    return path, extra.get("client_state")
+
+
+def _load_legacy(engine, path: str, load_optimizer_states: bool,
+                 load_lr_scheduler_states: bool):
+    """Round-1 consolidated-pickle format."""
+    with open(os.path.join(path, MODEL_FILE), "rb") as f:
+        ckpt = pickle.load(f)
     shardings = engine._state_shardings
     params = jax.tree_util.tree_map(jax.device_put, ckpt["module"],
                                     shardings.params)
-    if load_optimizer_states:
-        opt_state = jax.tree_util.tree_map(jax.device_put, ckpt["optimizer"],
-                                           shardings.opt_state)
-    else:
-        opt_state = engine.state.opt_state
-
-    scale = jax.device_put(ckpt["loss_scale"])
+    opt_state = (jax.tree_util.tree_map(jax.device_put, ckpt["optimizer"],
+                                        shardings.opt_state)
+                 if load_optimizer_states else engine.state.opt_state)
     engine.state = TrainState(
         step=jnp.asarray(ckpt["step"], jnp.int32),
         params=params,
         opt_state=opt_state,
-        scale=scale,
+        scale=jax.device_put(ckpt["loss_scale"]),
         rng=jnp.asarray(ckpt["rng"]),
         skipped_steps=jnp.asarray(ckpt["skipped_steps"], jnp.int32))
     engine.global_steps = int(ckpt["global_steps"])
     engine.global_samples = int(ckpt.get("global_samples", 0))
     if load_lr_scheduler_states and engine.lr_scheduler is not None:
         engine.lr_scheduler.load_state_dict(ckpt["lr_scheduler"])
-    log_dist(f"loaded checkpoint {path} (global_steps="
-             f"{engine.global_steps})", ranks=[0])
+    log_dist(f"loaded legacy checkpoint {path}", ranks=[0])
     return path, ckpt.get("client_state")
 
 
-def zero_to_fp32(checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
-    """Consolidated fp32 state dict from a checkpoint directory (the
-    reference's offline ``deepspeed/utils/zero_to_fp32.py:188``; trivial here
-    because the canonical format is already consolidated and
-    topology-independent)."""
+def zero_to_fp32(checkpoint_dir: str, tag: Optional[str] = None
+                 ) -> Dict[str, np.ndarray]:
+    """Consolidated fp32 state dict from a checkpoint directory (reference
+    offline ``deepspeed/utils/zero_to_fp32.py:188``).  Reads shard records
+    directly — no engine, no devices."""
     if tag is None:
         with open(os.path.join(checkpoint_dir, LATEST_FILE)) as f:
             tag = f.read().strip()
-    with open(os.path.join(checkpoint_dir, tag, MODEL_FILE), "rb") as f:
-        ckpt = pickle.load(f)
-    flat = {}
-    for kp, leaf in jax.tree_util.tree_flatten_with_path(ckpt["module"])[0]:
-        key = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
-                       for k in kp)
-        flat[key] = np.asarray(leaf, dtype=np.float32)
-    return flat
+    path = os.path.join(checkpoint_dir, tag)
+    if os.path.exists(os.path.join(path, MODEL_FILE)):   # legacy
+        with open(os.path.join(path, MODEL_FILE), "rb") as f:
+            ckpt = pickle.load(f)
+        flat = {}
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(
+                ckpt["module"])[0]:
+            flat[sharded.path_str(kp)] = np.asarray(leaf, dtype=np.float32)
+        return flat
+    full = sharded.read_full_tree(path)
+    prefix = "module/"
+    return {k[len(prefix):]: v.astype(np.float32)
+            for k, v in full.items() if k.startswith(prefix)}

@@ -1,0 +1,288 @@
+"""Sharded, topology-independent checkpoint store.
+
+Re-design of the reference's scalable checkpoint machinery — universal
+checkpoint (``deepspeed/checkpoint/ds_to_universal.py:112`` extract shards,
+``:232`` merge tp slices), per-rank ZeRO shard files
+(``engine.py:3213 _save_zero_checkpoint``), and the async Nebula engine
+(``runtime/checkpoint_engine/nebula_checkpoint_engine.py``) — built
+TPU-first instead of as an offline conversion step:
+
+- **Universal by default.** Every leaf is keyed by its pytree path with its
+  GLOBAL shape; shard records carry the global index (slice per dim) they
+  cover.  No (dp, tp, pp)-specific layout exists on disk, so there is
+  nothing to convert: any mesh loads any checkpoint.
+- **Per-process sharded write.** Each process writes only the addressable
+  shards whose ``replica_id == 0`` (exactly one copy of each array region
+  cluster-wide) into one binary blob + JSON index per process.  Host memory
+  per process is bounded by its largest shard, never the model size — the
+  reference's rank-0 ``torch.save`` of consolidated state is exactly what
+  this avoids.
+- **Reshard on load.** ``jax.make_array_from_callback`` asks for precisely
+  the global slices each destination device needs; the reader assembles
+  them from whichever saved shard records overlap, so an 8-way ZeRO-3
+  checkpoint loads onto a 4-way TP=2 mesh (or a single host) without ever
+  materializing a full array per device.
+- **Async save.** D2H transfer happens synchronously (a snapshot), file IO
+  runs on a background thread (Nebula's "tier-1" semantics); ``wait()``
+  joins the in-flight save.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+INDEX_FILE = "index_p{proc}.json"
+BLOB_FILE = "shards_p{proc}.bin"
+DONE_FILE = "done_p{proc}"
+
+
+def path_str(kp) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in kp)
+
+
+def _index_to_slices(index, shape) -> List[List[int]]:
+    """Normalize a shard index (tuple of slices) to [[start, stop], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def save_tree(tree: Any, path: str, materialize: bool = True
+              ) -> Dict[str, Any]:
+    """Plan this process's shard writes of ``tree`` (jax.Arrays) under
+    ``path``; hand the result to :func:`write_snapshot`.
+
+    ``materialize=True`` copies every shard to host up front — a consistent
+    snapshot safe to write asynchronously while training donates/overwrites
+    the source buffers.  Host memory: this process's full partition (the
+    async cost).  ``materialize=False`` keeps device references and
+    :func:`write_snapshot` streams them shard-by-shard — host memory
+    bounded by the largest single shard, but the tree must not be mutated
+    until the write completes (sync saves).
+    """
+    records, buffers = [], []
+    offset = 0
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        leaf = jax.numpy.asarray(leaf)
+        for shard in leaf.addressable_shards:
+            if shard.replica_id != 0:
+                continue               # exactly one copy cluster-wide
+            nbytes = int(np.prod(shard.data.shape) *
+                         shard.data.dtype.itemsize)
+            records.append({
+                "path": path_str(kp),
+                "dtype": np.dtype(shard.data.dtype).name,
+                "global_shape": list(leaf.shape),
+                "slices": _index_to_slices(shard.index, leaf.shape),
+                "offset": offset,
+                "nbytes": nbytes,
+            })
+            # D2H one shard at a time when materializing
+            buffers.append(np.asarray(shard.data) if materialize
+                           else shard.data)
+            offset += nbytes
+    return {"records": records, "buffers": buffers, "dir": path,
+            "proc": jax.process_index()}
+
+
+def write_snapshot(snap: Dict[str, Any]) -> None:
+    """File IO half of a save (runs on the async thread).  Writes the blob
+    + index, then a per-process ``done`` marker — readers treat a
+    checkpoint as complete only when every process's marker exists."""
+    proc = snap["proc"]
+    os.makedirs(snap["dir"], exist_ok=True)
+    blob = os.path.join(snap["dir"], BLOB_FILE.format(proc=proc))
+    with open(blob, "wb") as f:
+        for buf in snap["buffers"]:
+            f.write(np.ascontiguousarray(np.asarray(buf)).tobytes())
+    index = os.path.join(snap["dir"], INDEX_FILE.format(proc=proc))
+    with open(index, "w") as f:
+        json.dump({"records": snap["records"]}, f)
+    with open(os.path.join(snap["dir"], DONE_FILE.format(proc=proc)),
+              "w") as f:
+        f.write("ok")
+
+
+def is_complete(path: str, process_count: int) -> bool:
+    """All processes' done markers present?  (No collective needed: the
+    markers live on the shared checkpoint filesystem.)"""
+    return all(os.path.exists(os.path.join(path, DONE_FILE.format(proc=p)))
+               for p in range(process_count))
+
+
+class _Reader:
+    """Assembles requested global slices from saved shard records."""
+
+    def __init__(self, path: str):
+        self.by_path: Dict[str, List[Dict]] = {}
+        self.blobs: Dict[int, str] = {}
+        for fname in sorted(os.listdir(path)):
+            if not (fname.startswith("index_p") and fname.endswith(".json")):
+                continue
+            proc = int(fname[len("index_p"):-len(".json")])
+            with open(os.path.join(path, fname)) as f:
+                for rec in json.load(f)["records"]:
+                    rec["proc"] = proc
+                    self.by_path.setdefault(rec["path"], []).append(rec)
+            self.blobs[proc] = os.path.join(path,
+                                            BLOB_FILE.format(proc=proc))
+        self._lock = threading.Lock()
+        self._files: Dict[int, Any] = {}
+        self._cache: Dict[Tuple[int, int], np.ndarray] = {}
+
+    def paths(self) -> Sequence[str]:
+        return list(self.by_path)
+
+    def meta(self, path: str) -> Tuple[Tuple[int, ...], np.dtype]:
+        rec = self.by_path[path][0]
+        return tuple(rec["global_shape"]), np.dtype(rec["dtype"])
+
+    def _read_record(self, rec: Dict) -> np.ndarray:
+        # small LRU: consecutive make_array_from_callback callbacks for
+        # neighbouring destination shards hit the same saved records, so
+        # caching a few avoids O(dest_shards x record_bytes) re-reads
+        key = (rec["proc"], rec["offset"])
+        with self._lock:
+            if key in self._cache:
+                return self._cache[key]
+            f = self._files.get(rec["proc"])
+            if f is None:
+                f = open(self.blobs[rec["proc"]], "rb")
+                self._files[rec["proc"]] = f
+            f.seek(rec["offset"])
+            raw = f.read(rec["nbytes"])
+            shape = [b - a for a, b in rec["slices"]]
+            arr = np.frombuffer(raw,
+                                dtype=np.dtype(rec["dtype"])).reshape(shape)
+            self._cache[key] = arr
+            while len(self._cache) > 4:
+                self._cache.pop(next(iter(self._cache)))
+            return arr
+
+    def read_slice(self, path: str, index: Tuple[slice, ...]) -> np.ndarray:
+        """Global-slice read: union of overlapping saved records."""
+        recs = self.by_path.get(path)
+        if not recs:
+            raise KeyError(f"checkpoint has no entry for {path!r}")
+        shape, dtype = self.meta(path)
+        want = _index_to_slices(index, shape)
+        out_shape = [b - a for a, b in want]
+        out = np.empty(out_shape, dtype)
+        filled = 0
+        for rec in recs:
+            have = rec["slices"]
+            inter = [[max(w[0], h[0]), min(w[1], h[1])]
+                     for w, h in zip(want, have)]
+            if any(a >= b for a, b in inter):
+                continue
+            src = self._read_record(rec)
+            src_sel = tuple(slice(a - h[0], b - h[0])
+                            for (a, b), h in zip(inter, have))
+            dst_sel = tuple(slice(a - w[0], b - w[0])
+                            for (a, b), w in zip(inter, want))
+            out[dst_sel] = src[src_sel]
+            filled += int(np.prod([b - a for a, b in inter]))
+        if filled != int(np.prod(out_shape)):
+            raise ValueError(
+                f"{path!r}: saved shards cover {filled} of "
+                f"{int(np.prod(out_shape))} requested elements "
+                "(incomplete checkpoint?)")
+        return out
+
+    def close(self):
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+        self._cache.clear()
+
+
+def load_tree(template: Any, shardings: Any, path: str,
+              cast: bool = True) -> Any:
+    """Load a tree saved by :func:`save_tree` onto ``shardings``
+    (a matching tree of ``jax.sharding.Sharding``), resharding as needed.
+    ``template`` supplies the pytree structure and leaf dtypes (host-side
+    dtype cast when the stored dtype differs and ``cast`` is set).
+    """
+    reader = _Reader(path)
+    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+    shard_flat = jax.tree_util.tree_leaves(
+        shardings, is_leaf=lambda s: isinstance(s, jax.sharding.Sharding))
+    assert len(flat) == len(shard_flat), (
+        f"template has {len(flat)} leaves, shardings {len(shard_flat)}")
+    out = []
+    for (kp, leaf), sharding in zip(flat, shard_flat):
+        key = path_str(kp)
+        shape = tuple(np.shape(leaf))
+        # dtype without any D2H transfer (template leaves may span
+        # non-addressable devices on multi-host meshes)
+        want_dtype = (np.dtype(getattr(leaf, "dtype", None) or
+                               np.result_type(leaf)) if cast else None)
+
+        def cb(index, key=key, want_dtype=want_dtype):
+            arr = reader.read_slice(key, index)
+            if want_dtype is not None and arr.dtype != want_dtype:
+                arr = arr.astype(want_dtype)
+            return arr
+
+        saved_shape, _ = reader.meta(key)
+        if saved_shape != shape:
+            raise ValueError(
+                f"{key!r}: checkpoint shape {saved_shape} != model shape "
+                f"{shape} (different model config?)")
+        out.append(jax.make_array_from_callback(shape, sharding, cb))
+    reader.close()
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def read_full_tree(path: str) -> Dict[str, np.ndarray]:
+    """Flat {pytree path: full ndarray} view of a saved tree (offline
+    consolidation — ``zero_to_fp32`` support)."""
+    reader = _Reader(path)
+    out = {}
+    for key in reader.paths():
+        shape, _ = reader.meta(key)
+        out[key] = reader.read_slice(key, tuple(slice(0, d) for d in shape))
+    reader.close()
+    return out
+
+
+class AsyncSaver:
+    """One-slot background writer (Nebula-equivalent async save)."""
+
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="ckpt-writer")
+        self._inflight: Optional[Future] = None
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        self.wait()
+        fut = self._pool.submit(fn)
+
+        def _log_failure(f: Future) -> None:
+            # surface failures immediately — an unobserved Future would
+            # swallow e.g. a disk-full on the run's final save
+            if f.exception() is not None:
+                logger.error(f"async checkpoint save FAILED: "
+                             f"{f.exception()!r}")
+
+        fut.add_done_callback(_log_failure)
+        self._inflight = fut
+
+    def wait(self) -> None:
+        if self._inflight is not None:
+            exc = self._inflight.exception()
+            self._inflight = None
+            if exc is not None:
+                raise exc

