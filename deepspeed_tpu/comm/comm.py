@@ -100,13 +100,16 @@ def get_backend_name() -> Optional[str]:
 
 
 def initialize_mesh(dp: Optional[int] = None, tp: int = 1, pp: int = 1,
-                    sp: int = 1, ep: int = 1,
+                    sp: int = 1, ep: int = 1, hpz: int = 1,
                     devices: Optional[Sequence[jax.Device]] = None) -> MeshTopology:
     """Create and install the global mesh (reference
-    ``initialize_mesh_device``, comm.py:609)."""
+    ``initialize_mesh_device``, comm.py:609).  ``hpz`` splits the data axis
+    for ZeRO++ hpZ secondary partitioning (``dp`` counts total data-parallel
+    replicas, including the split)."""
     if not _state.initialized:
         init_distributed()
-    topo = MeshTopology(dp=dp, tp=tp, pp=pp, sp=sp, ep=ep, devices=devices)
+    topo = MeshTopology(dp=dp, tp=tp, pp=pp, sp=sp, ep=ep, hpz=hpz,
+                        devices=devices)
     _state.topology = topo
     return topo
 

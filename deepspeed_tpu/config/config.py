@@ -445,4 +445,36 @@ def load_config(config: Union[str, Dict[str, Any], DeepSpeedConfig, None],
         raise TypeError(f"Unsupported config type: {type(config)}")
     if dp_world_size is not None:
         cfg.reconcile_batch_size(dp_world_size)
+    warn_unimplemented(cfg)
     return cfg
+
+
+def warn_unimplemented(cfg: DeepSpeedConfig) -> None:
+    """Accepted-but-not-yet-implemented knobs fail LOUDLY instead of
+    silently doing nothing (reference configs keep loading; the user keeps
+    an accurate mental model).  Entries leave this list as the features
+    land."""
+    notes = []
+    if any(getattr(cfg.compression_training, f) for f in
+           ("weight_quantization", "activation_quantization",
+            "sparse_pruning", "row_pruning", "head_pruning",
+            "channel_pruning", "layer_reduction")):
+        notes.append("compression_training.* (use deepspeed_tpu."
+                     "compression.init_compression explicitly)")
+    offl_p = cfg.zero_optimization.offload_param
+    offl_o = cfg.zero_optimization.offload_optimizer
+    if offl_p is not None and offl_p.device != "none":
+        notes.append(f"offload_param.device={offl_p.device}")
+    if offl_o is not None and offl_o.device != "none":
+        notes.append(f"offload_optimizer.device={offl_o.device}")
+    if cfg.flops_profiler.enabled:
+        notes.append("flops_profiler")
+    if cfg.elasticity.enabled:
+        notes.append("elasticity")
+    if cfg.data_efficiency.enabled:
+        notes.append("data_efficiency")
+    if cfg.curriculum_learning.enabled:
+        notes.append("curriculum_learning")
+    for note in notes:
+        logger.warning(f"config: {note} is NOT implemented on TPU yet; "
+                       "the setting has no effect")

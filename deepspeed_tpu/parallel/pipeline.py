@@ -38,7 +38,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.parallel.topology import DATA_AXIS, PIPE_AXIS
+from deepspeed_tpu.parallel.topology import DATA_AXIS, HPZ_AXIS, PIPE_AXIS
 from deepspeed_tpu.utils.sharding import maybe_constrain
 
 
@@ -167,7 +167,7 @@ class _Tick(nn.Module):
         # microbatch t enters stage 0 at tick t and exits at tick t + S - 1
         staged = jnp.roll(state, 1, axis=0).at[0].set(inp)
         staged = maybe_constrain(
-            staged, (PIPE_AXIS, DATA_AXIS) + (None,) * (staged.ndim - 2))
+            staged, (PIPE_AXIS, (DATA_AXIS, HPZ_AXIS)) + (None,) * (staged.ndim - 2))
         stage = nn.vmap(
             _Stage,
             variable_axes={"params": 0},
@@ -178,7 +178,7 @@ class _Tick(nn.Module):
           self.remat_policy, name="stages")
         out = stage(staged, *bcast)                # [S, mb, ...]
         out = maybe_constrain(
-            out, (PIPE_AXIS, DATA_AXIS) + (None,) * (out.ndim - 2))
+            out, (PIPE_AXIS, (DATA_AXIS, HPZ_AXIS)) + (None,) * (out.ndim - 2))
         return (out, bcast), out[-1]               # finished microbatch
 
 
@@ -210,7 +210,7 @@ class GPipe(nn.Module):
 
         state0 = jnp.zeros((S, mb) + x.shape[1:], x.dtype)
         state0 = maybe_constrain(
-            state0, (PIPE_AXIS, DATA_AXIS) + (None,) * (state0.ndim - 2))
+            state0, (PIPE_AXIS, (DATA_AXIS, HPZ_AXIS)) + (None,) * (state0.ndim - 2))
 
         (_, _), outs = nn.scan(
             _Tick,

@@ -33,11 +33,19 @@ from deepspeed_tpu.utils.logging import log_dist
 
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+# hpZ / ZeRO++ secondary-partition sub-axis (reference
+# ``groups.py:650 _create_zero_param_parallel_group``): the data axis splits
+# into data (across-node, outer) x data_sub (node-local, inner, size
+# ``zero_hpz_partition_size``); stage-3 params shard only over ``data_sub``
+# so their all-gathers ride node-local ICI, while grads/optimizer state
+# shard over the full data x data_sub extent.  Size 1 (no hpZ) by default.
+HPZ_AXIS = "data_sub"
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
 TENSOR_AXIS = "tensor"
 
-AXIS_ORDER: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS)
+AXIS_ORDER: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, HPZ_AXIS, EXPERT_AXIS,
+                               SEQ_AXIS, TENSOR_AXIS)
 
 
 class MeshTopology:
@@ -54,6 +62,7 @@ class MeshTopology:
                  pp: int = 1,
                  sp: int = 1,
                  ep: int = 1,
+                 hpz: int = 1,
                  devices: Optional[Sequence[jax.Device]] = None):
         if devices is None:
             devices = jax.devices()
@@ -69,9 +78,11 @@ class MeshTopology:
             raise ValueError(
                 f"dp({dp}) * tp({tp}) * pp({pp}) * sp({sp}) * ep({ep}) != "
                 f"device count {n}")
+        if dp % hpz != 0:
+            raise ValueError(f"dp({dp}) not divisible by hpz({hpz})")
         self.shape: Dict[str, int] = {
-            PIPE_AXIS: pp, DATA_AXIS: dp, EXPERT_AXIS: ep,
-            SEQ_AXIS: sp, TENSOR_AXIS: tp,
+            PIPE_AXIS: pp, DATA_AXIS: dp // hpz, HPZ_AXIS: hpz,
+            EXPERT_AXIS: ep, SEQ_AXIS: sp, TENSOR_AXIS: tp,
         }
         dev_array = np.asarray(devices).reshape(
             tuple(self.shape[a] for a in AXIS_ORDER))
@@ -89,7 +100,11 @@ class MeshTopology:
 
     @property
     def data_parallel_size(self) -> int:
-        return self.shape[DATA_AXIS]
+        return self.shape[DATA_AXIS] * self.shape[HPZ_AXIS]
+
+    @property
+    def hpz_partition_size(self) -> int:
+        return self.shape[HPZ_AXIS]
 
     @property
     def tensor_parallel_size(self) -> int:
@@ -114,22 +129,22 @@ class MeshTopology:
         """Axes ZeRO partitions over: data × expert × seq (the reference's
         ``seq_data_parallel_group``; expert params handle ``expert``
         separately via :meth:`expert_zero_axes`)."""
-        return (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+        return (DATA_AXIS, HPZ_AXIS, EXPERT_AXIS, SEQ_AXIS)
 
     @property
     def expert_zero_axes(self) -> Tuple[str, ...]:
         """Axes expert params ZeRO-shard over (the reference's
         ``expert_data_parallel_group``)."""
-        return (DATA_AXIS, SEQ_AXIS)
+        return (DATA_AXIS, HPZ_AXIS, SEQ_AXIS)
 
     @property
     def grad_reduce_axes(self) -> Tuple[str, ...]:
         """Axes over which dense-param gradients are averaged."""
-        return (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+        return (DATA_AXIS, HPZ_AXIS, EXPERT_AXIS, SEQ_AXIS)
 
     @property
     def expert_grad_reduce_axes(self) -> Tuple[str, ...]:
-        return (DATA_AXIS, SEQ_AXIS)
+        return (DATA_AXIS, HPZ_AXIS, SEQ_AXIS)
 
     def zero_partition_count(self) -> int:
         return int(np.prod([self.shape[a] for a in self.zero_axes]))

@@ -85,6 +85,22 @@ def initialize(args=None,
         dp_world_size=topology.data_parallel_size *
         topology.expert_parallel_size)
 
+    # hpZ (ZeRO++): rebuild the mesh with the data axis split into
+    # data x data_sub so stage-3 params can shard node-locally
+    hpz = ds_config.zero_optimization.zero_hpz_partition_size
+    if (hpz > 1 and ds_config.zero_optimization.stage >= 3 and
+            topology.hpz_partition_size != hpz):
+        topology = MeshTopology(
+            dp=topology.data_parallel_size,
+            tp=topology.tensor_parallel_size,
+            pp=topology.pipe_parallel_size,
+            sp=topology.sequence_parallel_size,
+            ep=topology.expert_parallel_size,
+            hpz=hpz,
+            devices=list(topology.mesh.devices.flatten()))
+        dist.set_topology(topology)
+        log_dist(f"hpZ: split data axis -> {topology.describe()}", ranks=[0])
+
     engine = DeepSpeedEngine(model=model,
                              model_parameters=model_parameters,
                              config=ds_config,
@@ -152,16 +168,22 @@ class DeepSpeedEngine:
 
         # -- resolve model -> (loss_fn, params) ---------------------------
         self.module = None
+        self._init_rngs = None                 # set => deferred sharded init
         if hasattr(model, "init") and hasattr(model, "apply"):  # flax Module
+            model = self._apply_activation_checkpointing_config(model)
             self.module = model
             assert example_batch is not None, \
                 "flax-module path needs example_batch for init"
             init_rng, rng = jax.random.split(rng)
             if model_parameters is None:
-                # jit the init: partial-manual shard_map (Ulysses/ring SP)
-                # only traces under jit, and XLA frees intermediates eagerly
-                model_parameters = jax.jit(model.init)(
-                    {"params": init_rng, "dropout": init_rng}, example_batch)
+                # zero.Init equivalent (partition_parameters.py:824): params
+                # are born sharded.  Here: shapes only via eval_shape; the
+                # real init runs later under jit with out_shardings from the
+                # ZeRO plan, so no device or host ever materializes the
+                # full unsharded model.
+                self._init_rngs = {"params": init_rng, "dropout": init_rng}
+                model_parameters = jax.eval_shape(
+                    model.init, self._init_rngs, example_batch)
 
             def loss_fn(params, batch, step_rng):
                 return model.apply(params, batch, rngs={"dropout": step_rng})
@@ -193,7 +215,8 @@ class DeepSpeedEngine:
         from deepspeed_tpu.parallel import tensor_parallel as tp_lib
 
         self.base_specs = None
-        if tp_lib.has_partitioning(model_parameters):
+        params_boxed = tp_lib.has_partitioning(model_parameters)
+        if params_boxed:
             self.base_specs = tp_lib.extract_partition_specs(
                 model_parameters, self.mesh.axis_names)
             model_parameters = tp_lib.unbox_params(model_parameters)
@@ -221,14 +244,34 @@ class DeepSpeedEngine:
             hpz_partition_size=zcfg.zero_hpz_partition_size)
 
         master_dtype = jnp.float32 if self.master_weights else self.compute_dtype
-        host_params = jax.tree_util.tree_map(
-            lambda x: np.asarray(x, dtype=master_dtype)
-            if np.issubdtype(np.asarray(x).dtype, np.floating) else np.asarray(x),
-            model_parameters)
-        param_shardings = self.plan.param_shardings(host_params,
+
+        def to_master(x):
+            return (x.astype(master_dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x)
+
+        param_shardings = self.plan.param_shardings(model_parameters,
                                                     self.base_specs)
-        params = jax.tree_util.tree_map(jax.device_put, host_params,
-                                        param_shardings)
+        if self._init_rngs is not None:
+            # deferred init: each device computes/receives only its shard
+            def sharded_init(rngs, batch):
+                p = model.init(rngs, batch)
+                if params_boxed:
+                    p = tp_lib.unbox_params(p)
+                return jax.tree_util.tree_map(to_master, p)
+
+            params = jax.jit(sharded_init, out_shardings=param_shardings)(
+                self._init_rngs, example_batch)
+        else:
+            # user-provided params: already materialized; cast on host and
+            # place leaf-by-leaf against the plan (no second full-tree copy)
+            def put(x, s):
+                x = np.asarray(x)
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    x = x.astype(master_dtype)
+                return jax.device_put(x, s)
+
+            params = jax.tree_util.tree_map(put, model_parameters,
+                                            param_shardings)
         self._grad_spec_tree = self.plan.grad_specs(params, self.base_specs)
 
         opt_shapes = jax.eval_shape(self.tx.init, params)
@@ -310,6 +353,53 @@ class DeepSpeedEngine:
             f"train_batch={config.train_batch_size}", ranks=[0])
 
     # ------------------------------------------------------------------
+
+    def _apply_activation_checkpointing_config(self, model):
+        """Honor the ``activation_checkpointing`` JSON subtree (reference
+        ``runtime/activation_checkpointing/checkpointing.py`` configure):
+        when explicitly set, rebuild the model's dataclass config with the
+        matching ``nn.remat`` policy so the knob actually drives remat."""
+        import dataclasses
+
+        if "activation_checkpointing" not in self.config.model_fields_set:
+            return model
+        acfg = self.config.activation_checkpointing
+        if acfg.cpu_checkpointing or acfg.contiguous_memory_optimization:
+            logger.warning(
+                "activation_checkpointing: cpu_checkpointing / "
+                "contiguous_memory_optimization are no-ops on TPU (XLA "
+                "owns activation placement and memory layout)")
+        # only an explicit policy (or partition_activations, whose TPU
+        # equivalent is remat) changes remat behavior — other fields in the
+        # block (profile, ...) must not silently enable checkpointing
+        if ("policy" not in acfg.model_fields_set and
+                not acfg.partition_activations):
+            return model
+        mc = getattr(model, "config", None)
+        if not (dataclasses.is_dataclass(mc) and
+                all(any(f.name == n for f in dataclasses.fields(mc))
+                    for n in ("remat", "remat_policy"))):
+            logger.warning(
+                "activation_checkpointing set but the model carries no "
+                "remat-capable dataclass config; knob has no effect")
+            return model
+        # config policy names -> (model remat_policy, remat on?)
+        mapping = {"nothing_saveable": ("full", True),
+                   "dots_saveable": ("dots", True),
+                   "everything_saveable": ("none", False)}
+        if acfg.policy not in mapping:
+            raise ValueError(
+                f"activation_checkpointing.policy={acfg.policy!r}: expected "
+                f"one of {sorted(mapping)}")
+        remat_policy, remat = mapping[acfg.policy]
+        if (mc.remat, mc.remat_policy) == (remat, remat_policy):
+            return model
+        log_dist(f"activation_checkpointing: policy={acfg.policy} -> "
+                 f"remat={remat} remat_policy={remat_policy}", ranks=[0])
+        # clone preserves every other module field (a module may carry more
+        # than its config)
+        return model.clone(config=dataclasses.replace(
+            mc, remat=remat, remat_policy=remat_policy))
 
     def _repl(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())

@@ -50,11 +50,26 @@ class ZeroShardingPlan:
             a for a in topology.zero_axes if topology.axis_size(a) > 1)
         self.partitions = int(np.prod(
             [topology.axis_size(a) for a in self.axes])) if self.axes else 1
+        # hpZ (ZeRO++ secondary partition, groups.py:650): stage-3 PARAMS
+        # shard only over the node-local data_sub axis — cheap all-gathers
+        # over intra-node ICI — while grads/opt state keep the full extent
+        self.param_axes: Tuple[str, ...] = self.axes
+        if hpz_partition_size > 1 and stage >= 3:
+            from deepspeed_tpu.parallel.topology import HPZ_AXIS
+
+            if topology.hpz_partition_size != hpz_partition_size:
+                raise ValueError(
+                    f"zero_hpz_partition_size={hpz_partition_size} but the "
+                    f"mesh's data_sub axis is {topology.hpz_partition_size} "
+                    "wide — build the mesh with initialize_mesh(..., "
+                    "hpz=<size>) (the engine does this automatically)")
+            self.param_axes = tuple(a for a in self.axes if a == HPZ_AXIS)
 
     # -- per-leaf spec ----------------------------------------------------
 
     def leaf_spec(self, shape: Tuple[int, ...], sharded: bool,
-                  base: Optional[P] = None) -> P:
+                  base: Optional[P] = None,
+                  axes: Optional[Tuple[str, ...]] = None) -> P:
         """PartitionSpec for one array of ``shape``.
 
         ``base`` carries pre-existing model-parallel sharding (TP/expert axis
@@ -66,11 +81,12 @@ class ZeroShardingPlan:
         spec = list(base) if base is not None else []
         spec = spec[:ndim] + [None] * (ndim - len(spec))
         has_base = any(s is not None for s in spec)
+        my_axes = self.axes if axes is None else axes
 
         def out():
             return P(*spec) if has_base else P()
 
-        if not sharded or not self.axes or ndim == 0:
+        if not sharded or not my_axes or ndim == 0:
             return out()
         if int(np.prod(shape)) <= self.persistence_threshold and not has_base:
             return P()  # persistent (replicated) small param
@@ -79,7 +95,7 @@ class ZeroShardingPlan:
         for s in spec:
             for ax in (s,) if isinstance(s, str) else (s or ()):
                 base_axes.add(ax)
-        axes = tuple(a for a in self.axes if a not in base_axes)
+        axes = tuple(a for a in my_axes if a not in base_axes)
         if not axes:
             return out()
         partitions = int(np.prod([self.topology.axis_size(a) for a in axes]))
@@ -94,17 +110,20 @@ class ZeroShardingPlan:
 
     # -- tree-level specs -------------------------------------------------
 
-    def _specs(self, params, sharded: bool, base_specs):
+    def _specs(self, params, sharded: bool, base_specs, axes=None):
         if base_specs is None:
             return jax.tree_util.tree_map(
-                lambda x: self.leaf_spec(x.shape, sharded), params)
+                lambda x: self.leaf_spec(x.shape, sharded, axes=axes), params)
         return jax.tree_util.tree_map(
-            lambda x, b: self.leaf_spec(x.shape, sharded, b), params,
-            base_specs)
+            lambda x, b: self.leaf_spec(x.shape, sharded, b, axes=axes),
+            params, base_specs)
 
     def param_specs(self, params, base_specs=None):
-        """Stage 3 shards params; stages 0-2 keep only the base (TP) spec."""
-        return self._specs(params, self.stage >= 3, base_specs)
+        """Stage 3 shards params (over ``param_axes`` — restricted to the
+        node-local sub-axis under hpZ); stages 0-2 keep only the base (TP)
+        spec."""
+        return self._specs(params, self.stage >= 3, base_specs,
+                           axes=self.param_axes)
 
     def grad_specs(self, params, base_specs=None):
         """Stage >= 2 keeps grads in the sharded layout (reduce-scatter)."""
@@ -171,7 +190,7 @@ class ZeroShardingPlan:
         [B, S] token arrays) shards over ``seq`` — inputs then arrive
         seq-sharded exactly like the reference's Ulysses input contract
         ([s/P, b, h], ``sequence/layer.py``)."""
-        axes = tuple(a for a in ("data", "expert")
+        axes = tuple(a for a in ("data", "data_sub", "expert")
                      if self.topology.axis_size(a) > 1)
         specs = []
         if has_gas_dim:
