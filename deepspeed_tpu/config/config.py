@@ -463,10 +463,12 @@ def warn_unimplemented(cfg: DeepSpeedConfig) -> None:
                      "compression.init_compression explicitly)")
     offl_p = cfg.zero_optimization.offload_param
     offl_o = cfg.zero_optimization.offload_optimizer
-    if offl_p is not None and offl_p.device != "none":
-        notes.append(f"offload_param.device={offl_p.device}")
-    if offl_o is not None and offl_o.device != "none":
-        notes.append(f"offload_optimizer.device={offl_o.device}")
+    if offl_p is not None and offl_p.device == "nvme":
+        notes.append("offload_param.device=nvme (device=cpu pinned-host "
+                     "offload IS supported)")
+    if offl_o is not None and offl_o.device == "nvme":
+        notes.append("offload_optimizer.device=nvme (device=cpu "
+                     "pinned-host offload IS supported)")
     if cfg.flops_profiler.enabled:
         notes.append("flops_profiler")
     if cfg.elasticity.enabled:

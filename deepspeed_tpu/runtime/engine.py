@@ -249,8 +249,43 @@ class DeepSpeedEngine:
             return (x.astype(master_dtype)
                     if jnp.issubdtype(x.dtype, jnp.floating) else x)
 
+        # -- ZeRO-Offload (swap_tensor/partitioned_*_swapper equivalents):
+        # state placed in host memory via memory_kind="pinned_host"; XLA
+        # streams it to the chip inside the step.  TPU-only: the CPU
+        # backend cannot compile host-placement annotations.
+        offl_o, offl_p = zcfg.offload_optimizer, zcfg.offload_param
+        want_opt_off = bool(offl_o and offl_o.device == "cpu")
+        want_param_off = bool(offl_p and offl_p.device == "cpu" and
+                              zcfg.stage >= 3)
+        if offl_p and offl_p.device == "cpu" and zcfg.stage < 3:
+            logger.warning(
+                f"offload_param.device=cpu requires zero stage 3 (params "
+                f"are not partitioned at stage {zcfg.stage}); IGNORED")
+        host_mem_ok = self.mesh.devices.flat[0].platform != "cpu"
+        if (want_opt_off or want_param_off) and not host_mem_ok:
+            logger.warning(
+                "offload to cpu requested but this backend cannot compile "
+                "pinned_host placement; keeping state in device memory")
+        self.offload_optimizer = want_opt_off and host_mem_ok
+        self.offload_param = want_param_off and host_mem_ok
+
+        def to_host(shardings):
+            return jax.tree_util.tree_map(
+                lambda s: s.with_memory_kind("pinned_host"), shardings)
+
         param_shardings = self.plan.param_shardings(model_parameters,
                                                     self.base_specs)
+        # in-graph H2D fetch: host-resident operands must be explicitly
+        # transferred before compute ops (XLA does not auto-stream them)
+        self._fetch_params = lambda p: p
+        self._fetch_opt = lambda o: o
+        if self.offload_param:
+            dev_shardings = param_shardings
+            param_shardings = to_host(param_shardings)
+            self._fetch_params = (
+                lambda p, _s=dev_shardings: jax.device_put(p, _s))
+            log_dist("ZeRO-Offload: params resident in host memory "
+                     "(pinned_host)", ranks=[0])
         if self._init_rngs is not None:
             # deferred init: each device computes/receives only its shard
             def sharded_init(rngs, batch):
@@ -278,6 +313,13 @@ class DeepSpeedEngine:
         opt_specs = self.plan.opt_state_specs(opt_shapes, self.base_specs)
         opt_shardings = self.plan.opt_state_shardings(opt_shapes,
                                                       self.base_specs)
+        if self.offload_optimizer:
+            dev_opt_shardings = opt_shardings
+            opt_shardings = to_host(opt_shardings)
+            self._fetch_opt = (
+                lambda o, _s=dev_opt_shardings: jax.device_put(o, _s))
+            log_dist("ZeRO-Offload: optimizer state resident in host "
+                     "memory (pinned_host)", ranks=[0])
         opt_state = jax.jit(self.tx.init, out_shardings=opt_shardings)(params)
 
         # Fused Pallas optimizers have no GSPMD partitioning rule; run the
@@ -434,6 +476,8 @@ class DeepSpeedEngine:
         fp16 = self.config.fp16
         dynamic = self.dynamic_loss_scale
         grad_specs = self._grad_spec_tree
+        fetch_params = self._fetch_params
+        fetch_opt = self._fetch_opt
 
         def cast_params(p):
             return prec.cast_tree(p, compute_dtype)
@@ -446,6 +490,9 @@ class DeepSpeedEngine:
         def train_step(state: TrainState, batch, lr):
             rng, new_rng = jax.random.split(state.rng)
             scale = state.scale.loss_scale
+            # ZeRO-Offload: explicit H2D fetch of host-resident state
+            live_params = fetch_params(state.params)
+            live_opt = fetch_opt(state.opt_state)
 
             def micro_grads(mb, idx):
                 mrng = jax.random.fold_in(rng, idx)
@@ -454,7 +501,7 @@ class DeepSpeedEngine:
                     loss = loss_fn(cast_params(p), mb, mrng)
                     return (loss * scale.astype(loss.dtype)).astype(jnp.float32)
 
-                loss_s, grads = jax.value_and_grad(scaled_loss)(state.params)
+                loss_s, grads = jax.value_and_grad(scaled_loss)(live_params)
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(jnp.float32), grads)
                 # ZeRO >= 2: keep accumulated grads in the sharded layout so
@@ -476,7 +523,7 @@ class DeepSpeedEngine:
                     return (grads_acc, loss_acc + loss_s), None
 
                 zero_grads = jax.tree_util.tree_map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+                    lambda p: jnp.zeros(p.shape, jnp.float32), live_params)
                 zero_grads = constrain_tree(zero_grads, grad_specs, mesh)
                 idxs = jnp.arange(gas)
                 (grads, loss_sum), _ = jax.lax.scan(
@@ -497,24 +544,24 @@ class DeepSpeedEngine:
                 overflow = prec.has_inf_or_nan(grads)
                 safe_grads = jax.tree_util.tree_map(
                     lambda g: jnp.where(overflow, jnp.zeros_like(g), g), grads)
-                updates, new_opt = tx_update(safe_grads, state.opt_state,
-                                             state.params)
+                updates, new_opt = tx_update(safe_grads, live_opt,
+                                             live_params)
                 new_params = jax.tree_util.tree_map(
                     lambda p, u: jnp.where(overflow, p,
                                            (p - lr * u.astype(jnp.float32)
                                             ).astype(p.dtype)),
-                    state.params, updates)
+                    live_params, updates)
                 new_opt = jax.tree_util.tree_map(
                     lambda n, o: jnp.where(overflow, o, n), new_opt,
-                    state.opt_state)
+                    live_opt)
             else:
                 overflow = jnp.asarray(False)
-                updates, new_opt = tx_update(grads, state.opt_state,
-                                             state.params)
+                updates, new_opt = tx_update(grads, live_opt,
+                                             live_params)
                 new_params = jax.tree_util.tree_map(
                     lambda p, u: (p - lr * u.astype(jnp.float32)
                                   ).astype(p.dtype),
-                    state.params, updates)
+                    live_params, updates)
 
             new_scale = prec.update_loss_scale(
                 state.scale, overflow, dynamic,
@@ -552,9 +599,10 @@ class DeepSpeedEngine:
     def _build_eval_step(self):
         loss_fn = self.loss_fn
         compute_dtype = self.compute_dtype
+        fetch_params = self._fetch_params
 
         def eval_step(state: TrainState, batch, rng):
-            params = prec.cast_tree(state.params, compute_dtype)
+            params = prec.cast_tree(fetch_params(state.params), compute_dtype)
             return loss_fn(params, batch, rng)
 
         return jax.jit(eval_step, out_shardings=self._repl())
@@ -565,6 +613,7 @@ class DeepSpeedEngine:
         compute_dtype = self.compute_dtype
         mesh = self.mesh
         grad_spec_tree = self._grad_spec_tree
+        fetch_params = self._fetch_params
 
         def grad_step(state: TrainState, batch, rng):
             scale = state.scale.loss_scale
@@ -573,7 +622,8 @@ class DeepSpeedEngine:
                 loss = loss_fn(prec.cast_tree(p, compute_dtype), batch, rng)
                 return (loss * scale.astype(loss.dtype)).astype(jnp.float32)
 
-            loss_s, grads = jax.value_and_grad(scaled_loss)(state.params)
+            loss_s, grads = jax.value_and_grad(scaled_loss)(
+                fetch_params(state.params))
             grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32),
                                            grads)
             grads = constrain_tree(grads, grad_spec_tree, mesh)
@@ -588,9 +638,13 @@ class DeepSpeedEngine:
         fp16 = self.config.fp16
         dynamic = self.dynamic_loss_scale
         gas = self.gas
+        fetch_params = self._fetch_params
+        fetch_opt = self._fetch_opt
 
         def apply_step(state: TrainState, grads, lr):
             scale = state.scale.loss_scale
+            live_params = fetch_params(state.params)
+            live_opt = fetch_opt(state.opt_state)
             inv = 1.0 / (scale * gas)
             grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
             overflow = prec.has_inf_or_nan(grads)
@@ -599,15 +653,15 @@ class DeepSpeedEngine:
                 grads, _ = prec.clip_by_global_norm(grads, clip, grad_norm)
             safe = jax.tree_util.tree_map(
                 lambda g: jnp.where(overflow, jnp.zeros_like(g), g), grads)
-            updates, new_opt = tx_update(safe, state.opt_state, state.params)
+            updates, new_opt = tx_update(safe, live_opt, live_params)
             new_params = jax.tree_util.tree_map(
                 lambda p, u: jnp.where(overflow, p,
                                        (p - lr * u.astype(jnp.float32)
                                         ).astype(p.dtype)),
-                state.params, updates)
+                live_params, updates)
             new_opt = jax.tree_util.tree_map(
                 lambda n, o: jnp.where(overflow, o, n), new_opt,
-                state.opt_state)
+                live_opt)
             new_scale = prec.update_loss_scale(
                 state.scale, overflow, dynamic,
                 loss_scale_window=fp16.loss_scale_window,
@@ -684,13 +738,28 @@ class DeepSpeedEngine:
         plain engine this is forward+backward+step at once)."""
         if batch is None:
             batch = self._next_batch(data_iter)
+        breakdown = self.config.wall_clock_breakdown
+        if breakdown:
+            self.timers("batch_prep").start()
         gbatch = self._to_gas_batch(batch)
+        if breakdown:
+            self.timers("batch_prep").stop()
         if self._train_step_fn is None:
             self._train_step_fn = self._build_train_step()
         lr = self._lr_device()
 
         self.tput_timer.start()
+        if breakdown:
+            self.timers(STEP_GLOBAL_TIMER).start()
         self.state, metrics = self._train_step_fn(self.state, gbatch, lr)
+        if breakdown:
+            # one fused XLA program covers fwd+bwd+step; the device-synced
+            # bracket is the whole step (fwd/bwd are not separable without
+            # deoptimizing — documented divergence from EngineTimers).
+            # jit dispatch is async: sync on the result before stopping
+            jax.block_until_ready(metrics)
+            self.timers(STEP_GLOBAL_TIMER).stop()
+        self._last_metrics = metrics
         self.global_steps += 1
         self.micro_steps += self.gas
         self.global_samples += self.config.train_batch_size
@@ -704,6 +773,11 @@ class DeepSpeedEngine:
                 f"lr={self.get_lr()[0]:.3e} "
                 f"grad_norm={float(m['grad_norm']):.3f} "
                 f"loss_scale={float(m['loss_scale']):.0f}", ranks=[0])
+            if breakdown:
+                # elapsed accumulates across steps_per_print steps; report
+                # per-step times like the reference EngineTimers
+                self.timers.log(["batch_prep", STEP_GLOBAL_TIMER],
+                                normalizer=self.config.steps_per_print)
         if self.monitor is not None and self.monitor.enabled:
             m = jax.device_get(metrics)
             self.monitor.write_events([
@@ -803,7 +877,12 @@ class DeepSpeedEngine:
     # -- misc -------------------------------------------------------------
 
     def get_global_grad_norm(self) -> Optional[float]:
-        return None  # exposed per-step in train_batch metrics
+        """Global (pre-clip) gradient norm of the most recent step
+        (reference ``engine.py`` ``get_global_grad_norm``)."""
+        m = getattr(self, "_last_metrics", None)
+        if m is None:
+            return None
+        return float(jax.device_get(m["grad_norm"]))
 
     def module_state_dict(self):
         return jax.device_get(self.state.params)

@@ -318,3 +318,20 @@ def test_unimplemented_config_warns(caplog):
     assert "flops_profiler" in text
     assert "elasticity" in text
     assert "compression_training" in text
+
+
+def test_observability_grad_norm_and_breakdown(devices, caplog):
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    engine = make_engine(1, config_overrides={"wall_clock_breakdown": True,
+                                              "steps_per_print": 2})
+    assert engine.get_global_grad_norm() is None
+    ds_logger.addHandler(caplog.handler)
+    try:
+        for _ in range(2):
+            engine.train_batch(batch=random_tokens(16, seed=8))
+    finally:
+        ds_logger.removeHandler(caplog.handler)
+    gn = engine.get_global_grad_norm()
+    assert gn is not None and np.isfinite(gn) and gn > 0
+    assert "batch_prep" in caplog.text and "step" in caplog.text
