@@ -24,6 +24,8 @@ from deepspeed_tpu.comm.comm import (
     comms_logger,
 )
 from deepspeed_tpu.comm.comms_logging import CommsLogger, get_bw
+from deepspeed_tpu.comm.quantized import (quantized_all_gather,
+                                          quantized_reduce_scatter)
 
 __all__ = [
     "init_distributed", "is_initialized", "initialize_mesh", "set_topology",
@@ -31,5 +33,6 @@ __all__ = [
     "get_process_count", "barrier", "all_reduce", "inference_all_reduce",
     "all_gather", "reduce_scatter", "all_to_all", "ppermute", "broadcast",
     "axis_index", "log_summary", "configure", "comms_logger", "CommsLogger",
+    "quantized_all_gather", "quantized_reduce_scatter",
     "get_bw",
 ]

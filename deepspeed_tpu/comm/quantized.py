@@ -1,0 +1,204 @@
+"""ZeRO++ quantized collectives (qwZ / qgZ).
+
+TPU-native re-design of the reference's compressed collectives
+(``runtime/comm/coalesced_collectives.py:31 all_to_all_quant_reduce``,
+``:81 all_to_all_loco_quant_reduce``, backed by ``csrc/quantization/``
+swizzled-quant CUDA kernels):
+
+- :func:`quantized_all_gather` — **qwZ**: the int8 weight all-gather.
+  Each member quantizes its shard group-wise (``ops/quantization.py``),
+  the int8 payload + fp32 scales cross the wire (~4x fewer bytes than
+  bf16, ~8x with ``num_bits=4`` whose nibbles are packed two-per-byte),
+  and members dequantize locally.
+- :func:`quantized_reduce_scatter` — **qgZ**: gradient reduce-scatter as
+  quantize -> all-to-all -> local dequant-reduce.  With a multi-axis group
+  (e.g. ``("data", "data_sub")``) the hops run hierarchically, innermost
+  (node-local ICI) axis first with re-quantization between hops — the
+  reference's 2-hop qgZ that keeps the DCN hop at 1/N of the bytes.
+
+Both run hop-per-axis with mutually inverse hop orders, so
+``quantized_all_gather(quantized_reduce_scatter(x, group=g), group=g)``
+reconstructs the original layout for any axis tuple (the ZeRO++ wire
+pattern).
+
+Both are in-graph collectives: call them inside ``shard_map`` (or any
+traced context with mesh axis names).  Dequantization math runs as plain
+XLA elementwise ops (one multiply-add; the Pallas kernels matter for the
+standalone quantize path, not here where fusion is free).
+
+Quantization noise makes these LOSSY: the convergence-parity tests
+(tests/unit/test_quantized_comm.py) pin the error bounds and show a
+manual-DP training loop tracking its full-precision twin.
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deepspeed_tpu.comm.comm import _resolve_axes, comms_logger
+from deepspeed_tpu.ops.quantization import quantize
+
+GroupLike = Union[None, str, Sequence[str]]
+
+
+def _axes_size(axes: Tuple[str, ...]) -> int:
+    import deepspeed_tpu.comm as dist
+
+    topo = dist.get_topology()
+    return int(np.prod([topo.axis_size(a) for a in axes]))
+
+
+def _chunk_group_size(chunk_numel: int, group_size: int,
+                      num_bits: int = 8) -> int:
+    """Largest quant-group size <= group_size that divides the chunk, so
+    groups never straddle chunk boundaries.  Kept even so int4 nibble
+    pairs never straddle a group."""
+    gs = group_size if chunk_numel % group_size == 0 else \
+        math.gcd(chunk_numel, group_size)
+    while gs > 1 and (gs % 2 or chunk_numel % gs):
+        gs -= 1
+    if num_bits == 4 and gs % 2:
+        raise ValueError(
+            f"int4 packing needs an even group size but the shard has "
+            f"{chunk_numel} elements (odd): pad the array or use num_bits=8")
+    if gs < 16:
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.warning(
+            f"quantized collective: shard numel {chunk_numel} only admits "
+            f"quant groups of {gs} elements — per-group fp32 scales now "
+            "rival the payload and the 'compressed' transfer may exceed "
+            "the uncompressed one; pad shards to a multiple of "
+            f"{group_size} to restore the compression ratio")
+    return max(gs, 1)
+
+
+def _deq(vals: jax.Array, scale: jax.Array) -> jax.Array:
+    # symmetric quantization on the wire: offset is identically zero and
+    # never transferred (halves the fp32 side-channel bytes)
+    return vals.astype(jnp.float32) * scale
+
+
+def _pack4(v: jax.Array) -> jax.Array:
+    """[G, gs] int8 holding int4-range values -> [G, gs//2] packed bytes."""
+    pair = v.reshape(v.shape[0], -1, 2)
+    lo = pair[..., 0] & jnp.int8(0x0F)
+    hi = (pair[..., 1] & jnp.int8(0x0F)) << 4
+    return lo | hi
+
+
+def _unpack4(p: jax.Array) -> jax.Array:
+    """Inverse of :func:`_pack4` (arithmetic shifts sign-extend)."""
+    lo = (p << 4) >> 4
+    hi = p >> 4
+    return jnp.stack([lo, hi], axis=-1).reshape(p.shape[0], -1)
+
+
+def _wire(v: jax.Array, num_bits: int) -> jax.Array:
+    return _pack4(v) if num_bits == 4 else v
+
+
+def _unwire(v: jax.Array, num_bits: int) -> jax.Array:
+    return _unpack4(v) if num_bits == 4 else v
+
+
+def quantized_all_gather(x: jax.Array, group: GroupLike = None,
+                         axis: int = 0, num_bits: int = 8,
+                         group_size: int = 2048) -> jax.Array:
+    """qwZ: all-gather with an int8 (or packed-int4) payload on the wire.
+
+    ``x`` is this member's shard; the result is the tiled gather along
+    ``axis``.  For a SINGLE-axis group the layout matches
+    ``comm.all_gather`` exactly.  Multi-axis groups gather hop-by-hop in
+    the inverse order of :func:`quantized_reduce_scatter`'s hops, so
+    RS -> AG round-trips to the original layout — but the standalone
+    multi-axis layout is chunk-PERMUTED relative to
+    ``comm.all_gather(group=(a, b))`` (the standard hierarchical-
+    collective permutation); only pair it with its RS twin, or gather one
+    axis at a time when layout-compatibility with the flat collective
+    matters.  Lossy: ~0.4% relative error per group (int8 symmetric).
+    """
+    axes = _resolve_axes(group)
+    out = x
+    for ax in axes:                       # inverse of RS's reversed(axes)
+        out = _quant_gather_hop(out, ax, axis, num_bits, group_size)
+    return out
+
+
+def _quant_gather_hop(x: jax.Array, ax: str, axis: int, num_bits: int,
+                      group_size: int) -> jax.Array:
+    n = _axes_size((ax,))
+    if n == 1:
+        return x
+    numel = int(np.prod(x.shape))
+    gs = _chunk_group_size(numel, group_size, num_bits)
+    qt = quantize(x, num_bits=num_bits, group_size=gs)
+    payload = _wire(qt.values, num_bits)
+    comms_logger.append("quantized_all_gather",
+                        int(payload.size + 4 * qt.scale.size) * n, n, None,
+                        "qwZ")
+    vals = lax.all_gather(payload, ax)         # int8 on the wire
+    sc = lax.all_gather(qt.scale, ax)
+    full = jax.vmap(lambda v, s: _deq(_unwire(v, num_bits), s))(vals, sc)
+    full = full.reshape(n, -1)[:, :numel]
+    full = full.reshape((n,) + tuple(x.shape)).astype(x.dtype)
+    out = jnp.moveaxis(full, 0, axis)          # [..., n, d_axis, ...]
+    shape = list(x.shape)
+    shape[axis] *= n
+    return out.reshape(shape)
+
+
+def quantized_reduce_scatter(x: jax.Array, group: GroupLike = None,
+                             op: str = "avg", num_bits: int = 8,
+                             group_size: int = 2048) -> jax.Array:
+    """qgZ: reduce-scatter (dim 0) as quantize -> all-to-all -> local
+    dequant-reduce, hop per mesh axis, innermost axis first.
+
+    Equivalent (up to quantization noise) to hierarchical
+    ``lax.psum_scatter`` hops in the same order; each hop re-quantizes so
+    every wire transfer is int8/packed-int4.  ``op``: "sum" or "avg" (avg
+    divides by the total group size, the reference's gradient-averaging
+    semantics).
+    """
+    assert op in ("sum", "avg")
+    axes = _resolve_axes(group)
+    out = x
+    # innermost mesh axis (ICI-adjacent) first: the reference's
+    # intra-node-then-inter-node 2-hop order
+    for ax in reversed(axes):
+        out = _quant_scatter_hop(out, ax, num_bits, group_size)
+    if op == "avg":
+        out = out / _axes_size(axes)
+    return out.astype(x.dtype)
+
+
+def _quant_scatter_hop(x: jax.Array, ax: str, num_bits: int,
+                       group_size: int) -> jax.Array:
+    n = _axes_size((ax,))
+    if n == 1:
+        return x
+    d0 = x.shape[0]
+    assert d0 % n == 0, (
+        f"reduce-scatter dim {d0} not divisible by axis {ax!r} size {n}")
+    chunk_shape = (d0 // n,) + tuple(x.shape[1:])
+    chunk_numel = int(np.prod(chunk_shape))
+    gs = _chunk_group_size(chunk_numel, group_size, num_bits)
+    qt = quantize(x, num_bits=num_bits, group_size=gs)
+    payload = _wire(qt.values, num_bits)
+    comms_logger.append("quantized_reduce_scatter",
+                        int(payload.size + 4 * qt.scale.size), n, None,
+                        "qgZ")
+    gc = chunk_numel // gs                     # quant groups per chunk
+    # rows are ordered chunk-major (groups never straddle chunks), so a
+    # tiled dim-0 all-to-all routes chunk i's rows to member i
+    vals = lax.all_to_all(payload, ax, split_axis=0, concat_axis=0,
+                          tiled=True)
+    sc = lax.all_to_all(qt.scale, ax, split_axis=0, concat_axis=0,
+                        tiled=True)
+    parts = _deq(_unwire(vals, num_bits), sc).reshape(n, gc * gs)
+    return jnp.sum(parts, axis=0).reshape(chunk_shape)

@@ -12,8 +12,8 @@ APIs:
 - :func:`quantize` / :func:`dequantize` — int8 blockwise, symmetric or
   asymmetric, Pallas on TPU with identical-math jnp fallback.
 - :func:`quantize_fp8` / :func:`dequantize_fp8` — scaled fp8 (e4m3) cast.
-- :func:`quantized_allgather_spec` helpers live in the ZeRO++ collectives
-  (``runtime/comm``), which call these kernels.
+- the ZeRO++ qwZ/qgZ collectives live in ``comm/quantized.py`` and call
+  these kernels for the wire payloads.
 """
 from __future__ import annotations
 

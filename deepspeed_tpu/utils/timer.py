@@ -42,6 +42,7 @@ class Timer:
         self._start_time = 0.0
         self._elapsed = 0.0
         self._record_count = 0
+        self.last_interval = 0.0
 
     def start(self) -> None:
         assert not self.started, f"timer {self.name} already started"
@@ -54,9 +55,15 @@ class Timer:
         assert self.started, f"timer {self.name} not started"
         if self.synchronize:
             _device_sync()
-        self._elapsed += time.perf_counter() - self._start_time
+        self.last_interval = time.perf_counter() - self._start_time
+        self._elapsed += self.last_interval
         if record:
             self._record_count += 1
+        self.started = False
+
+    def discard(self) -> None:
+        """Abandon an in-flight interval without recording it (and without
+        touching the accumulated window, unlike :meth:`reset`)."""
         self.started = False
 
     def reset(self) -> None:

@@ -1,0 +1,165 @@
+"""ZeRO++ quantized-collective tests (reference:
+tests/unit/runtime/zero/test_zeropp.py — qwZ/qgZ correctness and training
+parity).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.comm.quantized import (quantized_all_gather,
+                                          quantized_reduce_scatter)
+
+
+def _mesh8():
+    return dist.initialize_mesh(dp=8)
+
+
+def test_quantized_all_gather_matches_all_gather(devices):
+    topo = _mesh8()
+    rng = np.random.default_rng(0)
+    full = rng.normal(size=(64, 32)).astype(np.float32)
+
+    def f(x):
+        return quantized_all_gather(x, group="data", group_size=128)
+
+    out = jax.jit(jax.shard_map(f, mesh=topo.mesh,
+                                in_specs=P("data"), out_specs=P("data"),
+                                check_vma=False))(full)
+    # every member reconstructs the full array up to int8 group error
+    err = np.abs(np.asarray(out[:64]) - full)
+    scale = np.abs(full).reshape(-1, 128).max(axis=1, keepdims=True) / 127.0
+    assert (err.reshape(-1, 128) <= scale * 0.51 + 1e-7).all(), err.max()
+    # and it is genuinely close
+    assert np.abs(err).max() < 0.05
+
+
+@pytest.mark.parametrize("axes,mesh_kw", [
+    (("data",), dict(dp=8)),
+    (("data", "data_sub"), dict(dp=8, hpz=2)),   # hierarchical 2-hop
+])
+def test_quantized_reduce_scatter_approximates_psum_scatter(devices, axes,
+                                                            mesh_kw):
+    topo = dist.initialize_mesh(**mesh_kw)
+    rng = np.random.default_rng(1)
+    # per-member distinct contributions: global [8, 64, 16]
+    contrib = rng.normal(size=(8, 64, 16)).astype(np.float32)
+
+    def quant(x):
+        return quantized_reduce_scatter(x, group=axes, op="sum",
+                                        group_size=64)
+
+    def exact(x):
+        out = x
+        for ax in reversed(axes):
+            out = jax.lax.psum_scatter(out, ax, scatter_dimension=0,
+                                       tiled=True)
+        return out
+
+    got, want = [
+        jax.jit(jax.shard_map(f, mesh=topo.mesh, in_specs=P(axes),
+                              out_specs=P(axes), check_vma=False))(
+            contrib.reshape(-1, 16))
+        for f in (quant, exact)
+    ]
+    got, want = np.asarray(got), np.asarray(want)
+    # int8 noise across 8 summed contributions stays small vs signal
+    denom = np.abs(want).mean() + 1e-6
+    assert np.abs(got - want).mean() / denom < 0.02
+    np.testing.assert_allclose(got, want, atol=0.2)
+
+
+def test_quantized_dp_training_tracks_full_precision(devices):
+    """Manual-DP loop: local grads -> qgZ reduce-scatter -> qwZ all-gather
+    (the ZeRO++ wire pattern) vs full-precision psum.  Loss trajectories
+    must track (the reference's qgZ convergence claim)."""
+    topo = _mesh8()
+    rng = np.random.default_rng(2)
+    W0 = rng.normal(size=(32, 32)).astype(np.float32) * 0.3
+    X = rng.normal(size=(64, 32)).astype(np.float32)
+    Y = rng.normal(size=(64, 32)).astype(np.float32)
+
+    def local_grad(w, x, y):
+        def loss(w):
+            return jnp.mean((x @ w - y) ** 2)
+
+        return jax.value_and_grad(loss)(w)
+
+    def make_step(quantized):
+        def step(w, x, y):
+            loss, g = local_grad(w, x, y)
+            loss = jax.lax.pmean(loss, "data")
+            if quantized:
+                flat = g.reshape(-1)
+                shard = quantized_reduce_scatter(flat, group="data",
+                                                 op="avg", group_size=128)
+                g = quantized_all_gather(shard, group="data",
+                                         group_size=128).reshape(g.shape)
+            else:
+                g = jax.lax.pmean(g, "data")
+            return w - 0.3 * g, loss
+
+        return jax.jit(jax.shard_map(
+            step, mesh=topo.mesh,
+            in_specs=(P(), P("data"), P("data")),
+            out_specs=(P(), P()), check_vma=False))
+
+    traj = {}
+    for quantized in (False, True):
+        step = make_step(quantized)
+        w = jnp.asarray(W0)
+        losses = []
+        for _ in range(12):
+            w, loss = step(w, X, Y)
+            losses.append(float(loss))
+        traj[quantized] = losses
+    assert traj[True][-1] < traj[True][0] * 0.7, traj[True]
+    np.testing.assert_allclose(traj[True], traj[False], rtol=0.05)
+
+
+def test_multi_axis_roundtrip_preserves_layout(devices):
+    """RS then AG over a 2-axis group must reconstruct the ORIGINAL chunk
+    layout (the hops are mutually inverse) — a permuted reconstruction
+    would silently train on misassigned gradient blocks."""
+    topo = dist.initialize_mesh(dp=8, hpz=2)
+    axes = ("data", "data_sub")
+    x = np.arange(64 * 8, dtype=np.float32).reshape(64, 8)
+
+    def f(v):
+        shard = quantized_reduce_scatter(v, group=axes, op="sum",
+                                         group_size=8)
+        return quantized_all_gather(shard, group=axes, group_size=8)
+
+    out = jax.jit(jax.shard_map(f, mesh=topo.mesh, in_specs=P(axes),
+                                out_specs=P(axes), check_vma=False))(x)
+    # every member contributed identical slices? No: in_specs=P(axes)
+    # shards x, so the sum reduces 8 distinct slices; the reconstruction
+    # must equal 8 * mean == exact sum layout
+    want = np.tile(x.reshape(8, 8, 8).sum(axis=0), (8, 1)).astype(np.float32)
+    got = np.asarray(out)
+    np.testing.assert_allclose(got, want, rtol=0.02, atol=2.0)
+
+
+def test_int4_packing_halves_payload(devices):
+    """num_bits=4 packs two values per wire byte and still reconstructs."""
+    from deepspeed_tpu.comm.quantized import _pack4, _unpack4
+
+    rng = np.random.default_rng(3)
+    v = rng.integers(-7, 8, size=(4, 64)).astype(np.int8)
+    packed = _pack4(jnp.asarray(v))
+    assert packed.shape == (4, 32)
+    np.testing.assert_array_equal(np.asarray(_unpack4(packed)), v)
+
+    topo = _mesh8()
+    full = rng.normal(size=(64, 32)).astype(np.float32)
+    out = jax.jit(jax.shard_map(
+        lambda x: quantized_all_gather(x, group="data", num_bits=4,
+                                       group_size=64),
+        mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"),
+        check_vma=False))(full)
+    # int4 error bound: half step of absmax/7 per group
+    err = np.abs(np.asarray(out[:64]) - full)
+    bound = np.abs(full).reshape(-1, 64).max(axis=1, keepdims=True) / 7 * 0.51
+    assert (err.reshape(-1, 64) <= bound + 1e-6).all()
