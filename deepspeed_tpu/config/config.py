@@ -268,6 +268,8 @@ class ElasticityConfig(ConfigModel):
     version: float = 0.2
     ignore_non_elastic_batch_info: bool = False
     prefer_larger_batch: bool = True
+    model_parallel_size: int = 1
+    num_gpus_per_node: int = 1
 
 
 class CurriculumParams(ConfigModel):
@@ -443,10 +445,48 @@ def load_config(config: Union[str, Dict[str, Any], DeepSpeedConfig, None],
         cfg = DeepSpeedConfig(**config)
     else:
         raise TypeError(f"Unsupported config type: {type(config)}")
+    if cfg.elasticity.enabled and dp_world_size is not None:
+        _apply_elasticity(cfg, dp_world_size)
     if dp_world_size is not None:
         cfg.reconcile_batch_size(dp_world_size)
     warn_unimplemented(cfg)
     return cfg
+
+
+def _apply_elasticity(cfg: DeepSpeedConfig, dp_world_size: int) -> None:
+    """Elastic mode takes over the batch triple (reference
+    ``runtime/config.py:735-796``): solve for the (batch, chip menu,
+    micro) triple, validate the current world size against the menu, and
+    override whatever batch parameters the user wrote."""
+    from deepspeed_tpu.elasticity import (ElasticityConfigError,
+                                          compute_elastic_config,
+                                          ensure_immutable_elastic_config)
+
+    edict = cfg.elasticity.model_dump()
+    user_batch_keys = [
+        k for k, v in (("train_batch_size", cfg.train_batch_size),
+                       ("train_micro_batch_size_per_gpu",
+                        cfg.train_micro_batch_size_per_gpu),
+                       ("gradient_accumulation_steps",
+                        cfg.gradient_accumulation_steps)) if v is not None]
+    if user_batch_keys and not cfg.elasticity.ignore_non_elastic_batch_info:
+        raise ElasticityConfigError(
+            f"batch parameters {user_batch_keys} are controlled by elastic "
+            "training and will not be used; set "
+            "elasticity.ignore_non_elastic_batch_info=true to silence")
+    ensure_immutable_elastic_config(edict)
+
+    world = dp_world_size * max(cfg.elasticity.model_parallel_size, 1)
+    batch, menu, micro = compute_elastic_config(
+        {"elasticity": edict}, world_size=world)
+    gas = batch // (micro * dp_world_size)
+    for key, new in (("train_batch_size", batch),
+                     ("train_micro_batch_size_per_gpu", micro),
+                     ("gradient_accumulation_steps", gas)):
+        old = getattr(cfg, key)
+        if old is not None and old != new:
+            logger.warning(f"[Elasticity] overriding {key}: {old} -> {new}")
+        setattr(cfg, key, new)
 
 
 def warn_unimplemented(cfg: DeepSpeedConfig) -> None:
@@ -469,10 +509,6 @@ def warn_unimplemented(cfg: DeepSpeedConfig) -> None:
     if offl_o is not None and offl_o.device == "nvme":
         notes.append("offload_optimizer.device=nvme (device=cpu "
                      "pinned-host offload IS supported)")
-    if cfg.flops_profiler.enabled:
-        notes.append("flops_profiler")
-    if cfg.elasticity.enabled:
-        notes.append("elasticity")
     if cfg.data_efficiency.enabled:
         notes.append("data_efficiency")
     if cfg.curriculum_learning.enabled:

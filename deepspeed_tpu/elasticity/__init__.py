@@ -1,0 +1,14 @@
+from deepspeed_tpu.elasticity.elasticity import (
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+)
+
+__all__ = [
+    "compute_elastic_config", "elasticity_enabled",
+    "ensure_immutable_elastic_config", "ElasticityError",
+    "ElasticityConfigError", "ElasticityIncompatibleWorldSize",
+]

