@@ -1,0 +1,6 @@
+from deepspeed_tpu.profiling.flops_profiler import (
+    FlopsProfiler,
+    get_model_profile,
+)
+
+__all__ = ["FlopsProfiler", "get_model_profile"]

@@ -369,6 +369,7 @@ class DeepSpeedEngine:
         self._apply_step_fn = None
         self._pending_grads = None
         self._pending_loss = None
+        self._profile_batch = None
         self._lr_cached_value = None
         self._lr_cached_dev = None
 
@@ -669,14 +670,21 @@ class DeepSpeedEngine:
                 consecutive_hysteresis=fp16.consecutive_hysteresis,
                 init_hysteresis=fp16.hysteresis)
             rng, new_rng = jax.random.split(state.rng)
-            return TrainState(step=state.step + 1, params=new_params,
-                              opt_state=new_opt, scale=new_scale, rng=new_rng,
-                              skipped_steps=state.skipped_steps +
-                              overflow.astype(jnp.int32))
+            new_state = TrainState(step=state.step + 1, params=new_params,
+                                   opt_state=new_opt, scale=new_scale,
+                                   rng=new_rng,
+                                   skipped_steps=state.skipped_steps +
+                                   overflow.astype(jnp.int32))
+            return new_state, {"grad_norm": grad_norm,
+                               "overflow": overflow,
+                               "loss_scale": new_scale.loss_scale}
 
+        metric_shardings = {k: self._repl()
+                            for k in ("grad_norm", "overflow", "loss_scale")}
         return jax.jit(apply_step,
                        in_shardings=(self._state_shardings, None, None),
-                       out_shardings=self._state_shardings,
+                       out_shardings=(self._state_shardings,
+                                      metric_shardings),
                        donate_argnums=(0,))
 
     # ------------------------------------------------------------------
@@ -741,7 +749,12 @@ class DeepSpeedEngine:
         breakdown = self.config.wall_clock_breakdown
         if breakdown:
             self.timers("batch_prep").start()
-        gbatch = self._to_gas_batch(batch)
+        try:
+            gbatch = self._to_gas_batch(batch)
+        except Exception:
+            if breakdown:
+                self.timers("batch_prep").discard()
+            raise
         if breakdown:
             self.timers("batch_prep").stop()
         if self._train_step_fn is None:
@@ -751,7 +764,12 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         if breakdown:
             self.timers(STEP_GLOBAL_TIMER).start()
-        self.state, metrics = self._train_step_fn(self.state, gbatch, lr)
+        try:
+            self.state, metrics = self._train_step_fn(self.state, gbatch, lr)
+        except Exception:
+            if breakdown:
+                self.timers(STEP_GLOBAL_TIMER).discard()
+            raise
         if breakdown:
             # one fused XLA program covers fwd+bwd+step; the device-synced
             # bracket is the whole step (fwd/bwd are not separable without
@@ -785,7 +803,33 @@ class DeepSpeedEngine:
                  self.global_samples),
                 ("Train/Samples/lr", self.get_lr()[0], self.global_samples),
             ])
+        fp = self.config.flops_profiler
+        if fp.enabled and self.global_steps == fp.profile_step:
+            self._run_flops_profiler(gbatch, lr)
         return metrics["loss"]
+
+    def _run_flops_profiler(self, gbatch, lr) -> None:
+        """One-shot step profile at ``flops_profiler.profile_step``
+        (reference wires this in ``engine._take_model_step``; here the
+        whole fused step is re-traced once and costed from its jaxpr)."""
+        from deepspeed_tpu.profiling import FlopsProfiler
+
+        fp = self.config.flops_profiler
+        prof = FlopsProfiler(self._train_step_fn, ds_engine=self)
+        prof.start_profile()
+        # duration: the step jit donates the state, so it cannot be re-run
+        # for measurement; reuse the wall_clock_breakdown bracket when on
+        duration = 0.0
+        if self.config.wall_clock_breakdown:
+            duration = self.timers(STEP_GLOBAL_TIMER).last_interval
+        prof.profile(self.state, gbatch, lr, params=self.state.params,
+                     duration=duration)
+        prof.print_model_profile(profile_step=fp.profile_step,
+                                 module_depth=fp.module_depth,
+                                 top_modules=fp.top_modules,
+                                 detailed=fp.detailed,
+                                 output_file=fp.output_file)
+        prof.end_profile()
 
     def eval_batch(self, data_iter: Optional[Iterator] = None,
                    batch: Any = None) -> jax.Array:
@@ -829,6 +873,7 @@ class DeepSpeedEngine:
             self._pending_grads = jax.tree_util.tree_map(
                 jnp.add, self._pending_grads, grads)
         self.micro_steps += 1
+        self._profile_batch = self._fwd_batch  # kept for flops profiling
         self._fwd_batch = None
 
     def is_gradient_accumulation_boundary(self) -> bool:
@@ -843,10 +888,38 @@ class DeepSpeedEngine:
         if self._apply_step_fn is None:
             self._apply_step_fn = self._build_apply_step()
         lr = jnp.asarray(self.get_lr()[0], jnp.float32)
-        self.state = self._apply_step_fn(self.state, self._pending_grads, lr)
+        fp = self.config.flops_profiler
+        if fp.enabled and self.global_steps + 1 == fp.profile_step:
+            self._profile_imperative_step(lr)
+        self.state, self._last_metrics = self._apply_step_fn(
+            self.state, self._pending_grads, lr)
         self._pending_grads = None
         self.global_steps += 1
         self.lr_scheduler.step()
+
+    def _profile_imperative_step(self, lr) -> None:
+        """Flops profile for the imperative fwd/bwd/step path: cost the
+        grad fn (fwd+bwd, the dominant FLOPs) and the optimizer apply,
+        merged into one report (the fused ``train_batch`` path instead
+        profiles its single step program)."""
+        from deepspeed_tpu.profiling import FlopsProfiler
+        from deepspeed_tpu.profiling.flops_profiler import (_merge,
+                                                            profile_fn)
+
+        fp = self.config.flops_profiler
+        prof = FlopsProfiler(self._grad_step_fn, ds_engine=self)
+        prof.start_profile()
+        prof.profile(self.state, self._profile_batch, self._fwd_rng,
+                     params=self.state.params)
+        apply_tree = profile_fn(self._apply_step_fn, self.state,
+                                self._pending_grads, lr)
+        _merge(prof._tree, apply_tree)
+        prof.print_model_profile(profile_step=fp.profile_step,
+                                 module_depth=fp.module_depth,
+                                 top_modules=fp.top_modules,
+                                 detailed=fp.detailed,
+                                 output_file=fp.output_file)
+        prof.end_profile()
 
     # -- checkpointing ----------------------------------------------------
 

@@ -1,0 +1,121 @@
+"""Flops profiler tests (reference
+``tests/unit/profiling/flops_profiler/test_flops_profiler.py`` strategy:
+profile known architectures and check the counts analytically)."""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.profiling import FlopsProfiler, get_model_profile
+from deepspeed_tpu.profiling.flops_profiler import profile_fn
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(64, name="fc1")(x)
+        x = nn.gelu(x)
+        return nn.Dense(16, name="fc2")(x)
+
+
+class TestCounts:
+    def test_mlp_macs_and_params_exact(self):
+        flops, macs, params = get_model_profile(
+            MLP(), input_shape=(4, 32), print_profile=False,
+            as_string=False)
+        assert macs == 4 * 32 * 64 + 4 * 64 * 16
+        assert params == (32 * 64 + 64) + (64 * 16 + 16)
+        assert flops >= 2 * macs  # bias adds + gelu on top
+
+    def test_matmul_fn_flops(self):
+        a = jnp.ones((8, 16))
+        b = jnp.ones((16, 32))
+        tree = profile_fn(lambda a, b: a @ b, a, b)
+        assert tree.flops == 2 * 8 * 16 * 32
+        assert tree.macs == 8 * 16 * 32
+
+    def test_scan_multiplies_by_trip_count(self):
+        w = jnp.ones((16, 16))
+
+        def step(x, _):
+            return x @ w, None
+
+        def scanned(x):
+            return jax.lax.scan(step, x, None, length=7)[0]
+
+        tree = profile_fn(scanned, jnp.ones((4, 16)))
+        assert tree.macs == 7 * 4 * 16 * 16
+
+    def test_cond_bills_expensive_branch(self):
+        w = jnp.ones((16, 16))
+
+        def f(x, flag):
+            return jax.lax.cond(flag, lambda x: (x @ w) @ w,
+                                lambda x: x, x)
+
+        tree = profile_fn(f, jnp.ones((4, 16)), jnp.bool_(True))
+        assert tree.macs == 2 * 4 * 16 * 16
+
+    def test_conv_macs(self):
+        class Conv(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Conv(8, (3, 3), padding="VALID")(x)
+
+        _, macs, _ = get_model_profile(Conv(), input_shape=(1, 10, 10, 4),
+                                       print_profile=False, as_string=False)
+        # out 8x8x8, kernel 3x3, cin 4
+        assert macs == (8 * 8 * 8) * 3 * 3 * 4
+
+    def test_jit_boundary_transparent(self):
+        a = jnp.ones((8, 16))
+        b = jnp.ones((16, 32))
+        tree = profile_fn(jax.jit(lambda a, b: a @ b), a, b)
+        assert tree.macs == 8 * 16 * 32
+
+
+class TestModuleAttribution:
+    def test_breakdown_paths(self):
+        model = MLP()
+        p = model.init(jax.random.PRNGKey(0), jnp.ones((4, 32)))
+        prof = FlopsProfiler(lambda v, x: model.apply(v, x))
+        prof.start_profile()
+        prof.profile(p, jnp.ones((4, 32)), params=p["params"],
+                     root_name="MLP")
+        tree = prof._tree
+        mlp = tree.children["MLP"]
+        assert set(mlp.children) >= {"fc1", "fc2"}
+        assert mlp.children["fc1"].macs == 4 * 32 * 64
+        assert mlp.children["fc1"].params == 32 * 64 + 64
+        prof.end_profile()
+
+    def test_as_string_render(self):
+        flops, macs, params = get_model_profile(
+            MLP(), input_shape=(4, 32), print_profile=False, as_string=True)
+        assert "FLOPs" in flops and "MACs" in macs
+
+
+class TestEngineWiring:
+    def test_profile_printed_at_step(self, capsys):
+        from tests.unit.simple_model import tiny_gpt2
+
+        ds = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "flops_profiler": {"enabled": True, "profile_step": 2,
+                               "module_depth": 2},
+            "steps_per_print": 1000,
+        }
+        batch = {"input_ids": np.ones((8, 16), np.int32)}
+        engine, *_ = deepspeed_tpu.initialize(
+            model=tiny_gpt2(), config=ds,
+            example_batch=batch, rng=jax.random.PRNGKey(0))
+        engine.train_batch(batch=batch)
+        out1 = capsys.readouterr().out
+        assert "Flops Profiler" not in out1
+        engine.train_batch(batch=batch)
+        out2 = capsys.readouterr().out
+        assert "Flops Profiler" in out2
+        assert "params:" in out2
