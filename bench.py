@@ -1,10 +1,21 @@
-"""Headline benchmark: GPT-2 training throughput + MFU on one chip.
+"""Benchmark matrix: the BASELINE.md target configs, one JSON line each.
 
-Run by the driver on real TPU hardware at the end of every round; prints ONE
-JSON line ``{"metric", "value", "unit", "vs_baseline"}``.  The metric is
-model FLOPs utilization (MFU) for a bf16 GPT-2 train step — the BASELINE.md
-north star is ZeRO-3 Llama-2-7B at >=45% MFU on v5p-128, so ``vs_baseline``
-reports value/45.
+Default (no args) runs config 1 — the driver's headline number — and
+prints ONE JSON line ``{"metric", "value", "unit", "vs_baseline"}``.
+
+The full matrix (``--config N``) mirrors BASELINE.md's target list:
+
+1. GPT-2 125M, ZeRO-0 DDP           — headline train MFU (north star 45%)
+2. GPT-2 1.3B, ZeRO-2 + fused Adam  — train MFU, bf16
+3. Llama-2-7B-class, ZeRO-3         — train MFU (``--size`` to shrink)
+4. Long-context Ulysses SP          — attention-heavy train MFU @ 32k seq
+5. Mixtral-class MoE + EP           — train MFU (active-params FLOPs)
+6. (``--config infer``) KV-cache decode — tokens/s/chip
+
+Configs 2-5 size to a single v5p chip by default; ``--size`` swaps the
+model preset (e.g. ``--size gpt2-350m``) and ``--smoke`` shrinks shapes
+for CPU runs.  On multi-chip hosts every config shards over all visible
+chips (data axis; config 4 prefers the seq axis, 5 the expert axis).
 
 MFU is computed from *device* step time (jax.profiler XPlane events): this
 benchmark may run through a remote-device tunnel whose per-dispatch host
@@ -14,6 +25,7 @@ framework or the chip.  Wall-clock throughput is reported alongside in
 """
 from __future__ import annotations
 
+import argparse
 import glob
 import json
 import shutil
@@ -85,87 +97,356 @@ def device_seconds_per_call(fn, n: int = 10):
     return wall, wall
 
 
-def main() -> None:
+def _measure_train(engine, batch, *, steps, micro_global, seq,
+                   flops_per_tok, metric, vs=NORTH_STAR_MFU,
+                   extra_detail=None):
+    """Shared harness: warm up, time the step, print the JSON line."""
     dev = jax.devices()[0]
-    on_tpu = dev.platform != "cpu"
-
-    import deepspeed_tpu
-    import deepspeed_tpu.comm as dist
-    from deepspeed_tpu.models.gpt2 import (GPT2LMLoss, count_params,
-                                           get_config)
-
-    if on_tpu:
-        cfg_model = get_config("gpt2-125m", n_positions=1024,
-                               dtype=jnp.bfloat16, remat=False,
-                               remat_policy="none", scan_layers=True,
-                               use_flash_attention=True)
-        micro, seq, steps = 8, 1024, 20
-    else:  # CPU smoke: tiny shapes so the line still prints
-        cfg_model = get_config("gpt2-125m", n_positions=128, n_embd=256,
-                               n_layer=4, n_head=4, dtype=jnp.float32,
-                               remat=False)
-        micro, seq, steps = 2, 128, 3
-
-    topo = dist.initialize_mesh()  # all visible devices on the data axis
-    dp = topo.zero_partition_count()
-    ds_config = {
-        "train_batch_size": micro * dp,
-        "train_micro_batch_size_per_gpu": micro,
-        "gradient_accumulation_steps": 1,
-        "bf16": {"enabled": bool(on_tpu)},
-        "zero_optimization": {"stage": 0},
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4,
-                                                  "weight_decay": 0.01}},
-        "steps_per_print": 1000000,
-    }
-    rng = np.random.default_rng(0)
-    batch = {"input_ids": rng.integers(
-        0, cfg_model.vocab_size, size=(micro * dp, seq), dtype=np.int32)}
-
-    engine, *_ = deepspeed_tpu.initialize(
-        model=GPT2LMLoss(cfg_model), config=ds_config, topology=topo,
-        example_batch={"input_ids": batch["input_ids"][:1]},
-        rng=jax.random.PRNGKey(0))
-
-    n_params = count_params(engine.state.params)
-
-    # stage the batch on device once: steady-state training streams batches
-    # ahead of the step, so per-step host->device time is not what we measure
     dbatch = engine.put_batch(batch)
-
-    # warmup (compile)
-    loss = engine.train_batch(batch=dbatch)
+    loss = engine.train_batch(batch=dbatch)          # compile
     float(jax.device_get(loss))
 
     dev_dt, wall_dt = device_seconds_per_call(
         lambda: engine.train_batch(batch=dbatch), n=steps)
     loss = engine.train_batch(batch=dbatch)
 
-    samples_per_sec = micro * dp / dev_dt
-    tokens_per_sec = samples_per_sec * seq
-    from deepspeed_tpu.models.gpt2 import flops_per_token
-    model_flops = tokens_per_sec * flops_per_token(cfg_model, seq)
     n_chips = len(jax.devices())
-    mfu = 100.0 * model_flops / (peak_flops(dev.device_kind) * n_chips)
-
-    result = {
-        "metric": "gpt2_125m_bf16_train_mfu",
+    samples_per_sec = micro_global / dev_dt
+    tokens_per_sec = samples_per_sec * seq
+    mfu = 100.0 * tokens_per_sec * flops_per_tok / (
+        peak_flops(dev.device_kind) * n_chips)
+    detail = {
+        "samples_per_sec_per_chip": round(samples_per_sec / n_chips, 2),
+        "tokens_per_sec": round(tokens_per_sec),
+        "device_step_ms": round(dev_dt * 1e3, 1),
+        "wall_step_ms": round(wall_dt * 1e3, 1),
+        "wall_tokens_per_sec": round(micro_global * seq / wall_dt),
+        "device": dev.device_kind,
+        "n_chips": n_chips,
+        "final_loss": float(jax.device_get(loss)),
+    }
+    detail.update(extra_detail or {})
+    print(json.dumps({
+        "metric": metric,
         "value": round(mfu, 2),
         "unit": "% MFU",
-        "vs_baseline": round(mfu / NORTH_STAR_MFU, 3),
-        "detail": {
-            "samples_per_sec_per_chip": round(samples_per_sec / n_chips, 2),
-            "tokens_per_sec": round(tokens_per_sec),
-            "device_step_ms": round(dev_dt * 1e3, 1),
-            "wall_step_ms": round(wall_dt * 1e3, 1),
-            "wall_tokens_per_sec": round(micro * dp * seq / wall_dt),
-            "params": n_params,
-            "device": dev.device_kind,
-            "n_chips": n_chips,
-            "final_loss": float(jax.device_get(loss)),
-        },
+        "vs_baseline": round(mfu / vs, 3),
+        "detail": detail,
+    }))
+
+
+def _tokens(vocab, batch, seq, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, size=(batch, seq),
+                                      dtype=np.int32)}
+
+
+# ---------------------------------------------------------------------------
+# configs
+# ---------------------------------------------------------------------------
+
+def bench_gpt2_ddp(args) -> None:
+    """Config 1 (headline): GPT-2 125M, ZeRO-0 DDP."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models.gpt2 import (GPT2LMLoss, count_params,
+                                           flops_per_token, get_config)
+
+    on_tpu = not args.smoke
+    size = args.size or "gpt2-125m"
+    if on_tpu:
+        cfg = get_config(size, n_positions=1024,
+                         dtype=jnp.bfloat16, remat=False,
+                         remat_policy="none", scan_layers=True,
+                         use_flash_attention=True)
+        micro, seq, steps = 8, 1024, args.steps
+    else:
+        cfg = get_config("gpt2-125m", n_positions=128, n_embd=256,
+                         n_layer=4, n_head=4, dtype=jnp.float32,
+                         remat=False)
+        micro, seq, steps = 2, 128, 3
+
+    topo = dist.initialize_mesh()
+    dp = topo.zero_partition_count()
+    ds = {
+        "train_batch_size": micro * dp,
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": on_tpu},
+        "zero_optimization": {"stage": 0},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4,
+                                                  "weight_decay": 0.01}},
+        "steps_per_print": 1000000,
     }
-    print(json.dumps(result))
+    batch = _tokens(cfg.vocab_size, micro * dp, seq)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=GPT2LMLoss(cfg), config=ds, topology=topo,
+        example_batch={"input_ids": batch["input_ids"][:1]},
+        rng=jax.random.PRNGKey(0))
+    _measure_train(
+        engine, batch, steps=steps, micro_global=micro * dp, seq=seq,
+        flops_per_tok=flops_per_token(cfg, seq),
+        metric="gpt2_125m_bf16_train_mfu",
+        extra_detail={"params": count_params(engine.state.params)})
+
+
+def bench_gpt2_zero2_fused(args) -> None:
+    """Config 2: GPT-2 1.3B, ZeRO-2, fused (Pallas) Adam, bf16."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models.gpt2 import (GPT2LMLoss, count_params,
+                                           flops_per_token, get_config)
+
+    on_tpu = not args.smoke
+    size = args.size or ("gpt2-1.3b" if on_tpu else "gpt2-125m")
+    if on_tpu:
+        cfg = get_config(size, n_positions=1024, dtype=jnp.bfloat16,
+                         remat=True, remat_policy="dots_saveable",
+                         scan_layers=True, use_flash_attention=True)
+        micro, seq, steps = 4, 1024, args.steps
+    else:
+        cfg = get_config(size, n_positions=128, n_embd=256, n_layer=4,
+                         n_head=4, dtype=jnp.float32, remat=False)
+        micro, seq, steps = 2, 128, 3
+
+    topo = dist.initialize_mesh()
+    dp = topo.zero_partition_count()
+    ds = {
+        "train_batch_size": micro * dp,
+        "train_micro_batch_size_per_gpu": micro,
+        "bf16": {"enabled": on_tpu},
+        "zero_optimization": {"stage": 2},
+        "optimizer": {"type": "FusedAdam",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "steps_per_print": 1000000,
+    }
+    batch = _tokens(cfg.vocab_size, micro * dp, seq)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=GPT2LMLoss(cfg), config=ds, topology=topo,
+        example_batch={"input_ids": batch["input_ids"][:1]},
+        rng=jax.random.PRNGKey(0))
+    _measure_train(
+        engine, batch, steps=steps, micro_global=micro * dp, seq=seq,
+        flops_per_tok=flops_per_token(cfg, seq),
+        metric=f"{size.replace('-', '_').replace('.', '_')}"
+               "_zero2_fused_adam_train_mfu",
+        extra_detail={"params": count_params(engine.state.params),
+                      "zero_stage": 2})
+
+
+def bench_llama_zero3(args) -> None:
+    """Config 3: Llama-2-7B-class, ZeRO-3 (sharded params + optimizer)."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models.llama import (LlamaLMLoss, count_params,
+                                            flops_per_token, get_config)
+
+    on_tpu = not args.smoke
+    size = args.size or ("llama2-7b" if on_tpu else "tinyllama")
+    if on_tpu:
+        cfg = get_config(size, max_position_embeddings=2048,
+                         dtype=jnp.bfloat16, remat=True,
+                         remat_policy="dots_saveable", scan_layers=True,
+                         use_flash_attention=True)
+        micro, seq, steps = 1, 2048, args.steps
+    else:
+        cfg = get_config(size, dtype=jnp.float32, remat=False)
+        micro, seq, steps = 2, 32, 3
+
+    topo = dist.initialize_mesh()
+    dp = topo.zero_partition_count()
+    ds = {
+        "train_batch_size": micro * dp,
+        "train_micro_batch_size_per_gpu": micro,
+        "bf16": {"enabled": on_tpu},
+        "zero_optimization": {"stage": 3,
+                              "stage3_param_persistence_threshold": 10000},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "steps_per_print": 1000000,
+    }
+    batch = _tokens(cfg.vocab_size, micro * dp, seq)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=LlamaLMLoss(cfg), config=ds, topology=topo,
+        example_batch={"input_ids": batch["input_ids"][:1]},
+        rng=jax.random.PRNGKey(0))
+    _measure_train(
+        engine, batch, steps=steps, micro_global=micro * dp, seq=seq,
+        flops_per_tok=flops_per_token(cfg, seq),
+        metric=f"{size.replace('-', '_')}_zero3_train_mfu",
+        extra_detail={"params": count_params(engine.state.params),
+                      "zero_stage": 3})
+
+
+def bench_ulysses_longctx(args) -> None:
+    """Config 4: long-context Ulysses SP (all-to-all attention heads)."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models.llama import (LlamaLMLoss, count_params,
+                                            flops_per_token, get_config)
+
+    on_tpu = not args.smoke
+    n_dev = len(jax.devices())
+    sp = n_dev  # whole mesh on the sequence axis
+    if on_tpu:
+        size = args.size or "llama2-7b"
+        seq = 32768
+        cfg = get_config(size, max_position_embeddings=seq,
+                         dtype=jnp.bfloat16, remat=True,
+                         remat_policy="dots_saveable", scan_layers=True,
+                         use_flash_attention=True,
+                         sequence_parallel="ulysses" if sp > 1 else "none")
+        micro, steps = 1, max(args.steps // 2, 3)
+    else:
+        size = args.size or "tinyllama"
+        seq = 64
+        cfg = get_config(size, dtype=jnp.float32, remat=False,
+                         max_position_embeddings=seq,
+                         sequence_parallel="ulysses" if sp > 1 else "none")
+        micro, steps = 1, 3
+
+    topo = dist.initialize_mesh(sp=sp) if sp > 1 else dist.initialize_mesh()
+    ds = {
+        "train_batch_size": micro,
+        "train_micro_batch_size_per_gpu": micro,
+        "bf16": {"enabled": on_tpu},
+        "zero_optimization": {"stage": 1 if sp > 1 else 0},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "steps_per_print": 1000000,
+    }
+    batch = _tokens(cfg.vocab_size, micro, seq)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=LlamaLMLoss(cfg), config=ds, topology=topo,
+        example_batch={"input_ids": batch["input_ids"][:1]},
+        rng=jax.random.PRNGKey(0))
+    _measure_train(
+        engine, batch, steps=steps, micro_global=micro, seq=seq,
+        flops_per_tok=flops_per_token(cfg, seq),
+        metric=f"ulysses_seq{seq}_train_mfu",
+        extra_detail={"params": count_params(engine.state.params),
+                      "seq_parallel": sp, "seqlen": seq})
+
+
+def bench_moe_ep(args) -> None:
+    """Config 5: Mixtral-class MoE, expert parallel + ZeRO.  MFU counts
+    ACTIVE params only (top-k routing), the MoE convention."""
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models.mixtral import (MixtralLMLoss, count_params,
+                                              flops_per_token, get_config)
+
+    on_tpu = not args.smoke
+    n_dev = len(jax.devices())
+    if on_tpu:
+        # single-chip-sized mixtral (~1B total, ~0.4B active)
+        cfg = get_config("tinymixtral", vocab_size=32000, hidden_size=1024,
+                         intermediate_size=3584, num_hidden_layers=12,
+                         num_attention_heads=16, num_key_value_heads=8,
+                         num_local_experts=8, num_experts_per_tok=2,
+                         max_position_embeddings=1024,
+                         dtype=jnp.bfloat16, remat=True,
+                         remat_policy="dots_saveable", scan_layers=True,
+                         use_flash_attention=True) \
+            if args.size is None else get_config(
+                args.size, dtype=jnp.bfloat16, remat=True,
+                scan_layers=True, use_flash_attention=True)
+        micro, seq, steps = 4, 1024, args.steps
+    else:
+        cfg = get_config("tinymixtral", dtype=jnp.float32, remat=False)
+        micro, seq, steps = 2, 32, 3
+
+    ep = min(n_dev, cfg.num_local_experts)
+    topo = dist.initialize_mesh(dp=n_dev // ep, ep=ep) if ep > 1 \
+        else dist.initialize_mesh()
+    dp = topo.zero_partition_count()
+    ds = {
+        "train_batch_size": micro * max(dp, 1),
+        "train_micro_batch_size_per_gpu": micro,
+        "bf16": {"enabled": on_tpu},
+        "zero_optimization": {"stage": 2},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "steps_per_print": 1000000,
+    }
+    batch = _tokens(cfg.vocab_size, micro * max(dp, 1), seq)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=MixtralLMLoss(cfg), config=ds, topology=topo,
+        example_batch={"input_ids": batch["input_ids"][:1]},
+        rng=jax.random.PRNGKey(0))
+    _measure_train(
+        engine, batch, steps=steps, micro_global=micro * max(dp, 1),
+        seq=seq, flops_per_tok=flops_per_token(cfg, seq),
+        metric="mixtral_ep_train_mfu",
+        extra_detail={"params": count_params(engine.state.params),
+                      "experts": cfg.num_local_experts,
+                      "expert_parallel": ep})
+
+
+def bench_inference(args) -> None:
+    """KV-cache decode throughput (tokens/s/chip), greedy sampling."""
+    import deepspeed_tpu
+
+    on_tpu = not args.smoke
+    from deepspeed_tpu.models.gpt2 import get_config
+
+    if on_tpu:
+        cfg = get_config(args.size or "gpt2-125m", n_positions=1024,
+                         dtype=jnp.bfloat16, scan_layers=True, remat=False,
+                         use_flash_attention=True, decode=True)
+        bsz, prompt, new = 32, 128, 128
+    else:
+        cfg = get_config("gpt2-125m", n_positions=128, n_embd=256,
+                         n_layer=4, n_head=4, dtype=jnp.float32,
+                         remat=False, decode=True)
+        bsz, prompt, new = 2, 16, 8
+
+    from deepspeed_tpu.models.gpt2 import GPT2Model
+    engine = deepspeed_tpu.init_inference(
+        model=GPT2Model(cfg), max_batch_size=bsz,
+        max_out_tokens=prompt + new, rng=jax.random.PRNGKey(0))
+    ids = _tokens(cfg.vocab_size, bsz, prompt)["input_ids"]
+
+    jax.block_until_ready(engine.generate(ids, max_new_tokens=new))  # compile
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out = engine.generate(ids, max_new_tokens=new)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    n_chips = len(jax.devices())
+    tps = bsz * new / dt
+    print(json.dumps({
+        "metric": "gpt2_125m_decode_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "detail": {"batch": bsz, "prompt": prompt, "new_tokens": new,
+                   "tokens_per_sec_per_chip": round(tps / n_chips, 1),
+                   "device": jax.devices()[0].device_kind},
+    }))
+
+
+CONFIGS = {
+    "1": bench_gpt2_ddp,
+    "2": bench_gpt2_zero2_fused,
+    "3": bench_llama_zero3,
+    "4": bench_ulysses_longctx,
+    "5": bench_moe_ep,
+    "infer": bench_inference,
+}
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="1", choices=sorted(CONFIGS),
+                   help="BASELINE.md target config to run")
+    p.add_argument("--size", default=None,
+                   help="model preset override (e.g. gpt2-350m)")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny shapes (auto on CPU)")
+    args = p.parse_args()
+    if jax.devices()[0].platform == "cpu":
+        args.smoke = True
+    CONFIGS[args.config](args)
 
 
 if __name__ == "__main__":
