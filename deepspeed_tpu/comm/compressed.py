@@ -1,0 +1,131 @@
+"""Error-feedback 1-bit compressed all-reduce (the 1-bit Adam/LAMB wire).
+
+TPU-native re-design of the reference compression backends
+(``runtime/comm/nccl.py:51 compressed_allreduce``, ``runtime/comm/mpi.py``,
+``hccl.py`` — cupy bit-packing + two-phase gather/scatter): each member
+
+1. adds its carried ``worker_error`` to the input, takes the sign, and
+   remembers the new quantization error (error feedback keeps the
+   compression *unbiased over time* — the 1-bit Adam convergence result);
+2. ships one SIGN BIT per element (packed 8-per-byte) plus one fp32 scale
+   (||x||/sqrt(n), so sign*scale preserves the l2 norm) through an
+   all-to-all: member i receives everyone's chunk i;
+3. averages its chunk server-side, compresses AGAIN with its carried
+   ``server_error``, and all-gathers the re-compressed chunk — both wire
+   phases are 1-bit, the reference's two-phase design.
+
+32x less traffic than fp32 all-reduce (64x vs a naive
+gather-the-world), at the cost of sign-quantization noise that the twin
+error accumulators feed back into the next step.
+
+In-graph collective: call inside ``shard_map`` with the group axes in
+scope.  Chunking pads to ``group_size * 8`` elements internally; inputs of
+any shape are accepted and restored.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deepspeed_tpu.comm.comm import _resolve_axes, comms_logger
+
+GroupLike = Union[None, str, Sequence[str]]
+
+_BITS = jnp.uint8(2) ** jnp.arange(8, dtype=jnp.uint8)
+
+
+def pack_signs(x: jax.Array) -> jax.Array:
+    """[N] float -> [N/8] uint8 of sign bits (1 = non-negative).  N must be
+    a multiple of 8."""
+    bits = (x >= 0).reshape(-1, 8).astype(jnp.uint8)
+    return (bits * _BITS).sum(axis=1).astype(jnp.uint8)
+
+
+def unpack_signs(p: jax.Array) -> jax.Array:
+    """[M] uint8 -> [M*8] float32 of {-1, +1}."""
+    bits = (p[:, None] >> jnp.arange(8, dtype=jnp.uint8)) & jnp.uint8(1)
+    return (bits.astype(jnp.float32) * 2.0 - 1.0).reshape(-1)
+
+
+def _scale(x: jax.Array) -> jax.Array:
+    # sign*scale preserves the l2 norm of the compressed tensor
+    return jnp.linalg.norm(x) / np.sqrt(x.size)
+
+
+def compressed_allreduce(
+        x: jax.Array, worker_error: jax.Array, server_error: jax.Array,
+        group: GroupLike = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """1-bit averaged all-reduce of ``x`` with twin error feedback.
+
+    ``worker_error``: [padded_numel] carried worker-side quantization
+    error.  ``server_error``: [padded_numel / group_size] carried
+    server-side error for this member's chunk.  Use
+    :func:`error_shapes` to size them.  Returns ``(avg, new_worker_error,
+    new_server_error)`` with ``avg`` reshaped to ``x``'s shape.
+    """
+    if group is None:                      # explicit no-comm (single member)
+        return x, worker_error, server_error
+    axes = _resolve_axes(group)
+    import deepspeed_tpu.comm as dist
+
+    topo = dist.get_topology()
+    n = int(np.prod([topo.axis_size(a) for a in axes]))
+    shape = x.shape
+    if n == 1:
+        return x, worker_error, server_error
+
+    numel = int(np.prod(shape))
+    pad = worker_error.size - numel
+    flat = jnp.concatenate(
+        [x.reshape(-1).astype(jnp.float32),
+         jnp.zeros((pad,), jnp.float32)]) if pad else \
+        x.reshape(-1).astype(jnp.float32)
+
+    # ---- worker-side compression with error feedback ------------------
+    buf = flat + worker_error
+    w_scale = _scale(buf)
+    signs = jnp.sign(buf)
+    signs = jnp.where(signs == 0, 1.0, signs)          # sign bit is binary
+    new_worker_error = buf - w_scale * signs
+
+    chunk = buf.size // n
+    packed = pack_signs(signs).reshape(n, chunk // 8)  # [n, chunk/8] uint8
+    comms_logger.append("compressed_allreduce",
+                        int(packed.size + 4) * 2, n, None, "1bit")
+
+    # phase 1: member i collects everyone's chunk i + every scale
+    recv = lax.all_to_all(packed, axes[0] if len(axes) == 1 else axes,
+                          split_axis=0, concat_axis=0, tiled=False)
+    recv = recv.reshape(n, chunk // 8)
+    scales = lax.all_gather(w_scale, axes).reshape(n)
+
+    # ---- server-side average + re-compression -------------------------
+    member_chunks = jax.vmap(unpack_signs)(recv)       # [n, chunk]
+    server_m = (member_chunks * scales[:, None]).mean(axis=0)
+    server_m = server_m + server_error
+    s_scale = _scale(server_m)
+    s_signs = jnp.sign(server_m)
+    s_signs = jnp.where(s_signs == 0, 1.0, s_signs)
+    new_server_error = server_m - s_scale * s_signs
+
+    # phase 2: all-gather the re-compressed server chunks
+    s_packed = pack_signs(s_signs)
+    all_packed = lax.all_gather(s_packed, axes).reshape(n, chunk // 8)
+    all_scales = lax.all_gather(s_scale, axes).reshape(n)
+    parts = jax.vmap(unpack_signs)(all_packed) * all_scales[:, None]
+    out = parts.reshape(-1)[:numel].reshape(shape).astype(x.dtype)
+    return out, new_worker_error, new_server_error
+
+
+def error_shapes(numel: int, group_size: int) -> Tuple[int, int]:
+    """(worker_error_numel, server_error_numel) for a tensor of ``numel``
+    elements reduced over a ``group_size``-member group: padded so every
+    member's chunk is a whole number of packed bytes."""
+    unit = group_size * 8
+    padded = ((numel + unit - 1) // unit) * unit
+    return padded, padded // group_size

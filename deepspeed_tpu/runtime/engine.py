@@ -201,6 +201,7 @@ class DeepSpeedEngine:
                                (opt_cfg.type if opt_cfg else "adamw"))
         opt_params = dict(opt_cfg.params) if opt_cfg else {}
         self.tx, base_lr = build_optimizer(self.optimizer_name, opt_params)
+        self._onebit_axes = self._resolve_onebit(topology, opt_params)
 
         # -- lr schedule --------------------------------------------------
         if lr_scheduler is None:
@@ -309,10 +310,18 @@ class DeepSpeedEngine:
                                             param_shardings)
         self._grad_spec_tree = self.plan.grad_specs(params, self.base_specs)
 
-        opt_shapes = jax.eval_shape(self.tx.init, params)
-        opt_specs = self.plan.opt_state_specs(opt_shapes, self.base_specs)
-        opt_shardings = self.plan.opt_state_shardings(opt_shapes,
-                                                      self.base_specs)
+        if self._onebit_axes is not None:
+            opt_state, opt_shardings = self._init_onebit_opt_state(params)
+            opt_specs = None
+        else:
+            opt_shapes = jax.eval_shape(self.tx.init, params)
+            opt_specs = self.plan.opt_state_specs(opt_shapes, self.base_specs)
+            opt_shardings = self.plan.opt_state_shardings(opt_shapes,
+                                                          self.base_specs)
+        if self.offload_optimizer and self._onebit_axes is not None:
+            logger.warning("offload_optimizer is not supported on the "
+                           "1-bit compressed path; keeping state on device")
+            self.offload_optimizer = False
         if self.offload_optimizer:
             dev_opt_shardings = opt_shardings
             opt_shardings = to_host(opt_shardings)
@@ -320,7 +329,9 @@ class DeepSpeedEngine:
                 lambda o, _s=dev_opt_shardings: jax.device_put(o, _s))
             log_dist("ZeRO-Offload: optimizer state resident in host "
                      "memory (pinned_host)", ranks=[0])
-        opt_state = jax.jit(self.tx.init, out_shardings=opt_shardings)(params)
+        if self._onebit_axes is None:
+            opt_state = jax.jit(self.tx.init,
+                                out_shardings=opt_shardings)(params)
 
         # Fused Pallas optimizers have no GSPMD partitioning rule; run the
         # update inside shard_map over the ZeRO moment layout so each device
@@ -328,8 +339,8 @@ class DeepSpeedEngine:
         # update + all-gather of the result, which XLA inserts when the
         # engine applies p - lr*u against less-sharded params).
         self._tx_update = self.tx.update
-        if is_fused_optimizer(self.optimizer_name,
-                              opt_cfg.params if opt_cfg else {}):
+        if self._onebit_axes is None and is_fused_optimizer(
+                self.optimizer_name, opt_cfg.params if opt_cfg else {}):
             moment_specs = self.plan.moment_specs(params, self.base_specs)
             self._tx_update = jax.shard_map(
                 self.tx.update, mesh=self.mesh,
@@ -396,6 +407,71 @@ class DeepSpeedEngine:
             f"train_batch={config.train_batch_size}", ranks=[0])
 
     # ------------------------------------------------------------------
+
+    def _resolve_onebit(self, topology, opt_params):
+        """1-bit optimizer family routing (reference
+        ``runtime/fp16/onebit/adam.py:14``): when eligible, swap ``self.tx``
+        for the compressed-momentum transform and return the comm axes the
+        shard_map train step runs over.  Eligibility mirrors the
+        reference's restrictions — ZeRO stage 0 (OnebitAdam asserts
+        non-ZeRO), pure DP (no tp/pp/sp/ep), no fp16 loss scaling — plus
+        >1 data member (nothing to compress otherwise)."""
+        name = self.optimizer_name.lower()
+        if name not in ("onebitadam", "onebitlamb", "zerooneadam"):
+            return None
+        n_dp = topology.zero_partition_count()
+        blockers = []
+        if name == "zerooneadam":
+            blockers.append("0/1 Adam's local-step phase holds per-member "
+                            "params, incompatible with the replicated "
+                            "engine state (use the transform standalone)")
+        if self.config.zero_optimization.stage != 0:
+            blockers.append(f"zero stage "
+                            f"{self.config.zero_optimization.stage} != 0")
+        for ax_attr, label in (("tensor_parallel_size", "tp"),
+                               ("pipe_parallel_size", "pp")):
+            if getattr(topology, ax_attr) > 1:
+                blockers.append(f"{label} > 1")
+        for ax in ("seq", "expert"):
+            if topology.axis_size(ax) > 1:
+                blockers.append(f"{ax} axis > 1")
+        if self.config.fp16.enabled:
+            blockers.append("fp16 dynamic loss scaling")
+        if n_dp <= 1:
+            blockers.append("single data-parallel member")
+        if blockers:
+            logger.warning(
+                f"{self.optimizer_name}: compressed-communication path "
+                f"disabled ({'; '.join(blockers)}); using the uncompressed "
+                "base optimizer (same warmup-stage math, full-precision "
+                "wire)")
+            return None
+        from deepspeed_tpu.parallel.topology import DATA_AXIS, HPZ_AXIS
+        from deepspeed_tpu.runtime.onebit import (scale_by_onebit_adam,
+                                                  scale_by_onebit_lamb)
+
+        axes = tuple(a for a in (DATA_AXIS, HPZ_AXIS)
+                     if topology.axis_size(a) > 1)
+        betas = tuple(opt_params.get("betas", (0.9, 0.999)))
+        kw = dict(b1=betas[0], b2=betas[1],
+                  freeze_step=int(opt_params.get("freeze_step", 100000)),
+                  weight_decay=float(opt_params.get("weight_decay", 0.0)),
+                  group=axes)
+        if name == "onebitlamb":
+            self.tx = scale_by_onebit_lamb(
+                eps=float(opt_params.get("eps", 1e-6)), **kw)
+        else:
+            self.tx = scale_by_onebit_adam(
+                eps=float(opt_params.get("eps", 1e-8)), **kw)
+        if self.config.gradient_clipping:
+            logger.warning(
+                f"{self.optimizer_name}: gradient_clipping is not supported "
+                "on the compressed path (the reference raises for "
+                "max_grad_norm); clipping is skipped")
+        log_dist(f"{self.optimizer_name}: 1-bit compressed momentum "
+                 f"all-reduce active over axes {axes} "
+                 f"(freeze_step={kw['freeze_step']})", ranks=[0])
+        return axes
 
     def _apply_activation_checkpointing_config(self, model):
         """Honor the ``activation_checkpointing`` JSON subtree (reference
@@ -465,6 +541,145 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # Compiled step builders
     # ------------------------------------------------------------------
+
+    def _init_onebit_opt_state(self, params):
+        """Global layout for :class:`OnebitState`: moments replicated (stage
+        0), error-feedback accumulators stored with a leading member axis
+        sharded over the comm axes (each member owns exactly its own error
+        — the reference keeps them as per-rank tensors)."""
+        axes = self._onebit_axes
+        n = int(np.prod([self.topology.axis_size(a) for a in axes]))
+        shapes = jax.eval_shape(self.tx.init, params)
+        err_sharding = NamedSharding(self.mesh, P(axes))
+        shardings = jax.tree_util.tree_map(
+            lambda _: self._repl(), shapes)._replace(
+            worker_error=jax.tree_util.tree_map(
+                lambda _: err_sharding, shapes.worker_error),
+            server_error=jax.tree_util.tree_map(
+                lambda _: err_sharding, shapes.server_error))
+
+        def init_global(p):
+            s = self.tx.init(p)
+            return s._replace(
+                worker_error=jax.tree_util.tree_map(
+                    lambda e: jnp.broadcast_to(e[None], (n,) + e.shape),
+                    s.worker_error),
+                server_error=jax.tree_util.tree_map(
+                    lambda e: jnp.broadcast_to(e[None], (n,) + e.shape),
+                    s.server_error))
+
+        state = jax.jit(init_global, out_shardings=shardings)(params)
+        return state, shardings
+
+    def _build_onebit_train_step(self, gbatch):
+        """shard_map train step for the 1-bit family: the data axes are
+        MANUAL, so gradients stay member-local (no GSPMD psum in backward)
+        and the only cross-member traffic is the transform's compressed
+        momentum all-reduce — the reference ``OnebitAdam.step`` wire
+        pattern, fused into the one compiled program."""
+        axes = self._onebit_axes
+        mesh = self.mesh
+        loss_fn = self.loss_fn
+        tx = self.tx
+        gas = self.gas
+        compute_dtype = self.compute_dtype
+
+        def cast_params(p):
+            return prec.cast_tree(p, compute_dtype)
+
+        repl = lambda tree: jax.tree_util.tree_map(lambda _: P(), tree)
+        state_specs = TrainState(
+            step=P(), params=repl(self.state.params),
+            opt_state=repl(self.state.opt_state)._replace(
+                worker_error=jax.tree_util.tree_map(
+                    lambda _: P(axes), self.state.opt_state.worker_error),
+                server_error=jax.tree_util.tree_map(
+                    lambda _: P(axes), self.state.opt_state.server_error)),
+            scale=repl(self.state.scale), rng=P(), skipped_steps=P())
+        batch_specs = jax.tree_util.tree_map(
+            lambda x: P(*((None, axes) + (None,) * (x.ndim - 2))), gbatch)
+        metric_specs = {k: P() for k in ("loss", "grad_norm", "overflow",
+                                         "loss_scale")}
+
+        def member_step(state: TrainState, batch, lr):
+            rng, new_rng = jax.random.split(state.rng)
+            if len(axes) == 1:
+                member = jax.lax.axis_index(axes[0])
+            else:
+                member = (jax.lax.axis_index(axes[0]) *
+                          jax.lax.axis_size(axes[1]) +
+                          jax.lax.axis_index(axes[1]))
+            params = state.params
+
+            def micro_grads(mb, idx):
+                mrng = jax.random.fold_in(jax.random.fold_in(rng, idx),
+                                          member)
+
+                def local_loss(p):
+                    return loss_fn(cast_params(p), mb, mrng).astype(
+                        jnp.float32)
+
+                loss, grads = jax.value_and_grad(local_loss)(params)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads)
+                return grads, loss
+
+            if gas == 1:
+                grads, loss_sum = micro_grads(
+                    jax.tree_util.tree_map(lambda x: x[0], batch), 0)
+            else:
+                def micro_step(carry, xs):
+                    grads_acc, loss_acc = carry
+                    mb, idx = xs
+                    g, l = micro_grads(mb, idx)
+                    return (jax.tree_util.tree_map(jnp.add, grads_acc, g),
+                            loss_acc + l), None
+
+                zero_grads = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                (grads, loss_sum), _ = jax.lax.scan(
+                    micro_step, (zero_grads, jnp.asarray(0.0, jnp.float32)),
+                    (batch, jnp.arange(gas)))
+                grads = jax.tree_util.tree_map(lambda g: g / gas, grads)
+
+            opt_in = state.opt_state._replace(
+                worker_error=jax.tree_util.tree_map(
+                    lambda e: e[0], state.opt_state.worker_error),
+                server_error=jax.tree_util.tree_map(
+                    lambda e: e[0], state.opt_state.server_error))
+            updates, new_opt = tx.update(grads, opt_in, params)
+            new_params = jax.tree_util.tree_map(
+                lambda p, u: (p - lr * u.astype(jnp.float32)).astype(p.dtype),
+                params, updates)
+            new_opt = new_opt._replace(
+                worker_error=jax.tree_util.tree_map(
+                    lambda e: e[None], new_opt.worker_error),
+                server_error=jax.tree_util.tree_map(
+                    lambda e: e[None], new_opt.server_error))
+
+            loss = jax.lax.pmean(loss_sum / gas, axes)
+            # norm of the member-local gradient, RMS-averaged across members
+            grad_norm = jnp.sqrt(jax.lax.pmean(
+                prec.global_norm(grads) ** 2, axes))
+            new_state = TrainState(
+                step=state.step + 1, params=new_params, opt_state=new_opt,
+                scale=state.scale, rng=new_rng,
+                skipped_steps=state.skipped_steps)
+            metrics = {"loss": loss, "grad_norm": grad_norm,
+                       "overflow": jnp.asarray(False),
+                       "loss_scale": state.scale.loss_scale}
+            return new_state, metrics
+
+        sharded = jax.shard_map(
+            member_step, mesh=mesh,
+            in_specs=(state_specs, batch_specs, P()),
+            out_specs=(state_specs, metric_specs), check_vma=False)
+        metric_shardings = {k: self._repl() for k in metric_specs}
+        return jax.jit(sharded,
+                       in_shardings=(self._state_shardings, None, None),
+                       out_shardings=(self._state_shardings,
+                                      metric_shardings),
+                       donate_argnums=(0,))
 
     def _build_train_step(self):
         plan = self.plan
@@ -758,7 +973,10 @@ class DeepSpeedEngine:
         if breakdown:
             self.timers("batch_prep").stop()
         if self._train_step_fn is None:
-            self._train_step_fn = self._build_train_step()
+            self._train_step_fn = (
+                self._build_onebit_train_step(gbatch)
+                if self._onebit_axes is not None
+                else self._build_train_step())
         lr = self._lr_device()
 
         self.tput_timer.start()
@@ -849,6 +1067,11 @@ class DeepSpeedEngine:
 
     def forward(self, batch) -> jax.Array:
         """Loss for one micro-batch; stashes it for ``backward``."""
+        if self._onebit_axes is not None:
+            raise NotImplementedError(
+                "the 1-bit compressed optimizer path only supports the "
+                "fused train_batch() API (local gradients never leave the "
+                "compiled step); use train_batch or a non-1-bit optimizer")
         self._fwd_batch = jax.tree_util.tree_map(
             lambda x: jax.device_put(np.asarray(x),
                                      self.plan.batch_sharding(np.asarray(x).ndim)),

@@ -12,9 +12,11 @@ The reference's FusedAdam/CPUAdam CUDA/AVX kernels (``csrc/adam``) map to a
 Pallas fused-optimizer kernel (``deepspeed_tpu.ops.fused_adam``) that the
 engine substitutes for the optax path on TPU when
 ``optimizer.params.fused=true`` — same math, one kernel per param bucket.
-1-bit optimizers (OneBitAdam/OneBitLamb/ZeroOneAdam) currently run with
-full-precision comm (error-feedback compressed DCN collectives are a
-planned extension; config is accepted and a warning logged).
+1-bit optimizers (OneBitAdam/OneBitLamb/ZeroOneAdam): this builder returns
+the uncompressed base math; the engine swaps in the error-feedback
+compressed-momentum transforms (``runtime/onebit.py`` +
+``comm/compressed.py``) when the topology is eligible (ZeRO stage 0, pure
+DP — the reference's own restriction).
 """
 from __future__ import annotations
 
@@ -64,11 +66,10 @@ def build_optimizer(name: Optional[str], params: Dict[str, Any]
     eps = float(p.get("eps", 1e-8))
     wd = float(p.get("weight_decay", 0.0))
 
-    if name in ("onebitadam", "zerooneadam", "onebitlamb"):
-        logger.warning(
-            f"{name}: compressed-communication variant not yet implemented on "
-            "TPU; using the uncompressed base optimizer (same convergence, "
-            "full-precision gradients on the wire).")
+    # 1-bit family: this builder returns the uncompressed base transform;
+    # the ENGINE swaps in the compressed-momentum transform
+    # (runtime/onebit.py) when the topology is eligible (stage 0, pure DP)
+    # and logs which path is active — see DeepSpeedEngine._resolve_onebit.
 
     # fused Pallas kernels (csrc/adam, csrc/lion equivalents). Opt-in:
     # "FusedAdam"/"FusedLion" type or fused=true. The kernel has no GSPMD
