@@ -510,9 +510,11 @@ def warn_unimplemented(cfg: DeepSpeedConfig) -> None:
         notes.append("offload_optimizer.device=nvme (device=cpu "
                      "pinned-host offload IS supported)")
     if cfg.data_efficiency.enabled:
-        notes.append("data_efficiency")
-    if cfg.curriculum_learning.enabled:
-        notes.append("curriculum_learning")
+        logger.warning(
+            "config: data_efficiency has no automatic engine wiring on "
+            "TPU; use deepspeed_tpu.data_pipeline explicitly "
+            "(DeepSpeedDataSampler for curriculum data_sampling, "
+            "RandomLayerTokenDrop + RandomLTDScheduler for data_routing)")
     for note in notes:
         logger.warning(f"config: {note} is NOT implemented on TPU yet; "
                        "the setting has no effect")

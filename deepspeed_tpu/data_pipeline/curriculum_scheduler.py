@@ -1,0 +1,120 @@
+"""Curriculum learning difficulty scheduler.
+
+Re-implements the reference ``data_pipeline/curriculum_scheduler.py:11
+CurriculumScheduler`` semantics: a difficulty value (typically max
+sequence length) that grows over training steps by one of four schedules.
+Pure step math — identical on TPU; the TPU-specific part is WHERE the
+difficulty lands: the engine truncates token batches to the current
+difficulty, which quantizes compile shapes, so ``difficulty_step``
+(multiple-of-8 in the reference for tensor cores) here also bounds the
+number of XLA retraces over a run.
+
+Schedules:
+
+- ``fixed_discrete``: explicit (difficulty, max_step) staircase;
+- ``fixed_linear``: min -> max linearly over ``total_curriculum_step``;
+- ``fixed_root``: min -> max along ``(t/T)^(1/root_degree)``;
+- ``custom``: user function via :meth:`set_custom_get_difficulty`.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        for key in ("min_difficulty", "max_difficulty", "schedule_type"):
+            assert key in config, f"curriculum learning requires {key!r}"
+        self.state: Dict[str, Any] = {
+            "min_difficulty": int(config["min_difficulty"]),
+            "max_difficulty": int(config["max_difficulty"]),
+            "current_difficulty": int(config["min_difficulty"]),
+            "schedule_type": config["schedule_type"],
+        }
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+        stype = config["schedule_type"]
+        sconf = dict(config.get("schedule_config", {}))
+        if stype == "fixed_discrete":
+            diffs = sconf.get("difficulty")
+            steps = sconf.get("max_step")
+            assert diffs and steps is not None, (
+                "fixed_discrete needs schedule_config.difficulty and "
+                ".max_step")
+            assert len(diffs) == len(steps) + 1, (
+                "difficulty must have one more entry than max_step (the "
+                "last difficulty holds forever)")
+            self.state["schedule"] = {"difficulty": list(diffs),
+                                      "max_step": list(steps)}
+        elif stype in ("fixed_linear", "fixed_root"):
+            assert "total_curriculum_step" in sconf, (
+                f"{stype} needs schedule_config.total_curriculum_step")
+            assert "difficulty_step" in sconf, (
+                f"{stype} needs schedule_config.difficulty_step")
+            if stype == "fixed_root":
+                assert "root_degree" in sconf, (
+                    "fixed_root needs schedule_config.root_degree")
+            if int(sconf["difficulty_step"]) % 8 != 0:
+                from deepspeed_tpu.utils.logging import logger
+
+                logger.warning(
+                    "curriculum difficulty_step should be a multiple of 8 "
+                    "for seqlen metrics: it quantizes compiled shapes "
+                    "(bounding XLA retraces) and keeps the MXU tiled")
+            self.state["schedule"] = sconf
+        elif stype == "custom":
+            pass
+        else:
+            raise RuntimeError(f"unsupported curriculum schedule {stype!r}")
+
+    # -- reference API --------------------------------------------------
+
+    def get_current_difficulty(self) -> int:
+        return self.state["current_difficulty"]
+
+    def set_current_difficulty(self, difficulty: int) -> None:
+        self.state["current_difficulty"] = difficulty
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]) -> None:
+        self.custom_get_difficulty = fn
+
+    def get_state(self) -> Dict[str, Any]:
+        return self.state
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.state = state
+
+    # -- schedules ------------------------------------------------------
+
+    def _discrete(self, step: int) -> int:
+        sched = self.state["schedule"]
+        for diff, max_step in zip(sched["difficulty"], sched["max_step"]):
+            if step <= max_step:
+                return diff
+        return sched["difficulty"][-1]
+
+    def _root(self, step: int, degree: float) -> int:
+        sched = self.state["schedule"]
+        lo, hi = self.state["min_difficulty"], self.state["max_difficulty"]
+        frac = (float(step) / sched["total_curriculum_step"]) ** (1.0 / degree)
+        diff = math.floor(frac * (hi - lo) + lo)
+        diff -= diff % sched["difficulty_step"]
+        return min(diff, hi)
+
+    def get_difficulty(self, global_steps: int) -> int:
+        stype = self.state["schedule_type"]
+        if stype == "fixed_discrete":
+            return self._discrete(global_steps)
+        if stype == "fixed_linear":
+            return self._root(global_steps, 1.0)
+        if stype == "fixed_root":
+            return self._root(global_steps,
+                              self.state["schedule"]["root_degree"])
+        assert self.custom_get_difficulty is not None, (
+            "custom schedule: call set_custom_get_difficulty first")
+        return self.custom_get_difficulty(global_steps)
+
+    def update_difficulty(self, global_steps: int) -> int:
+        d = self.get_difficulty(global_steps)
+        self.state["current_difficulty"] = d
+        return d

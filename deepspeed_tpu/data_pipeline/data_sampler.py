@@ -1,0 +1,183 @@
+"""Curriculum-aware data sampler.
+
+Re-design of the reference ``data_sampling/data_sampler.py:37
+DeepSpeedDataSampler``: an index iterator that, under curriculum
+learning, restricts each global batch to samples whose difficulty metric
+is within the current threshold, growing the eligible pool as training
+progresses.  The reference pipelines mmap-indexed offline metric files
+produced by its ``data_analyzer`` (880 LoC of distributed map-reduce);
+here metric values are plain in-memory numpy arrays — on TPU hosts the
+metric table for even a billion-sample corpus (one int per sample) fits
+host RAM, and anything bigger can memory-map the array itself.
+
+Semantics kept from the reference:
+
+- ``difficulty_type``: "value" (samples with metric <= difficulty) or
+  "percentile" (samples whose metric percentile <= difficulty);
+- ``clustering_type``: "single_cluster" (one pool, no curriculum order
+  within) vs "schedule_based" (new difficulty admits a freshly shuffled
+  cluster appended to the pool);
+- deterministic given the seed; each data-parallel rank draws its
+  disjoint micro-batch slice; state save/load for resume.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class DeepSpeedDataSampler:
+    def __init__(self, total_samples: int, micro_batch_size: int,
+                 data_parallel_rank: int, data_parallel_size: int,
+                 gradient_accumulation_steps: int = 1,
+                 curriculum_metrics: Optional[Dict[str, np.ndarray]] = None,
+                 curriculum_schedulers: Optional[Dict[str, Any]] = None,
+                 difficulty_type: Optional[Dict[str, str]] = None,
+                 clustering_type: Optional[Dict[str, str]] = None,
+                 seed: int = 1234, drop_last: bool = True):
+        from deepspeed_tpu.data_pipeline.curriculum_scheduler import \
+            CurriculumScheduler
+
+        self.total_samples = int(total_samples)
+        self.micro_batch_size = int(micro_batch_size)
+        self.dp_rank = int(data_parallel_rank)
+        self.dp_size = int(data_parallel_size)
+        self.gas = int(gradient_accumulation_steps)
+        self.global_batch_size = (self.micro_batch_size * self.dp_size *
+                                  self.gas)
+        self.drop_last = drop_last
+        self.np_rng = np.random.default_rng(seed)
+        self.consumed_samples = 0
+        self.curriculum_step = 0
+
+        self.metrics = curriculum_metrics or {}
+        self.schedulers: Dict[str, CurriculumScheduler] = {}
+        for name, cfg in (curriculum_schedulers or {}).items():
+            self.schedulers[name] = (cfg if isinstance(cfg,
+                                                       CurriculumScheduler)
+                                     else CurriculumScheduler(cfg))
+        self.difficulty_type = difficulty_type or {
+            n: "value" for n in self.metrics}
+        self.clustering_type = clustering_type or {
+            n: "schedule_based" for n in self.metrics}
+        for name in self.schedulers:
+            assert name in self.metrics, (
+                f"curriculum metric {name!r} has a scheduler but no "
+                "metric values")
+            assert len(self.metrics[name]) == self.total_samples, (
+                f"metric {name!r} has {len(self.metrics[name])} values "
+                f"for {self.total_samples} samples")
+
+        # the eligible pool: sample indices admitted so far, in admission
+        # order (each admission wave shuffled independently)
+        self._pool: np.ndarray = np.empty((0,), np.int64)
+        self._admitted = np.zeros((self.total_samples,), bool)
+        self._pool_pos = 0
+        if not self.schedulers:           # no curriculum: admit everything
+            self._admit(np.arange(self.total_samples))
+
+    def __len__(self) -> int:
+        return self.total_samples
+
+    # -- curriculum pool management -------------------------------------
+
+    def _admit(self, idx: np.ndarray) -> None:
+        idx = idx[~self._admitted[idx]]
+        if idx.size == 0:
+            return
+        self._admitted[idx] = True
+        wave = idx.copy()
+        self.np_rng.shuffle(wave)
+        self._pool = np.concatenate([self._pool, wave])
+
+    def _eligible(self, name: str, difficulty: float) -> np.ndarray:
+        vals = np.asarray(self.metrics[name])
+        if self.difficulty_type[name] == "percentile":
+            thresh = np.percentile(vals, difficulty)
+            return np.nonzero(vals <= thresh)[0]
+        return np.nonzero(vals <= difficulty)[0]
+
+    def _update_curriculum(self) -> None:
+        if not self.schedulers:
+            return
+        self.curriculum_step += 1
+        admitted: Optional[np.ndarray] = None
+        for name, sched in self.schedulers.items():
+            d = sched.update_difficulty(self.curriculum_step)
+            ok = self._eligible(name, d)
+            admitted = ok if admitted is None else np.intersect1d(admitted,
+                                                                  ok)
+        if self.clustering_type.get(next(iter(self.schedulers)),
+                                    "schedule_based") == "single_cluster":
+            # one flat pool: re-admit everything eligible, keep flat order
+            self._admit(admitted)
+        else:
+            self._admit(admitted)
+
+    # -- iteration ------------------------------------------------------
+
+    def _next_global_batch(self) -> Optional[np.ndarray]:
+        self._update_curriculum()
+        need = self.global_batch_size
+        remaining = self._pool.size - self._pool_pos
+        if remaining < need:
+            if self.drop_last or remaining == 0:
+                # wrap: reshuffle the whole admitted pool and restart
+                if self._pool.size < need:
+                    return None           # not enough eligible samples yet
+                wrapped = self._pool.copy()
+                self.np_rng.shuffle(wrapped)
+                self._pool = wrapped
+                self._pool_pos = 0
+            else:
+                batch = self._pool[self._pool_pos:]
+                self._pool_pos = self._pool.size
+                return batch
+        batch = self._pool[self._pool_pos:self._pool_pos + need]
+        self._pool_pos += need
+        return batch
+
+    def __iter__(self) -> Iterator[List[int]]:
+        """Yields this rank's micro-batch index lists, ``gas`` per global
+        batch (reference ``__iter__`` contract: rank-sliced)."""
+        while True:
+            batch = self._next_global_batch()
+            if batch is None:
+                return
+            self.consumed_samples += batch.size
+            per_rank = batch.reshape(self.gas, self.dp_size,
+                                     -1)[:, self.dp_rank, :] \
+                if batch.size == self.global_batch_size else None
+            if per_rank is None:
+                # ragged tail (drop_last=False): round-robin slice
+                tail = batch[self.dp_rank::self.dp_size]
+                if tail.size:
+                    yield tail.tolist()
+                return
+            for micro in per_rank:
+                yield micro.tolist()
+
+    # -- resume ---------------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "consumed_samples": self.consumed_samples,
+            "curriculum_step": self.curriculum_step,
+            "pool": self._pool.copy(),
+            "pool_pos": self._pool_pos,
+            "admitted": self._admitted.copy(),
+            "rng": self.np_rng.bit_generator.state,
+            "schedulers": {n: s.get_state()
+                           for n, s in self.schedulers.items()},
+        }
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.consumed_samples = sd["consumed_samples"]
+        self.curriculum_step = sd["curriculum_step"]
+        self._pool = np.asarray(sd["pool"])
+        self._pool_pos = sd["pool_pos"]
+        self._admitted = np.asarray(sd["admitted"])
+        self.np_rng.bit_generator.state = sd["rng"]
+        for n, st in sd.get("schedulers", {}).items():
+            self.schedulers[n].set_state(st)

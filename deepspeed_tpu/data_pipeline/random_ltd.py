@@ -1,0 +1,144 @@
+"""Random layer token dropping (random-LTD, arXiv:2211.11586).
+
+Re-design of the reference ``data_routing/basic_layer.py:14
+RandomLayerTokenDrop`` + ``scheduler.py:38 RandomLTDScheduler`` +
+``ops/random_ltd/dropping_utils.py`` CUDA gather/scatter: wrapped
+transformer layers run on a RANDOM SUBSET of tokens (the "reserved"
+tokens); dropped tokens skip the layer and rejoin afterwards, unchanged —
+cutting per-layer FLOPs by reserved/seq while training quality follows
+the random-LTD schedule that grows reserved length back to full.
+
+TPU-native shape discipline: the reserved length is a STATIC argument —
+each new schedule value compiles one new program (the scheduler's
+``increase_step`` quantizes values exactly so this stays bounded, the
+same role the reference's "multiple of 8 for tensor cores" rule plays).
+Gathers/scatters are ``jnp.take_along_axis`` / ``.at[].set`` — XLA's
+native dynamic-gather, no custom kernel needed.
+
+Decoder sampling keeps indices SORTED per row (the reference
+``gpt_sample_tokens``) so causal order is preserved on the subsequence;
+RoPE/position embeddings can consume the returned indices as positions.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def sample_token_indices(rng: jax.Array, batch: int, seq: int,
+                         reserved: int, sorted_indices: bool = True
+                         ) -> jax.Array:
+    """[B, reserved] per-row token indices without replacement (sorted for
+    decoder models — reference ``gpt_sample_tokens``; unsorted permutation
+    sampling matches ``bert_sample_tokens``)."""
+    # per-row random scores; top-`reserved` positions = uniform sample
+    # without replacement
+    scores = jax.random.uniform(rng, (batch, seq))
+    _, idx = jax.lax.top_k(scores, reserved)
+    if sorted_indices:
+        idx = jnp.sort(idx, axis=-1)
+    return idx
+
+
+def gather_tokens(x: jax.Array, idx: jax.Array) -> jax.Array:
+    """[B, S, H], [B, r] -> [B, r, H] (reference ``GatherTokens``)."""
+    return jnp.take_along_axis(x, idx[..., None], axis=1)
+
+
+def scatter_tokens(x: jax.Array, part: jax.Array, idx: jax.Array
+                   ) -> jax.Array:
+    """Write the layer's outputs back at their original positions
+    (reference ``ScatterTokens``); un-sampled tokens pass through."""
+    b = jnp.arange(x.shape[0])[:, None]
+    return x.at[b, idx].set(part.astype(x.dtype))
+
+
+class RandomLayerTokenDrop(nn.Module):
+    """Wrap one transformer block: run it on ``reserved_length`` sampled
+    tokens.  ``layer_fn`` builds/applies the wrapped block given the
+    gathered hidden states and their positions."""
+
+    layer: Any                       # nn.Module taking (x, *args)
+    model_type: str = "decoder"      # decoder (sorted) | encoder
+
+    @nn.compact
+    def __call__(self, x, reserved_length: int, *layer_args,
+                 rng: Optional[jax.Array] = None):
+        B, S = x.shape[0], x.shape[1]
+        if reserved_length >= S:
+            return self.layer(x, *layer_args)
+        if rng is None:
+            rng = self.make_rng("random_ltd")
+        idx = sample_token_indices(rng, B, S, reserved_length,
+                                   sorted_indices=self.model_type ==
+                                   "decoder")
+        part = gather_tokens(x, idx)
+        out = self.layer(part, *layer_args)
+        return scatter_tokens(x, out, idx)
+
+
+class RandomLTDScheduler:
+    """Reserved-length schedule + layer-token accounting (reference
+    ``scheduler.py:38``).  ``fixed_linear``: min -> max over
+    ``require_steps``, quantized to ``increase_step`` multiples."""
+
+    def __init__(self, config: Dict[str, Any]):
+        self.model_layer_num = int(config["total_layer_num"])
+        self.random_ltd_layer_num = int(config["random_ltd_layer_num"])
+        self.global_batch_size = int(config.get("global_batch_size", 1))
+        sched = config["random_ltd_schedule"]
+        self.schedule_type = sched.get("schedule_type", "fixed_linear")
+        if self.schedule_type != "fixed_linear":
+            raise RuntimeError(
+                f"unsupported random-LTD schedule {self.schedule_type!r}")
+        self.state: Dict[str, Any] = {
+            "min_value": int(sched["min_value"]),
+            "max_value": int(sched["max_value"]),
+            "current_value": int(sched["min_value"]),
+            "require_steps": int(sched["schedule_config"]["require_steps"]),
+            "increase_step": int(sched["schedule_config"]["seq_per_step"]),
+            "consumed_layer_tokens": 0,
+            "current_step": -1,
+        }
+
+    def get_value(self, global_steps: int) -> int:
+        lo, hi = self.state["min_value"], self.state["max_value"]
+        frac = float(global_steps) / self.state["require_steps"]
+        val = math.floor(frac * (hi - lo) + lo)
+        val -= val % self.state["increase_step"]
+        return min(val, hi)
+
+    def get_current_seq(self) -> int:
+        return self.state["current_value"]
+
+    def set_current_seq(self, v: int) -> None:
+        self.state["current_value"] = v
+
+    def get_random_ltd_layer_num(self) -> int:
+        return self.random_ltd_layer_num
+
+    def update_seq(self, global_steps: int) -> int:
+        if self.state["current_value"] < self.state["max_value"]:
+            self.state["current_value"] = self.get_value(global_steps)
+        if global_steps != self.state["current_step"]:
+            self.state["consumed_layer_tokens"] += self.global_batch_size * (
+                self.state["current_value"] * self.random_ltd_layer_num +
+                self.state["max_value"] *
+                (self.model_layer_num - self.random_ltd_layer_num))
+            self.state["current_step"] = global_steps
+        return self.state["current_value"]
+
+    def get_total_layer_tokens(self, train_iters: int) -> int:
+        for step in range(train_iters):
+            self.update_seq(step)
+        return self.state["consumed_layer_tokens"]
+
+    def state_dict(self) -> Dict[str, Any]:
+        return dict(self.state)
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.state.update(sd)

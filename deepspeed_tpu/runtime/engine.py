@@ -398,6 +398,28 @@ class DeepSpeedEngine:
             logger.warning(f"monitor setup failed; metric logging disabled: {e}")
         dist.configure(config.comms_logger)
 
+        # legacy curriculum learning (reference engine
+        # curriculum_enabled_legacy path): seqlen difficulty truncates
+        # token batches; difficulty_step quantizes compile shapes
+        self.curriculum_scheduler = None
+        if config.curriculum_learning.enabled:
+            from deepspeed_tpu.data_pipeline import CurriculumScheduler
+
+            if config.curriculum_learning.curriculum_type != "seqlen":
+                raise ValueError(
+                    "curriculum_learning.curriculum_type="
+                    f"{config.curriculum_learning.curriculum_type!r}: the "
+                    "engine-wired legacy path supports 'seqlen' (other "
+                    "metrics go through deepspeed_tpu.data_pipeline."
+                    "DeepSpeedDataSampler)")
+            self.curriculum_scheduler = CurriculumScheduler(
+                config.curriculum_learning.model_dump())
+            log_dist("curriculum learning: seqlen "
+                     f"{config.curriculum_learning.min_difficulty} -> "
+                     f"{config.curriculum_learning.max_difficulty} "
+                     f"({config.curriculum_learning.schedule_type})",
+                     ranks=[0])
+
         self.optimizer = OptimizerHandle(self)
         log_dist(
             f"DeepSpeedEngine: zero_stage={self.zero_stage} "
@@ -906,6 +928,28 @@ class DeepSpeedEngine:
     # Batch plumbing
     # ------------------------------------------------------------------
 
+    def _apply_curriculum(self, batch):
+        """Truncate token batches to the current seqlen difficulty
+        (reference ``engine.py curriculum_enabled_legacy`` +
+        megatron-side truncation).  A DeviceBatch is already staged at
+        full length and passes through untouched."""
+        if isinstance(batch, DeviceBatch):
+            return batch
+        d = self.curriculum_scheduler.update_difficulty(
+            self.global_steps + 1)
+
+        def trunc(x):
+            x = np.asarray(x)
+            return x[:, :d] if x.ndim >= 2 and x.shape[1] > d else x
+
+        return jax.tree_util.tree_map(trunc, batch)
+
+    def set_custom_curriculum_learning_schedule(self, schedule_fn) -> None:
+        """Reference ``engine.set_custom_curriculum_learning_schedule``."""
+        assert self.curriculum_scheduler is not None, (
+            "curriculum_learning is not enabled")
+        self.curriculum_scheduler.set_custom_get_difficulty(schedule_fn)
+
     def _to_gas_batch(self, batch):
         """[train_batch, ...] -> [gas, micro_global, ...] sharded arrays."""
         if isinstance(batch, DeviceBatch):
@@ -961,6 +1005,8 @@ class DeepSpeedEngine:
         plain engine this is forward+backward+step at once)."""
         if batch is None:
             batch = self._next_batch(data_iter)
+        if self.curriculum_scheduler is not None:
+            batch = self._apply_curriculum(batch)
         breakdown = self.config.wall_clock_breakdown
         if breakdown:
             self.timers("batch_prep").start()

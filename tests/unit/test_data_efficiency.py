@@ -1,0 +1,248 @@
+"""Data-efficiency tests (reference
+``tests/unit/runtime/test_data_efficiency.py`` strategy: schedule math
+exactness, sampler eligibility, random-LTD layer equivalence)."""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.data_pipeline import (CurriculumScheduler,
+                                         DeepSpeedDataSampler,
+                                         RandomLayerTokenDrop,
+                                         RandomLTDScheduler)
+from deepspeed_tpu.data_pipeline.random_ltd import (gather_tokens,
+                                                    sample_token_indices,
+                                                    scatter_tokens)
+
+
+class TestCurriculumScheduler:
+    def test_fixed_linear(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8}})
+        assert s.get_difficulty(0) == 8
+        assert s.get_difficulty(100) == 64
+        d50 = s.get_difficulty(50)
+        assert 8 <= d50 <= 64 and d50 % 8 == 0
+        # monotone
+        ds = [s.get_difficulty(t) for t in range(0, 120, 10)]
+        assert ds == sorted(ds)
+
+    def test_fixed_root_slower_start(self):
+        kw = dict(min_difficulty=8, max_difficulty=1024,
+                  schedule_config={"total_curriculum_step": 1000,
+                                   "difficulty_step": 8,
+                                   "root_degree": 2})
+        root = CurriculumScheduler(dict(kw, schedule_type="fixed_root"))
+        lin = CurriculumScheduler(dict(
+            kw, schedule_type="fixed_linear",
+            schedule_config={"total_curriculum_step": 1000,
+                             "difficulty_step": 8}))
+        # sqrt schedule ramps FASTER early (reference semantics:
+        # (t/T)^(1/2) > t/T for t<T)
+        assert root.get_difficulty(100) > lin.get_difficulty(100)
+        assert root.get_difficulty(1000) == lin.get_difficulty(1000) == 1024
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 1, "max_difficulty": 3,
+            "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [1, 2, 3],
+                                "max_step": [5, 10]}})
+        assert s.get_difficulty(3) == 1
+        assert s.get_difficulty(7) == 2
+        assert s.get_difficulty(11) == 3
+        assert s.get_difficulty(10000) == 3
+
+    def test_custom(self):
+        s = CurriculumScheduler({"min_difficulty": 1, "max_difficulty": 10,
+                                 "schedule_type": "custom"})
+        s.set_custom_get_difficulty(lambda t: min(t, 10))
+        assert s.get_difficulty(4) == 4
+
+    def test_state_roundtrip(self):
+        s = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8}})
+        s.update_difficulty(50)
+        s2 = CurriculumScheduler({
+            "min_difficulty": 8, "max_difficulty": 64,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 8}})
+        s2.set_state(s.get_state())
+        assert s2.get_current_difficulty() == s.get_current_difficulty()
+
+
+class TestDataSampler:
+    def test_plain_sampler_covers_all(self):
+        s = DeepSpeedDataSampler(total_samples=64, micro_batch_size=4,
+                                 data_parallel_rank=0,
+                                 data_parallel_size=2, seed=1)
+        seen = []
+        for i, micro in enumerate(s):
+            assert len(micro) == 4
+            seen.extend(micro)
+            if i >= 7:
+                break
+        assert len(set(seen)) == len(seen)  # rank slice: no dup in epoch
+
+    def test_ranks_disjoint(self):
+        def take(rank, n=4):
+            s = DeepSpeedDataSampler(total_samples=64, micro_batch_size=4,
+                                     data_parallel_rank=rank,
+                                     data_parallel_size=2, seed=7)
+            out = []
+            for i, micro in enumerate(s):
+                out.extend(micro)
+                if i >= n - 1:
+                    break
+            return out
+
+        a, b = take(0), take(1)
+        assert not set(a) & set(b)
+
+    def test_curriculum_restricts_then_grows(self):
+        metric = np.arange(100)           # difficulty == index
+        sched = {"min_difficulty": 10, "max_difficulty": 100,
+                 "schedule_type": "fixed_linear",
+                 "schedule_config": {"total_curriculum_step": 10,
+                                     "difficulty_step": 8}}
+        s = DeepSpeedDataSampler(
+            total_samples=100, micro_batch_size=4, data_parallel_rank=0,
+            data_parallel_size=1,
+            curriculum_metrics={"seqlen": metric},
+            curriculum_schedulers={"seqlen": sched},
+            difficulty_type={"seqlen": "value"}, seed=3)
+        it = iter(s)
+        first = next(it)
+        # step-1 difficulty: linear from 10 toward 100, quantized by 8
+        d1 = s.schedulers["seqlen"].get_current_difficulty()
+        assert all(metric[i] <= d1 for i in first)
+        for _ in range(40):
+            next(it)
+        later = next(it)
+        d_late = s.schedulers["seqlen"].get_current_difficulty()
+        assert d_late > d1
+        assert any(metric[i] > d1 for i in later) or d_late >= 100
+
+    def test_state_roundtrip_resumes_deterministically(self):
+        kw = dict(total_samples=64, micro_batch_size=4,
+                  data_parallel_rank=0, data_parallel_size=1, seed=5)
+        s = DeepSpeedDataSampler(**kw)
+        it = iter(s)
+        for _ in range(3):
+            next(it)
+        sd = s.state_dict()
+        expected = [next(it) for _ in range(3)]
+        s2 = DeepSpeedDataSampler(**kw)
+        s2.load_state_dict(sd)
+        got = []
+        it2 = iter(s2)
+        for _ in range(3):
+            got.append(next(it2))
+        assert got == expected
+
+
+class _Double(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return x * 2.0
+
+
+class TestRandomLTD:
+    def test_sample_indices_sorted_unique(self):
+        idx = sample_token_indices(jax.random.PRNGKey(0), 4, 32, 8)
+        a = np.asarray(idx)
+        assert a.shape == (4, 8)
+        for row in a:
+            assert len(set(row)) == 8
+            assert list(row) == sorted(row)
+            assert row.min() >= 0 and row.max() < 32
+
+    def test_gather_scatter_roundtrip(self):
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 16, 8)),
+                        jnp.float32)
+        idx = sample_token_indices(jax.random.PRNGKey(1), 2, 16, 4)
+        part = gather_tokens(x, idx)
+        assert part.shape == (2, 4, 8)
+        back = scatter_tokens(x, part, idx)
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+    def test_wrapper_applies_layer_to_subset_only(self):
+        x = jnp.ones((2, 16, 4))
+        m = RandomLayerTokenDrop(layer=_Double())
+        p = m.init({"params": jax.random.PRNGKey(0),
+                    "random_ltd": jax.random.PRNGKey(1)}, x, 8)
+        out = m.apply(p, x, 8, rngs={"random_ltd": jax.random.PRNGKey(2)})
+        a = np.asarray(out)
+        # exactly 8 of 16 tokens doubled per row
+        doubled = (a == 2.0).all(axis=-1).sum(axis=1)
+        kept = (a == 1.0).all(axis=-1).sum(axis=1)
+        assert (doubled == 8).all() and (kept == 8).all()
+
+    def test_wrapper_full_length_passthrough(self):
+        x = jnp.ones((2, 8, 4))
+        m = RandomLayerTokenDrop(layer=_Double())
+        p = m.init({"params": jax.random.PRNGKey(0),
+                    "random_ltd": jax.random.PRNGKey(1)}, x, 8)
+        out = m.apply(p, x, 8, rngs={"random_ltd": jax.random.PRNGKey(2)})
+        np.testing.assert_array_equal(np.asarray(out), 2.0 * np.asarray(x))
+
+    def test_scheduler_linear_growth_and_accounting(self):
+        s = RandomLTDScheduler({
+            "total_layer_num": 12, "random_ltd_layer_num": 8,
+            "global_batch_size": 4,
+            "random_ltd_schedule": {
+                "min_value": 128, "max_value": 512,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"require_steps": 100,
+                                    "seq_per_step": 16}}})
+        assert s.update_seq(0) == 128
+        mid = s.update_seq(50)
+        assert 128 < mid < 512 and mid % 16 == 0
+        assert s.update_seq(100) == 512
+        assert s.state["consumed_layer_tokens"] > 0
+        sd = s.state_dict()
+        s2 = RandomLTDScheduler({
+            "total_layer_num": 12, "random_ltd_layer_num": 8,
+            "global_batch_size": 4,
+            "random_ltd_schedule": {
+                "min_value": 128, "max_value": 512,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"require_steps": 100,
+                                    "seq_per_step": 16}}})
+        s2.load_state_dict(sd)
+        assert s2.get_current_seq() == s.get_current_seq()
+
+
+class TestEngineCurriculum:
+    def test_seqlen_curriculum_truncates_then_grows(self, capsys):
+        from tests.unit.simple_model import random_tokens, tiny_gpt2
+
+        ds = {
+            "train_batch_size": 8,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "curriculum_learning": {
+                "enabled": True, "curriculum_type": "seqlen",
+                "min_difficulty": 8, "max_difficulty": 16,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4,
+                                    "difficulty_step": 8}},
+            "steps_per_print": 1000,
+        }
+        batch = random_tokens(8, seq_len=16)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=tiny_gpt2(), config=ds,
+            example_batch=batch, rng=jax.random.PRNGKey(0))
+        losses = [float(jax.device_get(engine.train_batch(batch=batch)))
+                  for _ in range(5)]
+        assert all(np.isfinite(l) for l in losses)
+        # difficulty reached max by step 4
+        assert engine.curriculum_scheduler.get_current_difficulty() == 16
