@@ -1,0 +1,10 @@
+from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
+from deepspeed_tpu.linear.optimized_linear import (LoRAOptimizedLinear,
+                                                   OptimizedLinear,
+                                                   QuantizedLinear,
+                                                   lora_label_tree,
+                                                   mask_lora_frozen)
+
+__all__ = ["LoRAConfig", "QuantizationConfig", "OptimizedLinear",
+           "LoRAOptimizedLinear", "QuantizedLinear", "lora_label_tree",
+           "mask_lora_frozen"]

@@ -1,0 +1,36 @@
+"""LoRA / quantization configs (reference ``linear/config.py:13,39``)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List
+
+
+@dataclass
+class LoRAConfig:
+    """Reference field set (``linear/config.py:13``); ``offload`` /
+    ``offload_ratio`` are accepted for config compatibility — on TPU the
+    frozen base either lives in HBM or uses the engine's pinned-host
+    offload, there is no per-parameter ratio knob."""
+
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1
+    offload: bool = False
+    offload_ratio: float = 0.0
+    delay_lora_init: bool = False
+    target_mods: List[str] = field(default_factory=lambda: [
+        "q_proj", "k_proj", "v_proj", "o_proj", "gate_proj", "up_proj",
+        "down_proj"])
+
+
+@dataclass
+class QuantizationConfig:
+    """Reference field set (``linear/config.py:39``).  ``q_dtype`` is the
+    storage dtype; int8 payload with blockwise scales
+    (``ops/quantization.py``) replaces the reference's fp8-in-uint8 CUDA
+    buffers."""
+
+    q_bits: int = 8
+    mantissa_bits: int = 3
+    group_size: int = 512
+    q_dtype: Any = "int8"

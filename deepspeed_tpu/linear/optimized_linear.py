@@ -1,0 +1,186 @@
+"""OptimizedLinear: LoRA + quantized linear layers.
+
+TPU-native re-design of the reference ``linear/optimized_linear.py``
+(``OptimizedLinear`` dispatch ``:18``, ``LoRAOptimizedLinear:76``) and
+``linear/quantization.py`` (``QuantizedParameter``, ``QuantizedLinear``):
+
+- :class:`LoRAOptimizedLinear` — frozen base weight + trainable low-rank
+  adapters: ``y = x @ stop_gradient(W) + (alpha/r) * (x @ A) @ B``.
+  ``stop_gradient`` keeps base grads out of the backward graph (XLA prunes
+  the dead branch); :func:`mask_lora_frozen` additionally zeroes the
+  optimizer state for base leaves so moments are only allocated for
+  adapters — together these are the ``requires_grad=False`` semantics.
+  ``base_weight_sharding`` annotates the base kernel over the ``data``
+  axes (the reference shards it across the DP world the same way); GSPMD
+  then keeps one shard per member and gathers inside the matmul.
+- :class:`QuantizedLinear` — the base weight is STORED as int8 payload +
+  blockwise scales (``ops/quantization.py``; the reference stores fp8 in
+  uint8 buffers via ``FP_Quantize``) and dequantized on the fly inside
+  the forward — HBM holds 1 byte/param instead of 2.
+- :func:`OptimizedLinear` — the reference's dispatch: plain Dense without
+  configs, LoRA (optionally quantized base) with them.
+
+A/B init follows the reference (``init_lora``): A kaiming-uniform, B
+zeros, so step 0 output equals the base layer exactly.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
+
+LORA_ADAPTER_NAMES = ("lora_A", "lora_B")
+FROZEN_BASE_NAMES = ("base_kernel", "base_kernel_q", "base_kernel_scale",
+                     "base_kernel_offset")
+
+
+def _base_partitioning(cfg: Optional[LoRAConfig]):
+    if cfg is None or cfg.base_weight_sharding <= 1:
+        return None
+    # shard the input dim over the data axes (ZeRO-style memory split;
+    # reference flattens across world size the same way)
+    return ("data", "data_sub")
+
+
+class QuantizedLinear(nn.Module):
+    """Linear with int8-quantized frozen weight storage (reference
+    ``linear/quantization.py QuantizedLinear``)."""
+
+    output_dim: int
+    quantization_config: Optional[QuantizationConfig] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from deepspeed_tpu.ops.quantization import quantize
+
+        qcfg = self.quantization_config or QuantizationConfig()
+        in_dim = x.shape[-1]
+
+        def init_quantized(rng):
+            w = nn.initializers.xavier_uniform()(
+                rng, (in_dim, self.output_dim), jnp.float32)
+            qt = quantize(w, num_bits=qcfg.q_bits,
+                          group_size=min(qcfg.group_size, w.size))
+            return {"values": qt.values, "scale": qt.scale,
+                    "offset": qt.offset}
+
+        q = self.param("base_kernel_q", lambda rng: init_quantized(rng))
+        # dequantize on the fly: int8 payload + scales -> compute dtype;
+        # XLA fuses this into the matmul epilogue's operand read
+        w = (q["values"].astype(jnp.float32) * q["scale"] + q["offset"])
+        w = w.reshape(in_dim, self.output_dim).astype(self.dtype)
+        return x @ jax.lax.stop_gradient(w)
+
+
+class LoRAOptimizedLinear(nn.Module):
+    """Frozen base + low-rank adapters (reference
+    ``optimized_linear.py:76``).  ``bias=True`` is unsupported, like the
+    reference."""
+
+    input_dim: int
+    output_dim: int
+    lora_config: LoRAConfig
+    quantization_config: Optional[QuantizationConfig] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.lora_config
+        assert cfg is not None, "LoRAOptimizedLinear requires a LoRA config"
+        scaling = cfg.lora_alpha / cfg.lora_r
+
+        if self.quantization_config is not None:
+            from deepspeed_tpu.ops.quantization import quantize
+
+            qcfg = self.quantization_config
+
+            def init_q(rng):
+                w = nn.initializers.xavier_uniform()(
+                    rng, (self.input_dim, self.output_dim), jnp.float32)
+                qt = quantize(w, num_bits=qcfg.q_bits,
+                              group_size=min(qcfg.group_size, w.size))
+                return {"values": qt.values, "scale": qt.scale,
+                        "offset": qt.offset}
+
+            q = self.param("base_kernel_q", init_q)
+            base_w = (q["values"].astype(jnp.float32) * q["scale"]
+                      + q["offset"]).reshape(
+                self.input_dim, self.output_dim).astype(self.dtype)
+        else:
+            init = nn.initializers.xavier_uniform()
+            part = _base_partitioning(cfg)
+            if part is not None:
+                init = nn.with_partitioning(init, (part, None))
+            base_w = self.param("base_kernel", init,
+                                (self.input_dim, self.output_dim),
+                                self.dtype)
+        base_w = jax.lax.stop_gradient(base_w)
+
+        # A: kaiming uniform (reference init_lora follows peft); B: zeros
+        # so the initial output equals the base layer
+        a = self.param("lora_A",
+                       nn.initializers.variance_scaling(
+                           1.0 / 3.0, "fan_in", "uniform"),
+                       (self.input_dim, cfg.lora_r), self.dtype)
+        b = self.param("lora_B", nn.initializers.zeros,
+                       (cfg.lora_r, self.output_dim), self.dtype)
+        return x @ base_w + scaling * ((x @ a) @ b)
+
+
+def OptimizedLinear(input_dim: int, output_dim: int, bias: bool = False,
+                    lora_config: Optional[LoRAConfig] = None,
+                    quantization_config: Optional[QuantizationConfig] = None,
+                    dtype: Any = jnp.bfloat16) -> nn.Module:
+    """Dispatch (reference ``OptimizedLinear.__new__``): plain Dense
+    without configs; quantized-only; or LoRA (optionally quantized)."""
+    assert not bias, "bias=True is not supported by OptimizedLinear"
+    if lora_config is None and quantization_config is None:
+        return nn.Dense(output_dim, use_bias=False, dtype=dtype,
+                        param_dtype=dtype)
+    if lora_config is None:
+        return QuantizedLinear(output_dim=output_dim,
+                               quantization_config=quantization_config,
+                               dtype=dtype)
+    return LoRAOptimizedLinear(input_dim=input_dim, output_dim=output_dim,
+                               lora_config=lora_config,
+                               quantization_config=quantization_config,
+                               dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# trainability plumbing (torch requires_grad=False -> optax masking)
+# ---------------------------------------------------------------------------
+
+def lora_label_tree(params) -> Any:
+    """Label each leaf "frozen" (base weights) or "trainable" (adapters
+    and everything else) by parameter name, for ``optax.multi_transform``
+    or :func:`mask_lora_frozen`."""
+    import jax.tree_util as jtu
+
+    flat, treedef = jtu.tree_flatten_with_path(params)
+    labels = []
+    for kp, _ in flat:
+        names = [str(getattr(k, "key", getattr(k, "idx", k))) for k in kp]
+        frozen = any(n in FROZEN_BASE_NAMES for n in names)
+        labels.append("frozen" if frozen else "trainable")
+    return jtu.tree_unflatten(treedef, labels)
+
+
+def mask_lora_frozen(tx: optax.GradientTransformation
+                     ) -> optax.GradientTransformation:
+    """Wrap an optimizer so frozen base weights get no updates AND no
+    optimizer state (moments only for adapters — the LoRA memory win)."""
+    def mask_fn(params):
+        import jax.tree_util as jtu
+
+        return jtu.tree_map(lambda l: l == "trainable",
+                            lora_label_tree(params))
+
+    return optax.masked(tx, mask_fn)
