@@ -1,4 +1,15 @@
 from deepspeed_tpu.utils.logging import logger, log_dist, print_rank_0
+from deepspeed_tpu.utils.tensor_fragment import (
+    list_param_paths,
+    safe_get_full_fp32_param,
+    safe_get_full_grad,
+    safe_get_full_optimizer_state,
+    safe_get_local_fp32_param,
+    safe_get_local_grad,
+    safe_get_local_optimizer_state,
+    safe_set_full_fp32_param,
+    safe_set_full_optimizer_state,
+)
 from deepspeed_tpu.utils.timer import (
     SynchronizedWallClockTimer,
     ThroughputTimer,
@@ -8,4 +19,9 @@ from deepspeed_tpu.utils.timer import (
 __all__ = [
     "logger", "log_dist", "print_rank_0",
     "SynchronizedWallClockTimer", "ThroughputTimer", "Timer",
+    "safe_get_full_fp32_param", "safe_set_full_fp32_param",
+    "safe_get_full_optimizer_state", "safe_set_full_optimizer_state",
+    "safe_get_full_grad", "safe_get_local_fp32_param",
+    "safe_get_local_optimizer_state", "safe_get_local_grad",
+    "list_param_paths",
 ]

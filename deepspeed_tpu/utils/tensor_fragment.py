@@ -1,0 +1,214 @@
+"""Tensor-fragment debug APIs: inspect/patch sharded training state.
+
+Re-design of the reference ``utils/tensor_fragment.py`` ``safe_get/set_*``
+family (``:132 safe_get_full_fp32_param``, ``:164
+safe_get_full_optimizer_state``, ``:199 safe_get_full_grad``, local
+variants ``:243-299``).  The reference walks per-rank flat-buffer
+fragments (``tensor_fragment`` bookkeeping) because ZeRO scatters
+torch tensors by hand; under GSPMD a "fragment" is just the addressable
+shard of a global ``jax.Array``, so:
+
+- **full** variants materialize the whole (fp32 master) leaf on the host
+  — jax assembles across shards/processes transparently;
+- **local** variants return only this process's addressable shard(s) —
+  no cross-host traffic, the debugging-at-scale path;
+- **set** variants rebuild the engine state functionally (a new
+  ``TrainState`` with the leaf replaced, placed against the existing
+  sharding).
+
+Parameters are addressed by pytree path — a ``"/"``-joined string like
+``"transformer/h/attn/kernel"`` (the flax param tree layout) — instead of
+a live tensor object.  Optimizer-state keys accept both torch-style
+("exp_avg", "exp_avg_sq") and optax-style ("mu", "nu") names.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PathLike = Union[str, Tuple[str, ...]]
+
+_OPTIM_KEY_ALIASES = {
+    "exp_avg": "mu", "exp_avg_sq": "nu",
+    "momentum": "mu", "variance": "nu",
+    "mu": "mu", "nu": "nu", "trace": "trace",
+}
+
+
+def _split(path: PathLike) -> Tuple[str, ...]:
+    if isinstance(path, str):
+        return tuple(p for p in path.split("/") if p)
+    return tuple(path)
+
+
+def _lookup(tree: Any, parts: Tuple[str, ...]) -> Any:
+    node = tree
+    for p in parts:
+        if isinstance(node, (dict,)):
+            if p not in node:
+                raise KeyError(
+                    f"path component {p!r} not found; available: "
+                    f"{sorted(node)[:20]}")
+            node = node[p]
+        elif isinstance(node, (list, tuple)):
+            node = node[int(p)]
+        else:
+            node = getattr(node, p)
+    return node
+
+
+def _replace(tree: Any, parts: Tuple[str, ...], value: Any) -> Any:
+    """Functional leaf replacement along a dict path."""
+    if not parts:
+        return value
+    if isinstance(tree, dict):
+        new = dict(tree)
+        new[parts[0]] = _replace(tree[parts[0]], parts[1:], value)
+        return new
+    if isinstance(tree, (list, tuple)):
+        i = int(parts[0])
+        items = list(tree)
+        items[i] = _replace(items[i], parts[1:], value)
+        return type(tree)(items) if not hasattr(tree, "_fields") else \
+            type(tree)(*items)
+    raise TypeError(f"cannot replace inside {type(tree)}")
+
+
+def _param_leaf(engine, path: PathLike):
+    return _lookup(engine.state.params, _split(path))
+
+
+def _state_replace(state, **kw):
+    rep = getattr(state, "_replace", None) or getattr(state, "replace")
+    return rep(**kw)
+
+
+def _moment_trees(engine) -> Dict[str, Any]:
+    """Locate first/second-moment trees inside the optax state (chain
+    tuples, ScaleByAdamState.mu/nu, trace, or the 1-bit OnebitState)."""
+    found: Dict[str, Any] = {}
+
+    def walk(node):
+        for key in ("mu", "nu", "trace"):
+            sub = getattr(node, key, None)
+            if sub is not None and key not in found:
+                found[key] = sub
+        if isinstance(node, (tuple, list)):
+            for item in node:
+                walk(item)
+
+    walk(engine.state.opt_state)
+    return found
+
+
+def list_param_paths(engine) -> List[str]:
+    """All addressable param paths (debug discovery helper)."""
+    flat = jax.tree_util.tree_flatten_with_path(engine.state.params)[0]
+    return ["/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                     for k in kp) for kp, _ in flat]
+
+
+# ---------------------------------------------------------------------------
+# full (cross-shard) accessors
+# ---------------------------------------------------------------------------
+
+def safe_get_full_fp32_param(engine, path: PathLike) -> np.ndarray:
+    """Assembled fp32 master value of one parameter (reference ``:132``)."""
+    leaf = _param_leaf(engine, path)
+    return np.asarray(jax.device_get(leaf)).astype(np.float32)
+
+
+def safe_set_full_fp32_param(engine, path: PathLike, value) -> None:
+    """Overwrite one parameter globally (reference ``:148``); the new
+    value is placed against the leaf's existing sharding."""
+    parts = _split(path)
+    leaf = _param_leaf(engine, parts)
+    value = jnp.asarray(value, leaf.dtype)
+    assert value.shape == leaf.shape, (value.shape, leaf.shape)
+    new_leaf = jax.device_put(value, leaf.sharding)
+    engine.state = _state_replace(
+        engine.state,
+        params=_replace(engine.state.params, parts, new_leaf))
+
+def safe_get_full_optimizer_state(engine, path: PathLike,
+                                  optim_state_key: str
+                                  ) -> Optional[np.ndarray]:
+    """Assembled optimizer moment for one parameter (reference ``:164``)."""
+    key = _OPTIM_KEY_ALIASES.get(optim_state_key)
+    if key is None:
+        raise KeyError(f"unknown optimizer state key {optim_state_key!r}; "
+                       f"known: {sorted(_OPTIM_KEY_ALIASES)}")
+    trees = _moment_trees(engine)
+    if key not in trees:
+        return None
+    leaf = _lookup(trees[key], _split(path))
+    return np.asarray(jax.device_get(leaf)).astype(np.float32)
+
+
+def safe_set_full_optimizer_state(engine, path: PathLike, value,
+                                  optim_state_key: str) -> None:
+    """Overwrite one optimizer moment globally (reference ``:181``)."""
+    key = _OPTIM_KEY_ALIASES[optim_state_key]
+    parts = _split(path)
+
+    def walk_replace(node):
+        sub = getattr(node, key, None)
+        if sub is not None:
+            leaf = _lookup(sub, parts)
+            new_leaf = jax.device_put(jnp.asarray(value, leaf.dtype),
+                                      leaf.sharding)
+            return node._replace(**{key: _replace(sub, parts, new_leaf)})
+        if isinstance(node, tuple) and not hasattr(node, "_fields"):
+            return tuple(walk_replace(item) for item in node)
+        return node
+
+    engine.state = _state_replace(
+        engine.state,
+        opt_state=walk_replace(engine.state.opt_state))
+
+
+def safe_get_full_grad(engine, path: PathLike) -> Optional[np.ndarray]:
+    """Assembled gradient of one parameter (reference ``:199``).  Only
+    populated on the imperative fwd/bwd path between ``backward()`` and
+    ``step()`` — the fused ``train_batch`` consumes gradients inside one
+    compiled program and never exposes them (documented divergence)."""
+    grads = getattr(engine, "_pending_grads", None)
+    if grads is None:
+        return None
+    leaf = _lookup(grads, _split(path))
+    return np.asarray(jax.device_get(leaf)).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# local (addressable-shard) accessors
+# ---------------------------------------------------------------------------
+
+def _local_shard(leaf) -> np.ndarray:
+    shards = [np.asarray(s.data) for s in leaf.addressable_shards]
+    return shards[0] if len(shards) == 1 else np.stack(shards)
+
+
+def safe_get_local_fp32_param(engine, path: PathLike) -> np.ndarray:
+    """This process's shard(s) of a parameter (reference ``:269``)."""
+    return _local_shard(_param_leaf(engine, path)).astype(np.float32)
+
+
+def safe_get_local_optimizer_state(engine, path: PathLike,
+                                   optim_state_key: str
+                                   ) -> Optional[np.ndarray]:
+    key = _OPTIM_KEY_ALIASES[optim_state_key]
+    trees = _moment_trees(engine)
+    if key not in trees:
+        return None
+    return _local_shard(_lookup(trees[key],
+                                _split(path))).astype(np.float32)
+
+
+def safe_get_local_grad(engine, path: PathLike) -> Optional[np.ndarray]:
+    grads = getattr(engine, "_pending_grads", None)
+    if grads is None:
+        return None
+    return _local_shard(_lookup(grads, _split(path))).astype(np.float32)
