@@ -171,3 +171,111 @@ def quantize_fp8(x: jax.Array) -> FP8Tensor:
 
 def dequantize_fp8(ft: FP8Tensor) -> jax.Array:
     return (ft.values.astype(jnp.float32) * ft.scale).astype(ft.dtype)
+
+
+# ---------------------------------------------------------------------------
+# FP6 e3m2 (``csrc/fp6`` / FP6-LLM equivalent).  No native fp6 dtype
+# exists, so encode/decode is explicit bit math: 1 sign + 3 exponent
+# (bias 3) + 2 mantissa bits, subnormals at exponent 0, max normal 28.
+# Four 6-bit codes pack into three bytes — 6 bits/param in HBM.
+# ---------------------------------------------------------------------------
+
+FP6_MAX = 28.0                       # (1 + 3/4) * 2^(7-3)
+_FP6_BIAS = 3
+
+
+class FP6Tensor(NamedTuple):
+    values: jax.Array   # uint8 [num_groups, group_size * 3 // 4] packed
+    scale: jax.Array    # f32 [num_groups, 1]
+    shape: Tuple[int, ...]
+    dtype: jnp.dtype
+    group_size: int
+
+
+def _fp6_encode(a: jax.Array) -> jax.Array:
+    """|x| in [0, FP6_MAX] -> 5-bit magnitude code (3 exp | 2 mantissa),
+    round-to-nearest."""
+    a = jnp.clip(a, 0.0, FP6_MAX)
+    e = jnp.floor(jnp.log2(jnp.maximum(a, 1e-30)))
+    e = jnp.clip(e, 1 - _FP6_BIAS, 4)              # normal exponents
+    sub = a < 2.0 ** (1 - _FP6_BIAS)               # subnormal range
+    # subnormal: a = m/4 * 2^(1-bias) -> m = a * 16
+    m_sub = jnp.round(a * (4.0 / 2.0 ** (1 - _FP6_BIAS)))
+    # normal: a = (1 + m/4) * 2^e -> m = (a/2^e - 1) * 4
+    m_norm = jnp.round((a / 2.0 ** e - 1.0) * 4.0)
+    # mantissa rounding overflow (m == 4) bumps the exponent
+    bump = m_norm >= 4.0
+    e = jnp.where(bump, e + 1.0, e)
+    m_norm = jnp.where(bump, 0.0, m_norm)
+    over = e > 4.0
+    e = jnp.where(over, 4.0, e)
+    m_norm = jnp.where(over, 3.0, m_norm)
+    exp_bits = jnp.where(sub, 0.0, e + _FP6_BIAS)
+    m = jnp.where(sub, jnp.minimum(m_sub, 3.0), m_norm)
+    return (exp_bits.astype(jnp.uint8) << 2) | m.astype(jnp.uint8)
+
+
+def _fp6_decode(code: jax.Array) -> jax.Array:
+    """5-bit magnitude code -> float32 value."""
+    exp_bits = (code >> 2) & jnp.uint8(0x7)
+    m = (code & jnp.uint8(0x3)).astype(jnp.float32)
+    sub = exp_bits == 0
+    val_sub = m / 4.0 * 2.0 ** (1 - _FP6_BIAS)
+    val_norm = (1.0 + m / 4.0) * 2.0 ** (
+        exp_bits.astype(jnp.float32) - _FP6_BIAS)
+    return jnp.where(sub, val_sub, val_norm)
+
+
+def _pack6(codes: jax.Array) -> jax.Array:
+    """[G, gs] 6-bit codes -> [G, gs*3/4] packed bytes (4 codes/3 bytes)."""
+    g, gs = codes.shape
+    q = codes.reshape(g, gs // 4, 4).astype(jnp.uint32)
+    word = (q[..., 0] | (q[..., 1] << 6) | (q[..., 2] << 12)
+            | (q[..., 3] << 18))                   # 24 bits
+    b0 = (word & 0xFF).astype(jnp.uint8)
+    b1 = ((word >> 8) & 0xFF).astype(jnp.uint8)
+    b2 = ((word >> 16) & 0xFF).astype(jnp.uint8)
+    return jnp.stack([b0, b1, b2], axis=-1).reshape(g, gs * 3 // 4)
+
+
+def _unpack6(packed: jax.Array, group_size: int) -> jax.Array:
+    g = packed.shape[0]
+    b = packed.reshape(g, group_size // 4, 3).astype(jnp.uint32)
+    word = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16)
+    codes = jnp.stack([word & 0x3F, (word >> 6) & 0x3F,
+                       (word >> 12) & 0x3F, (word >> 18) & 0x3F], axis=-1)
+    return codes.reshape(g, group_size).astype(jnp.uint8)
+
+
+def quantize_fp6(x: jax.Array, group_size: int = 512) -> FP6Tensor:
+    """Blockwise-scaled fp6 e3m2 quantization (reference FP6-LLM weight
+    format, ``csrc/fp6``): each group scales its absmax onto FP6_MAX,
+    then values round to the fp6 grid and pack 6 bits each."""
+    shape, dtype = tuple(x.shape), x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    numel = flat.size
+    gs = group_size
+    while gs > 4 and (numel % gs or gs % 4):
+        gs -= 1
+    if numel % gs or gs % 4:
+        pad = (-numel) % 4
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+        gs = 4
+    groups = flat.reshape(-1, gs)
+    absmax = jnp.maximum(jnp.max(jnp.abs(groups), axis=1, keepdims=True),
+                         1e-12)
+    scale = absmax / FP6_MAX
+    scaled = groups / scale
+    sign = (scaled < 0).astype(jnp.uint8) << 5
+    codes = sign | _fp6_encode(jnp.abs(scaled))
+    return FP6Tensor(values=_pack6(codes), scale=scale, shape=shape,
+                     dtype=dtype, group_size=gs)
+
+
+def dequantize_fp6(ft: FP6Tensor) -> jax.Array:
+    codes = _unpack6(ft.values, ft.group_size)
+    mag = _fp6_decode(codes)
+    sign = jnp.where((codes >> 5) & jnp.uint8(1), -1.0, 1.0)
+    x = sign * mag * ft.scale
+    n = int(np.prod(ft.shape)) if ft.shape else 1
+    return x.reshape(-1)[:n].reshape(ft.shape).astype(ft.dtype)

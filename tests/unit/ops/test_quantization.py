@@ -70,3 +70,55 @@ def test_fp8_roundtrip():
     # e4m3 has ~2 decimal digits; relative error bounded
     np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=0.08,
                                atol=0.1)
+
+
+# -- FP6 e3m2 (csrc/fp6 / FP6-LLM equivalent) --------------------------------
+
+def test_fp6_roundtrip_error_bounds():
+    from deepspeed_tpu.ops.quantization import (FP6_MAX, dequantize_fp6,
+                                                quantize_fp6)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4096,)).astype(np.float32)
+    ft = quantize_fp6(jnp.asarray(x), group_size=512)
+    y = np.asarray(dequantize_fp6(ft))
+    assert y.shape == x.shape and y.dtype == x.dtype
+    # blockwise bound: per-element abs error <= scale * (largest fp6 grid
+    # gap / 2) = scale * 2
+    scale = np.repeat(np.asarray(ft.scale)[:, 0], ft.group_size)[:x.size]
+    assert np.all(np.abs(y - x) <= scale * 2.0 + 1e-6)
+    # normals quantize with ~2^-4 relative step -> small mean error
+    assert np.abs(y - x)[np.abs(x) > 0.1].mean() < 0.05
+
+
+def test_fp6_exact_on_representable_values():
+    from deepspeed_tpu.ops.quantization import dequantize_fp6, quantize_fp6
+
+    # group absmax = 28 makes scale exactly 1: these are fp6 grid points
+    vals = np.array([28.0, 0.0, 1.0, 1.25, 1.75, -3.5, 0.0625, -28.0,
+                     24.0, 0.125, 14.0, -0.75, 8.0, 2.5, -20.0, 5.0],
+                    np.float32)
+    ft = quantize_fp6(jnp.asarray(vals), group_size=16)
+    y = np.asarray(dequantize_fp6(ft))
+    np.testing.assert_allclose(y, vals, rtol=1e-6)
+
+
+def test_fp6_packing_density():
+    from deepspeed_tpu.ops.quantization import quantize_fp6
+
+    ft = quantize_fp6(jnp.ones((512, 16)), group_size=512)
+    assert ft.values.dtype == jnp.uint8
+    assert ft.values.size * 8 == 512 * 16 * 6  # 6 bits per param
+
+
+def test_fp6_matmul_accuracy():
+    from deepspeed_tpu.ops.quantization import dequantize_fp6, quantize_fp6
+
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(64, 32)).astype(np.float32) * 0.1
+    x = rng.normal(size=(8, 64)).astype(np.float32)
+    wq = np.asarray(dequantize_fp6(quantize_fp6(jnp.asarray(w),
+                                                group_size=64)))
+    ref, got = x @ w, x @ wq
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 0.05, rel
