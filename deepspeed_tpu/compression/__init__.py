@@ -1,0 +1,17 @@
+from deepspeed_tpu.compression.compress import (apply_compression,
+                                                get_compression_plan,
+                                                init_compression,
+                                                redundancy_clean,
+                                                student_initialization)
+from deepspeed_tpu.compression.layers import CompressedLinear, QuantAct
+from deepspeed_tpu.compression.scheduler import CompressionScheduler
+from deepspeed_tpu.compression.utils import (asym_quantize, binary_quantize,
+                                             sym_quantize, ternary_quantize,
+                                             topk_binarize)
+
+__all__ = [
+    "init_compression", "apply_compression", "get_compression_plan",
+    "redundancy_clean", "student_initialization", "CompressedLinear",
+    "QuantAct", "CompressionScheduler", "sym_quantize", "asym_quantize",
+    "binary_quantize", "ternary_quantize", "topk_binarize",
+]

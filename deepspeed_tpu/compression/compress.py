@@ -1,0 +1,192 @@
+"""Compression orchestration over flax param trees.
+
+Re-design of the reference ``compression/compress.py``
+(``init_compression:100``, ``redundancy_clean:148``,
+``student_initialization:192``): the torch version swaps nn.Linear
+modules for ``LinearLayer_Compress`` in place; functionally, compression
+becomes a PLAN — a map from param path to the methods targeting it,
+built from the same config schema (method groups with ``modules``
+keyword patterns) — and :func:`apply_compression`, a pure function
+``(params, plan, step) -> params`` implementing fake-quant / pruning
+with straight-through gradients.  Call it on the weights inside the loss
+(QAT), or once at export time via :func:`redundancy_clean` (hard masks,
+no STE).
+
+``student_initialization`` exploits the scan-stacked layer layout: layer
+reduction is literally ``teacher_leaf[teacher_layer_indices]`` on every
+stacked leaf — the [L, ...] leading dim IS the layer index.
+"""
+from __future__ import annotations
+
+import fnmatch
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.compression.layers import quantize_weight
+from deepspeed_tpu.compression.scheduler import CompressionScheduler
+from deepspeed_tpu.compression.utils import topk_binarize
+
+_METHODS = ("weight_quantization", "sparse_pruning", "row_pruning",
+            "head_pruning", "channel_pruning")
+
+
+def _paths(params) -> List[str]:
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    return ["/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                     for k in kp) for kp, _ in flat]
+
+
+def _matches(path: str, patterns: Sequence[str]) -> bool:
+    for pat in patterns:
+        if pat == "*" or pat in path or fnmatch.fnmatch(path, f"*{pat}*"):
+            return True
+    return False
+
+
+def get_compression_plan(params, compression_config: Dict[str, Any]
+                         ) -> Dict[str, Dict[str, Dict[str, Any]]]:
+    """{param_path: {method: spec}} from the reference config schema
+    (method -> different_groups -> {params, modules}).  Only kernel-like
+    leaves (ndim >= 2) are targeted, like the reference's Linear swap."""
+    plan: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    shapes = { "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in kp): leaf.shape for kp, leaf in flat}
+    for method in _METHODS:
+        mcfg = compression_config.get(method, {})
+        groups = mcfg.get("different_groups", {})
+        for gname, g in groups.items():
+            spec = dict(g.get("params", {}))
+            spec["group"] = gname
+            if "quantization_period" in g:
+                spec["quantization_period"] = g["quantization_period"]
+            mods = g.get("modules", ["*"])
+            for path, shape in shapes.items():
+                if len(shape) < 2:
+                    continue
+                if _matches(path, mods):
+                    plan.setdefault(path, {})[method] = spec
+    return plan
+
+
+def init_compression(params, ds_config: Dict[str, Any]):
+    """Reference ``init_compression``: returns ``(plan, scheduler)`` for
+    the config's ``compression_training`` subtree.  Apply with
+    :func:`apply_compression` each step."""
+    ccfg = ds_config.get("compression_training", ds_config) or {}
+    return get_compression_plan(params, ccfg), CompressionScheduler(ccfg)
+
+
+def _apply_leaf(leaf, methods: Dict[str, Dict[str, Any]], step: int,
+                scheduler: Optional[CompressionScheduler], hard: bool):
+    w = leaf
+    sp = methods.get("sparse_pruning")
+    if sp is not None and step >= int(sp.get("schedule_offset", 0)):
+        # dense_ratio = fraction KEPT (reference naming)
+        keep = float(sp.get("dense_ratio", 1.0 - float(sp.get("ratio", 0.5))))
+        mask = topk_binarize(jax.lax.stop_gradient(
+            jnp.abs(w.astype(jnp.float32))), keep)
+        w = w * jax.lax.stop_gradient(mask).astype(w.dtype)
+    rp = methods.get("row_pruning")
+    if rp is not None and step >= int(rp.get("schedule_offset", 0)):
+        keep = float(rp.get("dense_ratio", 1.0 - float(rp.get("ratio",
+                                                              0.5))))
+        norms = jnp.linalg.norm(
+            jax.lax.stop_gradient(w.astype(jnp.float32)).reshape(
+                w.shape[0], -1), ord=1, axis=1)
+        mask = jax.lax.stop_gradient(topk_binarize(norms, keep))
+        w = w * mask.reshape((-1,) + (1,) * (w.ndim - 1)).astype(w.dtype)
+    wq = methods.get("weight_quantization")
+    if wq is not None and step >= int(wq.get("schedule_offset", 0)):
+        bits = int(wq.get("target_bits", 8))
+        if scheduler is not None:
+            bits = scheduler.weight_quantization_bits(step).get(
+                wq.get("group", ""), bits)
+        method = "asymmetric" if wq.get("quantization_type",
+                                        "symmetric") == "asymmetric" \
+            else "symmetric"
+        groups = int(wq.get("quantize_groups", 1))
+        q = quantize_weight(w.astype(jnp.float32), bits, method, groups)
+        w = (jax.lax.stop_gradient(q).astype(w.dtype) if hard
+             else q.astype(w.dtype))
+    return w
+
+
+def apply_compression(params, plan, step: int = 0,
+                      scheduler: Optional[CompressionScheduler] = None,
+                      hard: bool = False):
+    """Pure QAT transform: fake-quantize / mask every planned leaf at
+    this step.  ``hard=True`` detaches (export semantics, reference
+    ``redundancy_clean``)."""
+    import jax.tree_util as jtu
+
+    flat, treedef = jtu.tree_flatten_with_path(params)
+    out = []
+    for kp, leaf in flat:
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in kp)
+        methods = plan.get(path)
+        out.append(leaf if methods is None else
+                   _apply_leaf(leaf, methods, step, scheduler, hard))
+    return jtu.tree_unflatten(treedef, out)
+
+
+def redundancy_clean(params, plan,
+                     scheduler: Optional[CompressionScheduler] = None):
+    """Permanently apply masks/quantization grids (reference
+    ``redundancy_clean:148``) — the post-training export pass."""
+    return apply_compression(params, plan, step=1 << 30,
+                             scheduler=scheduler, hard=True)
+
+
+def student_initialization(student_params, teacher_params,
+                           ds_config: Dict[str, Any]):
+    """Layer reduction (reference ``student_initialization:192``): copy
+    ``teacher_layer``-indexed slices of every scan-stacked leaf under
+    ``module_name_prefix`` into the student, plus whole
+    ``other_module_name`` subtrees."""
+    ccfg = ds_config.get("compression_training", ds_config)
+    lr_cfg = ccfg["layer_reduction"]
+    prefix = lr_cfg["module_name_prefix"].replace(".", "/")
+    teacher_layer = list(lr_cfg["teacher_layer"])
+    others = [n.replace(".", "/") for n in
+              lr_cfg.get("other_module_name", [])]
+    idx = np.asarray(teacher_layer)
+
+    import jax.tree_util as jtu
+
+    s_flat, treedef = jtu.tree_flatten_with_path(student_params)
+    t_flat = dict()
+    for kp, leaf in jtu.tree_flatten_with_path(teacher_params)[0]:
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in kp)
+        t_flat[path] = leaf
+
+    out = []
+    for kp, s_leaf in s_flat:
+        path = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in kp)
+        t_leaf = t_flat.get(path)
+        if t_leaf is None:
+            out.append(s_leaf)
+            continue
+        if path.startswith(prefix) or f"/{prefix}/" in f"/{path}":
+            # scan-stacked leaf: leading dim is the layer index
+            assert t_leaf.shape[0] >= max(teacher_layer) + 1, (
+                f"{path}: teacher has {t_leaf.shape[0]} layers, config "
+                f"asks for layer {max(teacher_layer)}")
+            sel = jnp.asarray(t_leaf)[idx]
+            assert sel.shape == s_leaf.shape, (
+                f"{path}: student {s_leaf.shape} vs selected {sel.shape}")
+            out.append(sel.astype(s_leaf.dtype))
+        elif any(path.startswith(o) or f"/{o}" in f"/{path}"
+                 for o in others):
+            assert t_leaf.shape == s_leaf.shape, (
+                f"{path}: {t_leaf.shape} vs {s_leaf.shape}")
+            out.append(jnp.asarray(t_leaf).astype(s_leaf.dtype))
+        else:
+            out.append(s_leaf)
+    return jtu.tree_unflatten(treedef, out)

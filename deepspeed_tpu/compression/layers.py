@@ -1,0 +1,157 @@
+"""Compression-aware flax layers.
+
+Re-design of the reference ``compression/basic_layer.py``
+(``LinearLayer_Compress:121``, ``QuantAct:17``): the torch versions
+mutate module state (masks as buffers, learnable score Parameters bolted
+on by ``enable_*`` calls); here compression is DECLARED in the module
+config and applied functionally each forward — weight fake-quant,
+sparse/row/head pruning (l1 static or topk learnable-score), activation
+quantization — all with straight-through gradients, all jit-safe.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression.utils import (asym_quantize, binary_quantize,
+                                             sym_quantize, ternary_quantize,
+                                             topk_binarize)
+
+
+def quantize_weight(w: jax.Array, bits: int, method: str = "symmetric",
+                    num_groups: int = 1) -> jax.Array:
+    if bits == 1:
+        return binary_quantize(w, num_groups)
+    if bits == 2:
+        return ternary_quantize(w, num_groups)
+    if method == "asymmetric":
+        return asym_quantize(w, bits, num_groups)
+    return sym_quantize(w, bits, num_groups)
+
+
+class QuantAct(nn.Module):
+    """Activation fake-quant (reference ``QuantAct:17``): dynamic range
+    per call, or a static range tracked as a running min/max EMA in a
+    mutable ``quant_stats`` collection."""
+
+    num_bits: int = 8
+    quant_mode: str = "symmetric"      # symmetric | asymmetric
+    dynamic: bool = True
+    ema_decay: float = 0.99
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = False):
+        if self.dynamic:
+            fn = sym_quantize if self.quant_mode == "symmetric" else \
+                asym_quantize
+            return fn(x, self.num_bits, num_groups=1)
+        mn = self.variable("quant_stats", "min",
+                           lambda: jnp.zeros((), jnp.float32))
+        mx = self.variable("quant_stats", "max",
+                           lambda: jnp.ones((), jnp.float32))
+        if not deterministic:
+            mn.value = self.ema_decay * mn.value + \
+                (1 - self.ema_decay) * jnp.min(x)
+            mx.value = self.ema_decay * mx.value + \
+                (1 - self.ema_decay) * jnp.max(x)
+        fn = sym_quantize if self.quant_mode == "symmetric" else \
+            asym_quantize
+        return fn(x, self.num_bits, min_value=mn.value, max_value=mx.value)
+
+
+class CompressedLinear(nn.Module):
+    """Linear with declarative compression (reference
+    ``LinearLayer_Compress``).  ``weight_bits`` enables fake-quant QAT
+    (pass the scheduler's current bits); pruning knobs build masks:
+
+    - ``sparse_pruning``: elementwise, "l1" (static from |w|) or "topk"
+      (learnable scores);
+    - ``row_pruning``: whole output rows;
+    - ``head_pruning``: groups of output columns (O-projection style,
+      needs ``num_heads``), topk only, like the reference.
+    """
+
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    weight_bits: Optional[int] = None
+    weight_quant_method: str = "symmetric"
+    weight_quant_groups: int = 1
+    sparse_pruning_ratio: Optional[float] = None
+    sparse_pruning_method: str = "l1"
+    row_pruning_ratio: Optional[float] = None
+    row_pruning_method: str = "l1"
+    head_pruning_ratio: Optional[float] = None
+    num_heads: Optional[int] = None
+    activation_quant_bits: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (in_dim, self.features), self.dtype)
+        b = self.param("bias", nn.initializers.zeros, (self.features,),
+                       self.dtype) if self.use_bias else None
+
+        if self.activation_quant_bits:
+            x = QuantAct(num_bits=self.activation_quant_bits,
+                         name="quant_act")(x)
+
+        if self.sparse_pruning_ratio is not None:
+            keep = 1.0 - self.sparse_pruning_ratio
+            if self.sparse_pruning_method == "topk":
+                scores = self.param(
+                    "sparse_mask_scores",
+                    nn.initializers.variance_scaling(1 / 3, "fan_in",
+                                                     "uniform"),
+                    (in_dim, self.features), jnp.float32)
+                w = w * topk_binarize(scores, keep).astype(w.dtype)
+            else:
+                mask = topk_binarize(jax.lax.stop_gradient(jnp.abs(w)),
+                                     keep)
+                w = w * jax.lax.stop_gradient(mask).astype(w.dtype)
+
+        if self.row_pruning_ratio is not None:
+            keep = 1.0 - self.row_pruning_ratio
+            if self.row_pruning_method == "topk":
+                scores = self.param(
+                    "row_mask_scores",
+                    nn.initializers.variance_scaling(1 / 3, "fan_in",
+                                                     "uniform"),
+                    (1, self.features), jnp.float32)
+                mask = topk_binarize(scores, keep).astype(w.dtype)
+            else:
+                norms = jnp.linalg.norm(
+                    jax.lax.stop_gradient(w.astype(jnp.float32)),
+                    ord=1, axis=0, keepdims=True)
+                mask = jax.lax.stop_gradient(
+                    topk_binarize(norms, keep)).astype(w.dtype)
+            w = w * mask
+            if b is not None:
+                b = b * mask[0]
+
+        if self.head_pruning_ratio is not None:
+            assert self.num_heads, "head pruning needs num_heads"
+            assert in_dim % self.num_heads == 0, (
+                "head pruning slices the INPUT dim (O-projection layout)")
+            keep = 1.0 - self.head_pruning_ratio
+            scores = self.param(
+                "head_pruning_scores",
+                nn.initializers.variance_scaling(1 / 3, "fan_in",
+                                                 "uniform"),
+                (1, self.num_heads), jnp.float32)
+            hmask = topk_binarize(scores, keep).astype(w.dtype)  # [1, H]
+            per_head = jnp.repeat(hmask[0], in_dim // self.num_heads)
+            w = w * per_head[:, None]
+
+        if self.weight_bits is not None:
+            w = quantize_weight(w, self.weight_bits,
+                                self.weight_quant_method,
+                                self.weight_quant_groups)
+
+        y = x @ w
+        return y + b if b is not None else y

@@ -1,0 +1,59 @@
+"""Compression scheduler (reference ``compression/scheduler.py:14
+compression_scheduler``): decides, per training step, which compression
+methods are live and the current weight-quantization bit-width.
+
+The reference flips ``*_enabled`` flags on mutated modules at
+``schedule_offset`` and halves quantization bits every ``q_period``
+(``start_bits -> target_bits``).  Here the scheduler is pure step math;
+its output feeds :func:`deepspeed_tpu.compression.apply_compression` or
+a model's ``weight_bits`` argument.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class CompressionScheduler:
+    def __init__(self, compression_config: Dict[str, Any]):
+        self.config = compression_config or {}
+
+    @staticmethod
+    def _shared(group_cfg: Dict[str, Any]) -> Dict[str, Any]:
+        return group_cfg.get("shared_parameters", group_cfg)
+
+    def weight_quantization_bits(self, step: int) -> Dict[str, int]:
+        """Current bits per weight-quantization group: linear start->target
+        halving every ``quantization_period`` steps after
+        ``schedule_offset`` (reference ``QuantizationScheduler``)."""
+        out = {}
+        wq = self.config.get("weight_quantization", {})
+        shared = self._shared(wq)
+        offset = int(shared.get("schedule_offset", 0))
+        for name, g in wq.get("different_groups", {}).items():
+            p = g.get("params", g)
+            start = int(p.get("start_bits", 8))
+            target = int(p.get("target_bits", 8))
+            period = int(g.get("quantization_period",
+                               p.get("quantization_period", 1)) or 1)
+            if step < offset:
+                out[name] = start
+                continue
+            halvings = (step - offset) // period
+            bits = start
+            for _ in range(halvings):
+                if bits <= target:
+                    break
+                bits = max(bits // 2, target)
+            out[name] = max(bits, target)
+        return out
+
+    def method_enabled(self, step: int, method: str) -> bool:
+        """Is a compression family live at this step (its
+        ``schedule_offset`` reached)?"""
+        cfg = self.config.get(method, {})
+        if not cfg:
+            return False
+        shared = self._shared(cfg)
+        if not shared.get("enabled", bool(cfg.get("different_groups"))):
+            return False
+        return step >= int(shared.get("schedule_offset", 0))

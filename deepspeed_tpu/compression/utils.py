@@ -1,0 +1,102 @@
+"""Straight-through-estimator quantizers and binarizers.
+
+Re-design of the reference ``compression/utils.py`` autograd.Functions
+(``TopKBinarizer:11``, ``SymQuantizer:63``, ``AsymQuantizer:105``,
+``TernaryQuantizer``, ``BinaryQuantizer``): fake-quantization for
+quantization-aware training.  Torch implements the straight-through
+estimator as a custom backward returning the gradient unchanged; in JAX
+the same thing is one idiom::
+
+    x + stop_gradient(q(x) - x)
+
+— forward value is ``q(x)``, backward is identity.  All functions are
+pure and jit/grad-safe.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _ste(x: jax.Array, qx: jax.Array) -> jax.Array:
+    return x + jax.lax.stop_gradient(qx - x)
+
+
+def sym_quantize(x: jax.Array, num_bits: int, num_groups: int = 1,
+                 min_value: Optional[jax.Array] = None,
+                 max_value: Optional[jax.Array] = None) -> jax.Array:
+    """Symmetric fake-quant with STE (reference ``SymQuantizer``)."""
+    q_range = 2 ** num_bits
+    shape = x.shape
+    g = x.reshape(num_groups, -1)
+    if min_value is None:
+        absmax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+    else:
+        assert num_groups == 1
+        absmax = jnp.maximum(jnp.abs(min_value), max_value).reshape(1, 1)
+    scale = 2.0 * absmax / q_range
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(g / scale), -q_range // 2, q_range // 2 - 1) * scale
+    return _ste(x, q.reshape(shape))
+
+
+def asym_quantize(x: jax.Array, num_bits: int, num_groups: int = 1,
+                  min_value: Optional[jax.Array] = None,
+                  max_value: Optional[jax.Array] = None) -> jax.Array:
+    """Asymmetric fake-quant with STE (reference ``AsymQuantizer``)."""
+    q_range = 2 ** num_bits
+    shape = x.shape
+    g = x.reshape(num_groups, -1)
+    if min_value is None:
+        mn = jnp.min(g, axis=-1, keepdims=True)
+        mx = jnp.max(g, axis=-1, keepdims=True)
+    else:
+        assert num_groups == 1
+        mn = min_value.reshape(1, 1)
+        mx = max_value.reshape(1, 1)
+    scale = jnp.maximum((mx - mn) / q_range, 1e-12)
+    zero = mn
+    q = jnp.clip(jnp.round((g - zero) / scale), 0, q_range - 1) * scale + zero
+    return _ste(x, q.reshape(shape))
+
+
+def binary_quantize(x: jax.Array, num_groups: int = 1) -> jax.Array:
+    """1-bit sign quantization scaled by per-group mean |x| (reference
+    ``BinaryQuantizer``)."""
+    shape = x.shape
+    g = x.reshape(num_groups, -1)
+    alpha = jnp.mean(jnp.abs(g), axis=-1, keepdims=True)
+    q = jnp.where(g >= 0, alpha, -alpha)
+    return _ste(x, q.reshape(shape))
+
+
+def ternary_quantize(x: jax.Array, num_groups: int = 1) -> jax.Array:
+    """{-a, 0, +a} quantization with 0.7*mean|x| threshold (reference
+    ``TernaryQuantizer``)."""
+    shape = x.shape
+    g = x.reshape(num_groups, -1)
+    thre = 0.7 * jnp.mean(jnp.abs(g), axis=-1, keepdims=True)
+    mask = (jnp.abs(g) > thre).astype(g.dtype)
+    alpha = jnp.sum(jnp.abs(g) * mask, axis=-1, keepdims=True) / \
+        jnp.maximum(jnp.sum(mask, axis=-1, keepdims=True), 1.0)
+    q = alpha * jnp.sign(g) * mask
+    return _ste(x, q.reshape(shape))
+
+
+def topk_binarize(scores: jax.Array, keep_ratio: float,
+                  sigmoid: bool = False) -> jax.Array:
+    """Binary mask keeping the top ``keep_ratio`` fraction of ``scores``
+    (reference ``TopKBinarizer``); backward passes gradients straight
+    through to the scores (learnable-mask pruning)."""
+    if sigmoid:
+        keep_ratio = jax.nn.sigmoid(keep_ratio)
+    flat = scores.reshape(-1)
+    k = jnp.maximum(
+        jnp.ceil(keep_ratio * flat.size).astype(jnp.int32), 1)
+    # threshold = k-th largest value
+    sorted_desc = jnp.sort(flat)[::-1]
+    thresh = sorted_desc[jnp.clip(k - 1, 0, flat.size - 1)]
+    mask = (flat >= thresh).astype(scores.dtype).reshape(scores.shape)
+    return _ste(scores, mask)

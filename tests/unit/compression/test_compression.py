@@ -1,0 +1,200 @@
+"""Compression tests (reference ``tests/unit/compression/test_compression.py``
+strategy: quantizer math, mask ratios, plan targeting, layer reduction)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.compression import (CompressedLinear,
+                                       CompressionScheduler, QuantAct,
+                                       apply_compression,
+                                       get_compression_plan,
+                                       init_compression, redundancy_clean,
+                                       student_initialization)
+from deepspeed_tpu.compression.utils import (asym_quantize, binary_quantize,
+                                             sym_quantize, ternary_quantize,
+                                             topk_binarize)
+
+
+class TestQuantizers:
+    def test_sym_quant_grid(self):
+        x = jnp.asarray(np.linspace(-1, 1, 101), jnp.float32)
+        q = np.asarray(sym_quantize(x, 8))
+        assert np.abs(q - np.asarray(x)).max() <= 2.0 / 256 + 1e-6
+        # idempotent on grid points
+        np.testing.assert_allclose(np.asarray(sym_quantize(jnp.asarray(q), 8)),
+                                   q, atol=1e-6)
+
+    def test_asym_quant_handles_shifted_range(self):
+        x = jnp.asarray(np.linspace(3, 5, 64), jnp.float32)
+        qs = np.asarray(sym_quantize(x, 4))
+        qa = np.asarray(asym_quantize(x, 4))
+        assert np.abs(qa - np.asarray(x)).max() < \
+            np.abs(qs - np.asarray(x)).max()
+
+    def test_binary_ternary(self):
+        x = jnp.asarray([[1.0, -2.0, 0.1, -0.05]])
+        b = np.asarray(binary_quantize(x))
+        assert set(np.round(np.abs(b), 6).flatten()) == {round(np.abs(
+            np.asarray(x)).mean(), 6)}
+        t = np.asarray(ternary_quantize(x))
+        assert (t[0, 2] == 0) and (t[0, 3] == 0)  # below 0.7*mean|x|
+        assert t[0, 0] > 0 and t[0, 1] < 0
+
+    def test_ste_gradients_pass_through(self):
+        x = jnp.asarray([0.3, -0.7, 0.9])
+        g = jax.grad(lambda v: jnp.sum(sym_quantize(v, 4) * 2.0))(x)
+        np.testing.assert_allclose(np.asarray(g), 2.0)
+
+    def test_topk_binarize_ratio(self):
+        s = jnp.asarray(np.random.default_rng(0).normal(size=(10, 10)),
+                        jnp.float32)
+        m = np.asarray(jax.lax.stop_gradient(topk_binarize(s, 0.3)))
+        assert m.sum() == 30
+
+
+class TestCompressedLinear:
+    def _run(self, **kw):
+        m = CompressedLinear(features=16, num_heads=kw.pop("num_heads", None),
+                             **kw)
+        x = jnp.ones((2, 32), jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), x)
+        return m, v, x
+
+    def test_plain_matches_dense(self):
+        m, v, x = self._run()
+        out = m.apply(v, x)
+        ref = x @ v["params"]["kernel"] + v["params"]["bias"]
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6)
+
+    def test_weight_quantization_changes_weights_not_shape(self):
+        m, v, x = self._run(weight_bits=4)
+        out = m.apply(v, x)
+        assert out.shape == (2, 16)
+        ref = x @ v["params"]["kernel"] + v["params"]["bias"]
+        assert not np.allclose(np.asarray(out), np.asarray(ref))
+
+    def test_sparse_pruning_l1_zeroes_smallest(self):
+        m, v, x = self._run(sparse_pruning_ratio=0.5)
+        w = np.asarray(v["params"]["kernel"])
+        out = np.asarray(m.apply(v, x)) - np.asarray(v["params"]["bias"])
+        # effective weight has ~50% zeros: output equals x @ (w*mask)
+        thresh = np.percentile(np.abs(w), 50)
+        w_masked = np.where(np.abs(w) >= thresh, w, 0.0)
+        np.testing.assert_allclose(out, np.asarray(x) @ w_masked,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_topk_sparse_has_learnable_scores(self):
+        m, v, x = self._run(sparse_pruning_ratio=0.5,
+                            sparse_pruning_method="topk")
+        assert "sparse_mask_scores" in v["params"]
+
+    def test_row_pruning_zeroes_columns(self):
+        m, v, x = self._run(row_pruning_ratio=0.25)
+        out = np.asarray(m.apply(v, x))
+        # 4 of 16 output features fully off (bias masked too)
+        assert (np.abs(out) < 1e-7).all(axis=0).sum() == 4
+
+    def test_head_pruning(self):
+        m, v, x = self._run(head_pruning_ratio=0.5, num_heads=4)
+        assert "head_pruning_scores" in v["params"]
+        out = m.apply(v, x)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_activation_quantization(self):
+        m, v, x = self._run(activation_quant_bits=8)
+        assert np.isfinite(np.asarray(m.apply(v, x))).all()
+
+
+class TestScheduler:
+    CFG = {"weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 10},
+        "different_groups": {
+            "wq1": {"params": {"start_bits": 16, "target_bits": 4},
+                    "quantization_period": 5,
+                    "modules": ["attention"]}}}}
+
+    def test_bits_halve_on_period(self):
+        s = CompressionScheduler(self.CFG)
+        assert s.weight_quantization_bits(0)["wq1"] == 16
+        assert s.weight_quantization_bits(14)["wq1"] == 16
+        assert s.weight_quantization_bits(15)["wq1"] == 8
+        assert s.weight_quantization_bits(20)["wq1"] == 4
+        assert s.weight_quantization_bits(1000)["wq1"] == 4
+
+    def test_method_enabled_gate(self):
+        s = CompressionScheduler(self.CFG)
+        assert not s.method_enabled(5, "weight_quantization")
+        assert s.method_enabled(10, "weight_quantization")
+        assert not s.method_enabled(10, "sparse_pruning")
+
+
+class TestPlanAndApply:
+    PARAMS = {
+        "attention": {"q": np.ones((8, 8), np.float32),
+                      "bias": np.ones((8,), np.float32)},
+        "mlp": {"w": np.arange(64, dtype=np.float32).reshape(8, 8)},
+    }
+    CFG = {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "wq1": {"params": {"start_bits": 8, "target_bits": 4},
+                        "modules": ["attention"]}}},
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.5},
+                        "modules": ["mlp"]}}},
+    }}
+
+    def test_plan_targets_matching_kernels_only(self):
+        plan, _ = init_compression(self.PARAMS, self.CFG)
+        assert "attention/q" in plan
+        assert "weight_quantization" in plan["attention/q"]
+        assert "attention/bias" not in plan          # 1-D skipped
+        assert "sparse_pruning" in plan["mlp/w"]
+        assert "weight_quantization" not in plan["mlp/w"]
+
+    def test_apply_prunes_half_of_mlp(self):
+        plan, sched = init_compression(self.PARAMS, self.CFG)
+        out = apply_compression(self.PARAMS, plan, step=1,
+                                scheduler=sched)
+        w = np.asarray(out["mlp"]["w"])
+        assert (w == 0).sum() == 32
+        # largest-magnitude half survives
+        assert w[7, 7] == 63.0 and w[0, 0] == 0.0
+
+    def test_redundancy_clean_detaches(self):
+        plan, sched = init_compression(self.PARAMS, self.CFG)
+        cleaned = redundancy_clean(self.PARAMS, plan, sched)
+
+        def loss(p):
+            return jnp.sum(cleaned["attention"]["q"] * 0 + p["mlp"]["w"])
+
+        assert np.isfinite(np.asarray(cleaned["mlp"]["w"])).all()
+
+
+class TestLayerReduction:
+    def test_student_init_selects_teacher_layers(self):
+        teacher = {"transformer": {
+            "h": {"kernel": np.arange(6 * 4, dtype=np.float32).reshape(6, 4)},
+            "ln_f": {"scale": np.full((4,), 7.0, np.float32)}},
+            "head": {"w": np.ones((4, 2), np.float32)}}
+        student = {"transformer": {
+            "h": {"kernel": np.zeros((3, 4), np.float32)},
+            "ln_f": {"scale": np.zeros((4,), np.float32)}},
+            "head": {"w": np.zeros((4, 2), np.float32)}}
+        cfg = {"compression_training": {"layer_reduction": {
+            "enabled": True,
+            "module_name_prefix": "transformer.h",
+            "teacher_layer": [1, 3, 5],
+            "other_module_name": ["transformer.ln_f", "head"]}}}
+        out = student_initialization(student, teacher, cfg)
+        np.testing.assert_array_equal(
+            np.asarray(out["transformer"]["h"]["kernel"]),
+            np.asarray(teacher["transformer"]["h"]["kernel"])[[1, 3, 5]])
+        np.testing.assert_array_equal(
+            np.asarray(out["transformer"]["ln_f"]["scale"]), 7.0)
+        np.testing.assert_array_equal(np.asarray(out["head"]["w"]), 1.0)
