@@ -1,0 +1,3 @@
+from deepspeed_tpu.io.aio import AsyncIOBuilder, aio_handle
+
+__all__ = ["AsyncIOBuilder", "aio_handle"]

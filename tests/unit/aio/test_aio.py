@@ -1,0 +1,97 @@
+"""Native AIO tests (reference ``tests/unit/ops/aio/test_aio.py``
+strategy: sync/async parity, roundtrips, overlap)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.io import AsyncIOBuilder, aio_handle
+from deepspeed_tpu.io.aio import file_size
+
+
+@pytest.fixture(scope="module")
+def handle():
+    assert AsyncIOBuilder().is_compatible()
+    return AsyncIOBuilder().load().aio_handle(block_size=1 << 16,
+                                              thread_count=4)
+
+
+def _rand(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 255, size=n, dtype=np.uint8)
+
+
+class TestSync:
+    def test_write_read_roundtrip(self, handle, tmp_path):
+        data = _rand(1 << 20, 1)  # 1 MiB -> 16 chunks across 4 threads
+        path = str(tmp_path / "a.bin")
+        assert handle.sync_pwrite(data, path) == data.nbytes
+        assert file_size(path) == data.nbytes
+        out = np.empty_like(data)
+        assert handle.sync_pread(out, path) == data.nbytes
+        np.testing.assert_array_equal(out, data)
+
+    def test_small_unaligned_sizes(self, handle, tmp_path):
+        for n in (1, 511, 513, 65537):
+            data = _rand(n, n)
+            path = str(tmp_path / f"s{n}.bin")
+            handle.sync_pwrite(data, path)
+            out = np.empty_like(data)
+            handle.sync_pread(out, path)
+            np.testing.assert_array_equal(out, data)
+
+    def test_offset_read(self, handle, tmp_path):
+        data = _rand(4096, 2)
+        path = str(tmp_path / "off.bin")
+        handle.sync_pwrite(data, path)
+        out = np.empty(1024, np.uint8)
+        handle.sync_pread(out, path, offset=1024)
+        np.testing.assert_array_equal(out, data[1024:2048])
+
+    def test_overwrite_shrinks_file(self, handle, tmp_path):
+        path = str(tmp_path / "w.bin")
+        handle.sync_pwrite(_rand(4096), path)
+        handle.sync_pwrite(_rand(100), path)
+        assert file_size(path) == 100
+
+    def test_read_missing_file_raises(self, handle, tmp_path):
+        out = np.empty(16, np.uint8)
+        with pytest.raises(OSError):
+            handle.sync_pread(out, str(tmp_path / "nope.bin"))
+
+
+class TestAsync:
+    def test_async_write_then_wait(self, handle, tmp_path):
+        data = _rand(1 << 19, 3)
+        path = str(tmp_path / "async.bin")
+        op = handle.async_pwrite(data, path)
+        assert handle.wait(op) == 0
+        out = np.empty_like(data)
+        handle.sync_pread(out, path)
+        np.testing.assert_array_equal(out, data)
+
+    def test_many_concurrent_ops(self, handle, tmp_path):
+        datas = [_rand(1 << 16, 10 + i) for i in range(8)]
+        ops = [handle.async_pwrite(d, str(tmp_path / f"c{i}.bin"))
+               for i, d in enumerate(datas)]
+        for op in ops:
+            handle.wait(op)
+        for i, d in enumerate(datas):
+            out = np.empty_like(d)
+            handle.sync_pread(out, str(tmp_path / f"c{i}.bin"))
+            np.testing.assert_array_equal(out, d)
+
+    def test_poll_transitions_to_done(self, handle, tmp_path):
+        data = _rand(1 << 22, 4)  # 4 MiB: big enough to observe pending
+        op = handle.async_pwrite(data, str(tmp_path / "poll.bin"))
+        deadline = time.time() + 30
+        while handle.poll(op) is None:
+            assert time.time() < deadline
+            time.sleep(0.001)
+        assert handle.poll(op) == 0
+
+    def test_stats_accumulate(self, handle, tmp_path):
+        before = handle.bytes_written()
+        handle.sync_pwrite(_rand(2048), str(tmp_path / "st.bin"))
+        assert handle.bytes_written() - before == 2048
