@@ -1,8 +1,12 @@
 from deepspeed_tpu.sequence.layer import (DistributedAttention,
                                           ulysses_attention)
 from deepspeed_tpu.sequence.ring import ring_attention
+from deepspeed_tpu.sequence.fpdt import (fpdt_attention,
+                                         fpdt_chunked_attention,
+                                         fpdt_input_construct)
 from deepspeed_tpu.sequence.cross_entropy import \
     vocab_sequence_parallel_cross_entropy
 
 __all__ = ["DistributedAttention", "ulysses_attention", "ring_attention",
-           "vocab_sequence_parallel_cross_entropy"]
+           "fpdt_attention", "fpdt_chunked_attention",
+           "fpdt_input_construct", "vocab_sequence_parallel_cross_entropy"]
