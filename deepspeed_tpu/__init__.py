@@ -26,3 +26,12 @@ def init_inference(*args, **kwargs):
     from deepspeed_tpu.inference.engine import init_inference as _init
 
     return _init(*args, **kwargs)
+
+
+def initialize_hybrid(*args, **kwargs):
+    """Create a hybrid train+generate engine for RLHF (reference
+    ``DeepSpeedHybridEngine``, ``runtime/hybrid_engine.py:38``)."""
+    from deepspeed_tpu.runtime.hybrid_engine import \
+        initialize_hybrid as _init
+
+    return _init(*args, **kwargs)

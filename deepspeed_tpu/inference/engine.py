@@ -57,10 +57,16 @@ def init_inference(model: Any, config: Any = None, params: Any = None,
 
 class InferenceEngine:
     def __init__(self, model, config: DeepSpeedInferenceConfig, params=None,
-                 topology=None, rng: Optional[jax.Array] = None):
+                 topology=None, rng: Optional[jax.Array] = None,
+                 param_source=None):
+        """``param_source``: zero-copy live parameter callable (the hybrid
+        engine's RLHF path) — when set, params are NOT staged here; every
+        forward/generate reads ``param_source()`` and any dtype cast
+        happens in-graph (flax computes in the serving dtype)."""
         self.config = config
         self.dtype = _DTYPES[config.dtype]
         self.module = model                      # API parity with reference
+        self._param_source = param_source
 
         tp_size = config.tensor_parallel.tp_size if config.tensor_parallel.enabled else 1
         dist.init_distributed()
@@ -105,6 +111,15 @@ class InferenceEngine:
         # -- params: init if absent, cast to serving dtype, TP-shard -------
         from deepspeed_tpu.parallel import tensor_parallel as tp_lib
 
+        if param_source is not None:
+            self.params = None                   # live view, never staged
+            self._generate_cache: Dict[Tuple, Any] = {}
+            self._forward_fn = None
+            self._cache_shapes: Dict[int, Any] = {}
+            log_dist(f"InferenceEngine: dtype={config.dtype} tp={tp_size} "
+                     f"max_cache_len={self.max_cache_len} "
+                     "(live shared params)", ranks=[0])
+            return
         if params is None:
             if rng is None:
                 rng = jax.random.PRNGKey(0)
@@ -178,7 +193,15 @@ class InferenceEngine:
                 return self._logits(model.apply({"params": params}, ids))
 
             self._forward_fn = jax.jit(fwd)
-        return self._forward_fn(self.params, jnp.asarray(input_ids))
+        return self._forward_fn(self._live_params(),
+                                jnp.asarray(input_ids))
+
+    def _live_params(self):
+        if self._param_source is not None:
+            p = self._param_source()
+            return p["params"] if isinstance(p, dict) and "params" in p \
+                else p
+        return self.params
 
     __call__ = forward
 
@@ -259,4 +282,4 @@ class InferenceEngine:
         if rng is None:
             rng = jax.random.PRNGKey(0)
         return np.asarray(jax.device_get(
-            self._generate_cache[key](self.params, prompt, rng)))
+            self._generate_cache[key](self._live_params(), prompt, rng)))

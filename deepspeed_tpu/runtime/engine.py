@@ -58,7 +58,9 @@ def initialize(args=None,
                config_params: Any = None,
                example_batch: Any = None,
                rng: Optional[jax.Array] = None,
-               mpu: Any = None):
+               mpu: Any = None,
+               engine_cls: Any = None,
+               engine_kwargs: Optional[Dict] = None):
     """Create a training engine (reference ``deepspeed.initialize``,
     ``deepspeed/__init__.py:69``; same return arity).
 
@@ -101,15 +103,17 @@ def initialize(args=None,
         dist.set_topology(topology)
         log_dist(f"hpZ: split data axis -> {topology.describe()}", ranks=[0])
 
-    engine = DeepSpeedEngine(model=model,
-                             model_parameters=model_parameters,
-                             config=ds_config,
-                             topology=topology,
-                             optimizer_name=optimizer,
-                             lr_scheduler=lr_scheduler,
-                             training_data=training_data,
-                             example_batch=example_batch,
-                             rng=rng)
+    cls = engine_cls or DeepSpeedEngine
+    engine = cls(model=model,
+                 model_parameters=model_parameters,
+                 config=ds_config,
+                 topology=topology,
+                 optimizer_name=optimizer,
+                 lr_scheduler=lr_scheduler,
+                 training_data=training_data,
+                 example_batch=example_batch,
+                 rng=rng,
+                 **(engine_kwargs or {}))
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 
