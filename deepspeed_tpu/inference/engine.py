@@ -40,6 +40,7 @@ _DTYPES = {"bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
 
 def init_inference(model: Any, config: Any = None, params: Any = None,
                    topology=None, rng: Optional[jax.Array] = None,
+                   checkpoint: Any = None,
                    **kwargs) -> "InferenceEngine":
     """Create an :class:`InferenceEngine` (reference
     ``deepspeed.init_inference``, ``deepspeed/__init__.py:291``).
@@ -51,6 +52,11 @@ def init_inference(model: Any, config: Any = None, params: Any = None,
     (benchmarking).
     """
     cfg = load_inference_config(config, **kwargs)
+    if checkpoint is not None:
+        assert params is None, "pass checkpoint= or params=, not both"
+        from deepspeed_tpu.module_inject import load_hf_checkpoint
+
+        params = load_hf_checkpoint(model, checkpoint)
     return InferenceEngine(model, cfg, params=params, topology=topology,
                            rng=rng)
 

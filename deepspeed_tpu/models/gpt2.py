@@ -164,9 +164,9 @@ class Block(nn.Module):
     def __call__(self, x, deterministic: bool = True):
         cfg = self.config
         x = x + CausalSelfAttention(cfg, name="attn")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
+            nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name="ln_1")(x), deterministic)
         x = x + MLP(cfg, name="mlp")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x), deterministic)
+            nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name="ln_2")(x), deterministic)
         return x
 
 
@@ -261,7 +261,7 @@ class GPT2Model(nn.Module):
             for i in range(cfg.n_layer):
                 x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
 
-        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=cfg.dtype, name="ln_f")(x)
         if cfg.tie_word_embeddings:
             logits = wte.attend(x)
         else:

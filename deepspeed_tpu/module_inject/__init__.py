@@ -1,0 +1,4 @@
+from deepspeed_tpu.module_inject.hf_loader import (convert_hf_state_dict,
+                                                   load_hf_checkpoint)
+
+__all__ = ["convert_hf_state_dict", "load_hf_checkpoint"]

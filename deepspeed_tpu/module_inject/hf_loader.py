@@ -1,0 +1,245 @@
+"""HF checkpoint -> flax params conversion (module injection).
+
+TPU-native counterpart of the reference ``module_inject/`` stack
+(``replace_module.py replace_transformer_layer``, ``load_checkpoint.py``):
+the reference swaps HuggingFace torch modules in place for fused/TP
+kernel containers and surgically loads checkpoint shards into them.
+Here the optimized model IS our flax model zoo, so "injection" becomes a
+pure weight-layout conversion: torch (or numpy) state dicts map onto the
+flax param trees — per-layer tensors stack onto the scan axis, torch
+``[out, in]`` linear weights transpose to flax ``[in, out]`` kernels,
+GPT-2's Conv1D stays untransposed — after which the inference engine's
+AutoTP sharding places them across the mesh (the TP half of the
+reference's injection policies).
+
+Supported families: GPT-2, Llama, Mixtral (matching
+``models/gpt2|llama|mixtral.py``).  Sources: a dict of tensors, an HF
+``transformers`` model object, or a directory holding
+``pytorch_model.bin`` / sharded ``pytorch_model-*.bin`` /
+``model.safetensors``.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Any, Dict, List
+
+import numpy as np
+
+__all__ = ["convert_hf_state_dict", "load_hf_checkpoint"]
+
+
+def _to_numpy(t) -> np.ndarray:
+    if isinstance(t, np.ndarray):
+        return t
+    try:
+        import torch
+
+        if isinstance(t, torch.Tensor):
+            return t.detach().to(torch.float32).cpu().numpy()
+    except ImportError:
+        pass
+    return np.asarray(t)
+
+
+def _read_state_dict(source) -> Dict[str, np.ndarray]:
+    if isinstance(source, dict):
+        return {k: _to_numpy(v) for k, v in source.items()}
+    if hasattr(source, "state_dict"):
+        return {k: _to_numpy(v) for k, v in source.state_dict().items()}
+    assert isinstance(source, str), f"unsupported source {type(source)}"
+    if os.path.isdir(source):
+        shards = (sorted(glob.glob(os.path.join(source, "pytorch_model*.bin")))
+                  or sorted(glob.glob(os.path.join(source, "*.safetensors"))))
+        assert shards, f"no checkpoint files under {source}"
+    else:
+        shards = [source]
+    sd: Dict[str, np.ndarray] = {}
+    for shard in shards:
+        if shard.endswith(".safetensors"):
+            from safetensors.numpy import load_file
+
+            sd.update(load_file(shard))
+        else:
+            import torch
+
+            part = torch.load(shard, map_location="cpu",
+                              weights_only=True)
+            sd.update({k: _to_numpy(v) for k, v in part.items()})
+    return sd
+
+
+def _strip_prefix(sd: Dict[str, np.ndarray], *prefixes: str
+                  ) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in sd.items():
+        for p in prefixes:
+            if k.startswith(p):
+                k = k[len(p):]
+                break
+        out[k] = v
+    return out
+
+
+def _stack(per_layer: List[Dict[str, Any]], scan_layers: bool):
+    """[{path: arr} per layer] -> {path: [L, ...]} (scan) or
+    {layer_name_i: {path: arr}} (unrolled)."""
+    if scan_layers:
+        out: Dict[str, Any] = {}
+        keys = per_layer[0].keys()
+        for k in keys:
+            out[k] = np.stack([layer[k] for layer in per_layer])
+        return out
+    return per_layer
+
+
+def _nest(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    tree: Dict[str, Any] = {}
+    for path, v in flat.items():
+        node = tree
+        parts = path.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+# ---------------------------------------------------------------------------
+# per-family converters
+# ---------------------------------------------------------------------------
+
+def _convert_gpt2(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    sd = _strip_prefix(sd, "transformer.")
+    L = cfg.n_layer
+    layers = []
+    for i in range(L):
+        p = f"h.{i}."
+        # HF GPT-2 Conv1D stores [in, out] — flax kernel layout already
+        layers.append({
+            "ln_1/scale": sd[p + "ln_1.weight"],
+            "ln_1/bias": sd[p + "ln_1.bias"],
+            "attn/c_attn/kernel": sd[p + "attn.c_attn.weight"],
+            "attn/c_attn/bias": sd[p + "attn.c_attn.bias"],
+            "attn/c_proj/kernel": sd[p + "attn.c_proj.weight"],
+            "attn/c_proj/bias": sd[p + "attn.c_proj.bias"],
+            "ln_2/scale": sd[p + "ln_2.weight"],
+            "ln_2/bias": sd[p + "ln_2.bias"],
+            "mlp/c_fc/kernel": sd[p + "mlp.c_fc.weight"],
+            "mlp/c_fc/bias": sd[p + "mlp.c_fc.bias"],
+            "mlp/c_proj/kernel": sd[p + "mlp.c_proj.weight"],
+            "mlp/c_proj/bias": sd[p + "mlp.c_proj.bias"],
+        })
+    flat = {
+        "wte/embedding": sd["wte.weight"],
+        "wpe/embedding": sd["wpe.weight"][:cfg.n_positions],
+        "ln_f/scale": sd["ln_f.weight"],
+        "ln_f/bias": sd["ln_f.bias"],
+    }
+    if cfg.scan_layers:
+        for k, v in _stack(layers, True).items():
+            flat[f"h/block/{k}"] = v
+    else:
+        for i, layer in enumerate(layers):
+            for k, v in layer.items():
+                flat[f"h_{i}/{k}"] = v
+    return _nest(flat)
+
+
+def _llama_layer(sd, p: str) -> Dict[str, np.ndarray]:
+    return {
+        "input_layernorm/scale": sd[p + "input_layernorm.weight"],
+        "post_attention_layernorm/scale":
+            sd[p + "post_attention_layernorm.weight"],
+        "self_attn/q_proj/kernel": sd[p + "self_attn.q_proj.weight"].T,
+        "self_attn/k_proj/kernel": sd[p + "self_attn.k_proj.weight"].T,
+        "self_attn/v_proj/kernel": sd[p + "self_attn.v_proj.weight"].T,
+        "self_attn/o_proj/kernel": sd[p + "self_attn.o_proj.weight"].T,
+    }
+
+
+def _convert_llama(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    L = cfg.num_hidden_layers
+    layers = []
+    for i in range(L):
+        p = f"model.layers.{i}."
+        layer = _llama_layer(sd, p)
+        layer.update({
+            "mlp/gate_proj/kernel": sd[p + "mlp.gate_proj.weight"].T,
+            "mlp/up_proj/kernel": sd[p + "mlp.up_proj.weight"].T,
+            "mlp/down_proj/kernel": sd[p + "mlp.down_proj.weight"].T,
+        })
+        layers.append(layer)
+    flat = {
+        "model/embed_tokens/embedding": sd["model.embed_tokens.weight"],
+        "model/norm/scale": sd["model.norm.weight"],
+        "lm_head/kernel": (sd.get("lm_head.weight",
+                                  sd["model.embed_tokens.weight"])).T,
+    }
+    _place_layers(flat, layers, cfg, prefix="model/layers")
+    return _nest(flat)
+
+
+def _convert_mixtral(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    L = cfg.num_hidden_layers
+    E = cfg.num_local_experts
+    layers = []
+    for i in range(L):
+        p = f"model.layers.{i}."
+        layer = _llama_layer(sd, p)
+        moe = p + "block_sparse_moe."
+        layer["block_sparse_moe/gate"] = sd[moe + "gate.weight"].T
+        for w in ("w1", "w2", "w3"):
+            layer[f"block_sparse_moe/{w}"] = np.stack(
+                [sd[f"{moe}experts.{e}.{w}.weight"].T for e in range(E)])
+        layers.append(layer)
+    flat = {
+        "model/embed_tokens/embedding": sd["model.embed_tokens.weight"],
+        "model/norm/scale": sd["model.norm.weight"],
+        "lm_head/kernel": (sd.get("lm_head.weight",
+                                  sd["model.embed_tokens.weight"])).T,
+    }
+    _place_layers(flat, layers, cfg, prefix="model/layers")
+    return _nest(flat)
+
+
+def _place_layers(flat, layers, cfg, prefix: str) -> None:
+    if cfg.scan_layers:
+        for k, v in _stack(layers, True).items():
+            flat[f"{prefix}/block/{k}"] = v
+    else:
+        base = prefix.rsplit("/", 1)[0]  # "model/layers" -> "model"
+        for i, layer in enumerate(layers):
+            for k, v in layer.items():
+                flat[f"{base}/layers_{i}/{k}"] = v
+
+
+_CONVERTERS = {
+    "GPT2Config": _convert_gpt2,
+    "LlamaConfig": _convert_llama,
+    "MixtralConfig": _convert_mixtral,
+}
+
+
+def convert_hf_state_dict(model_or_config, source) -> Dict[str, Any]:
+    """Convert an HF-layout checkpoint into the flax params tree for one
+    of our model families.  ``model_or_config``: a model-zoo module (its
+    ``.config`` picks the family) or the config dataclass itself."""
+    cfg = getattr(model_or_config, "config", model_or_config)
+    name = type(cfg).__name__
+    # subclass dispatch: MixtralConfig extends LlamaConfig
+    for cls in type(cfg).__mro__:
+        if cls.__name__ in _CONVERTERS:
+            name = cls.__name__
+            break
+    if name not in _CONVERTERS:
+        raise TypeError(f"no HF converter for config {type(cfg).__name__}; "
+                        f"supported: {sorted(_CONVERTERS)}")
+    sd = _read_state_dict(source)
+    return {"params": _CONVERTERS[name](sd, cfg)}
+
+
+def load_hf_checkpoint(model, source):
+    """Reference ``init_inference(checkpoint=...)`` entry: returns params
+    ready for ``deepspeed_tpu.init_inference(model, params=...)``."""
+    return convert_hf_state_dict(model, source)
