@@ -1,0 +1,140 @@
+"""Launcher tests (reference ``tests/unit/launcher/test_multinode_runner.py``
++ ``test_run.py``: pure command/parse assertions, no scheduler needed)."""
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher.multinode_runner import (LauncherArgs,
+                                                     MPICHRunner,
+                                                     MVAPICHRunner,
+                                                     OpenMPIRunner,
+                                                     PDSHRunner, SlurmRunner,
+                                                     get_runner)
+from deepspeed_tpu.launcher.runner import (build_ssh_command, filter_hosts,
+                                           parse_hostfile)
+
+POOL = {"worker-0": 4, "worker-1": 4, "worker-2": 4}
+
+
+def args(**kw):
+    kw.setdefault("user_script", "train.py")
+    kw.setdefault("user_args", ["--epochs", "2"])
+    return LauncherArgs(**kw)
+
+
+class TestHostfile:
+    def test_parse(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("worker-0 slots=4\n# comment\nworker-1 slots=8\n\n")
+        assert parse_hostfile(str(hf)) == {"worker-0": 4, "worker-1": 8}
+
+    def test_default_slots(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("worker-0\n")
+        assert parse_hostfile(str(hf)) == {"worker-0": 1}
+
+    def test_duplicate_raises(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("w slots=1\nw slots=2\n")
+        with pytest.raises(ValueError):
+            parse_hostfile(str(hf))
+
+    def test_empty_raises(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("# nothing\n")
+        with pytest.raises(ValueError):
+            parse_hostfile(str(hf))
+
+    def test_filters(self):
+        assert list(filter_hosts(POOL, include="worker-1")) == ["worker-1"]
+        assert list(filter_hosts(POOL, exclude="worker-0")) == \
+            ["worker-1", "worker-2"]
+        with pytest.raises(ValueError):
+            filter_hosts(POOL, include="missing-host")
+        with pytest.raises(ValueError):
+            filter_hosts(POOL, exclude="worker-0@worker-1@worker-2")
+
+
+class TestSshCommand:
+    def test_structure(self):
+        cmd = build_ssh_command("worker-1", {"A": "x y"}, ["python", "t.py"])
+        assert cmd[:2] == ["ssh", "-o"]
+        assert "worker-1" in cmd
+        remote = cmd[-1]
+        assert "export A='x y';" in remote
+        assert "python t.py" in remote
+
+
+class TestRunnerCommands:
+    def test_pdsh(self):
+        r = PDSHRunner(args(), POOL)
+        cmd = r.get_cmd({})
+        assert cmd[0] == "pdsh"
+        assert "-w" in cmd and "worker-0,worker-1,worker-2" in cmd
+        remote = cmd[-1]
+        assert "DSTPU_COORDINATOR=worker-0:29500" in remote
+        assert "DSTPU_NUM_PROCESSES=3" in remote
+        assert "DSTPU_PROCESS_ID=%n" in remote
+        assert "train.py --epochs 2" in remote
+
+    def test_openmpi(self):
+        r = OpenMPIRunner(args(hostfile="/hf"), POOL)
+        r.add_export("UCX_TLS", "tcp")
+        cmd = r.get_cmd({})
+        assert cmd[:5] == ["mpirun", "-n", "3", "--npernode", "1"]
+        assert "-hostfile" in cmd and "/hf" in cmd
+        assert "-x" in cmd
+        assert "UCX_TLS=tcp" in cmd
+        # default tcp interface pin present unless user overrides
+        assert "btl_tcp_if_include" in cmd
+        assert cmd[-4:] == [sys.executable, "-u", "train.py", "--epochs"] \
+            + ["2"][:0] or cmd[-2:] == ["--epochs", "2"]
+        assert "train.py" in cmd
+
+    def test_openmpi_user_btl_override(self):
+        r = OpenMPIRunner(args(
+            launcher_args="--mca btl_tcp_if_include ens5"), POOL)
+        cmd = r.get_cmd({})
+        assert cmd.count("btl_tcp_if_include") == 1  # only the user's
+
+    def test_openmpi_rejects_include(self):
+        with pytest.raises(ValueError):
+            OpenMPIRunner(args(include="worker-0"), POOL)
+
+    def test_mpich(self):
+        cmd = MPICHRunner(args(hostfile="/hf"), POOL).get_cmd({})
+        assert cmd[:5] == ["mpirun", "-n", "3", "-ppn", "1"]
+        assert "-genv" in cmd
+
+    def test_slurm(self):
+        cmd = SlurmRunner(args(num_nodes=3, slurm_comment="tpu job"),
+                          POOL).get_cmd({})
+        assert cmd[:3] == ["srun", "-n", "3"]
+        assert "--ntasks-per-node=1" in cmd
+        assert "--comment" in cmd and "tpu job" in cmd
+        assert "--nodes" in cmd
+        exports = [c for c in cmd if c.startswith("--export=ALL")]
+        assert exports and "DSTPU_NUM_PROCESSES=3" in exports[0]
+
+    def test_mvapich(self):
+        cmd = MVAPICHRunner(args(hostfile="/hf"), POOL).get_cmd({})
+        assert cmd[:3] == ["mpirun_rsh", "-np", "3"]
+        assert any(c.startswith("DSTPU_COORDINATOR=") for c in cmd)
+
+    def test_dispatch(self):
+        assert isinstance(get_runner("slurm", args(), POOL), SlurmRunner)
+        with pytest.raises(ValueError):
+            get_runner("nope", args(), POOL)
+
+    def test_no_python_mode(self):
+        cmd = PDSHRunner(args(no_python=True,
+                              user_script="./run.sh"), POOL).get_cmd({})
+        assert "python" not in cmd[-1] or sys.executable not in cmd[-1]
+        assert "./run.sh" in cmd[-1]
+
+    def test_master_addr_override(self):
+        r = SlurmRunner(args(master_addr="10.0.0.9", master_port=12345),
+                        POOL)
+        exports = [c for c in r.get_cmd({})
+                   if c.startswith("--export=ALL")][0]
+        assert "DSTPU_COORDINATOR=10.0.0.9:12345" in exports
