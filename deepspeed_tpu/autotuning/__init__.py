@@ -1,0 +1,3 @@
+from deepspeed_tpu.autotuning.autotuner import Autotuner, ModelInfo
+
+__all__ = ["Autotuner", "ModelInfo"]
