@@ -1,0 +1,27 @@
+"""Conventions shared by the v1 and v2 inference engines."""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def logits_of(out):
+    """Models may return (logits, aux) tuples (e.g. Mixtral's router
+    loss); serving wants the logits."""
+    return out[0] if isinstance(out, tuple) else out
+
+
+def normalize_params(model, params: Any,
+                     rng: Optional[jax.Array] = None,
+                     plain_model=None):
+    """Default-init when absent (benchmarking) and strip the flax
+    ``{"params": ...}`` wrapper."""
+    if params is None:
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        init_model = plain_model if plain_model is not None else model
+        params = jax.jit(init_model.init)(rng, np.zeros((1, 8), np.int32))
+    if isinstance(params, dict) and "params" in params:
+        params = params["params"]
+    return params

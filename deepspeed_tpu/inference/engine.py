@@ -126,15 +126,13 @@ class InferenceEngine:
                      f"max_cache_len={self.max_cache_len} "
                      "(live shared params)", ranks=[0])
             return
+        from deepspeed_tpu.inference.common import normalize_params
+
         if params is None:
-            if rng is None:
-                rng = jax.random.PRNGKey(0)
-            dummy = np.zeros((1, 8), np.int32)
-            params = jax.jit(self._plain_model.init)(rng, dummy)
             log_dist("init_inference: params randomly initialized "
                      "(none provided)", ranks=[0])
-        if isinstance(params, dict) and "params" in params:
-            params = params["params"]
+        params = normalize_params(model, params, rng=rng,
+                                  plain_model=self._plain_model)
 
         specs = None
         if tp_lib.has_partitioning(params):
@@ -180,7 +178,9 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def _logits(self, out):
-        return out[0] if isinstance(out, tuple) else out
+        from deepspeed_tpu.inference.common import logits_of
+
+        return logits_of(out)
 
     def _zero_cache_shapes(self, B: int, S: int):
         if B not in self._cache_shapes:

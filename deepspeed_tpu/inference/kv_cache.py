@@ -20,13 +20,20 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def update_kv_cache(mdl, k: jax.Array, v: jax.Array, max_len: int):
+def update_kv_cache(mdl, k: jax.Array, v: jax.Array, max_len: int,
+                    write_positions: jax.Array = None):
     """Append this call's K/V ``[B, Hkv, S, Dh]`` to the layer's cache.
 
     Returns ``(k_full, v_full, start)`` where the full buffers are
     ``[B, Hkv, max_len, Dh]`` and ``start`` is the write offset (number of
     tokens cached before this call).  Call inside an attention module with
     ``mutable=["cache"]`` applies; ``model.init`` creates zeroed buffers.
+
+    ``write_positions``: optional [B] PER-SEQUENCE write offsets — the
+    ragged/continuous-batching path (FastGen v2), where each slot sits at
+    its own length.  The scalar ``cache_index`` then only tracks the max
+    offset for bookkeeping; masking is the reader's job (positions-aware
+    ``cached_attention``).
     """
     B, Hkv, S, Dh = k.shape
     assert S <= max_len, (
@@ -38,6 +45,17 @@ def update_kv_cache(mdl, k: jax.Array, v: jax.Array, max_len: int):
                       (B, Hkv, max_len, Dh), v.dtype)
     ci = mdl.variable("cache", "cache_index",
                       lambda: jnp.zeros((), jnp.int32))
+    if write_positions is not None:
+        wp = write_positions.astype(jnp.int32).reshape(B)
+
+        def row_write(buf, kk, st):
+            return jax.lax.dynamic_update_slice(buf, kk, (0, st, 0))
+
+        ck.value = jax.vmap(row_write)(ck.value, k, wp)
+        cv.value = jax.vmap(row_write)(cv.value, v, wp)
+        start = ci.value
+        ci.value = jnp.maximum(ci.value, jnp.max(wp) + S)
+        return ck.value, cv.value, start
     start = ci.value
     ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, start, 0))
     cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, start, 0))

@@ -1,0 +1,4 @@
+from deepspeed_tpu.inference.v2.ragged_engine import (RaggedInferenceEngineV2,
+                                                      Request)
+
+__all__ = ["RaggedInferenceEngineV2", "Request"]

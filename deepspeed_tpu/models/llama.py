@@ -50,6 +50,10 @@ class LlamaConfig:
     # inference: thread a KV cache through attention (flax "cache"
     # collection); max_cache_len=0 -> max_position_embeddings
     decode: bool = False
+    # ragged/continuous-batching decode (FastGen v2): per-sequence [B, S]
+    # positions drive cache write offsets; explicit opt-in — shared slots
+    # at different lengths make position-derived writes load-bearing
+    ragged_decode: bool = False
     max_cache_len: int = 0
 
     def __post_init__(self):
@@ -159,13 +163,24 @@ class LlamaAttention(nn.Module):
                                                           update_kv_cache)
 
             max_len = cfg.max_cache_len or cfg.max_position_embeddings
-            k_full, v_full, _ = update_kv_cache(self, k, v, max_len)
-            if S == 1:                     # decode step: attend to the cache
+            # ragged path (FastGen v2 continuous batching, explicit
+            # config opt-in): rows write at their own [B, S] position
+            # offsets and every call — decode step or chunked-prefill
+            # chunk — attends to the cache under the positions mask
+            ragged = cfg.ragged_decode
+            if ragged:
+                assert (positions is not None and positions.ndim == 2 and
+                        positions.shape[0] == B), (
+                    "ragged_decode requires per-sequence [B, S] positions")
+            wp = positions[:, 0] if ragged else None
+            k_full, v_full, _ = update_kv_cache(self, k, v, max_len,
+                                                write_positions=wp)
+            if S == 1 or ragged:
                 y = cached_attention(q, k_full, v_full, positions)
                 y = y.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
                 return nn.Dense(E, name="o_proj", **dense,
                                 **_tp_kwargs(cfg, "row"))(y)
-            # prefill: cache written above; attend within the chunk below
+            # full-prefill: cache written above; attend within the chunk
 
         if cfg.sequence_parallel == "ulysses":
             from deepspeed_tpu.sequence import ulysses_attention
