@@ -277,14 +277,31 @@ def ppermute(x, perm, group: GroupLike = None, log_name: str = "ppermute"):
 
 
 def broadcast(x, src: int = 0, group: GroupLike = None, log_name: str = "broadcast"):
-    """Broadcast the ``src`` member's value to the whole group."""
+    """Broadcast the ``src`` member's value to the whole group.
+
+    In-graph lowering is a binomial-tree ``ppermute`` ladder: log2(n)
+    rounds, ranks [0, step) forwarding to [step, 2*step) — (n-1) total
+    buffer hops, the textbook broadcast wire cost (a masked psum would
+    ride a full all-reduce ring, ~2x the bytes plus the adds)."""
     axes = _resolve_axes(group)
     assert len(axes) == 1, "broadcast requires a single mesh axis"
     if _is_traced(x):
         comms_logger.append("broadcast", _nbytes(x), _axes_size(axes), None, log_name)
+        n = _axes_size(axes)
+        if n == 1:
+            return x
         idx = lax.axis_index(axes[0])
-        masked = jnp.where(idx == src, x, jnp.zeros_like(x))
-        return lax.psum(masked, axes[0])
+        rank = (idx - src) % n                     # src relabeled to rank 0
+        val = x
+        step = 1
+        while step < n:
+            perm = [((src + r) % n, (src + r + step) % n)
+                    for r in range(step) if r + step < n]
+            recv = lax.ppermute(val, axes[0], perm)
+            is_receiver = (rank >= step) & (rank < min(2 * step, n))
+            val = jnp.where(is_receiver, recv, val)
+            step *= 2
+        return val
     return _eager_collective("broadcast", x, axes, src=src, log_name=log_name)
 
 

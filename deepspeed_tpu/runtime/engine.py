@@ -970,7 +970,7 @@ class DeepSpeedEngine:
         batch = jax.tree_util.tree_map(reshape, batch)
         return jax.tree_util.tree_map(
             lambda x: jax.device_put(x, self.plan.batch_sharding(
-                x.ndim, has_gas_dim=True)), batch)
+                x.ndim, has_gas_dim=True, dtype=x.dtype)), batch)
 
     def put_batch(self, batch) -> "DeviceBatch":
         """Pre-stage a [train_batch, ...] batch on device in the engine's
@@ -1105,7 +1105,9 @@ class DeepSpeedEngine:
             batch = self._next_batch(data_iter)
         batch = jax.tree_util.tree_map(
             lambda x: jax.device_put(np.asarray(x),
-                                     self.plan.batch_sharding(np.asarray(x).ndim)),
+                                     self.plan.batch_sharding(
+                                         np.asarray(x).ndim,
+                                         dtype=np.asarray(x).dtype)),
             batch)
         if self._eval_step_fn is None:
             self._eval_step_fn = self._build_eval_step()
@@ -1124,7 +1126,9 @@ class DeepSpeedEngine:
                 "compiled step); use train_batch or a non-1-bit optimizer")
         self._fwd_batch = jax.tree_util.tree_map(
             lambda x: jax.device_put(np.asarray(x),
-                                     self.plan.batch_sharding(np.asarray(x).ndim)),
+                                     self.plan.batch_sharding(
+                                         np.asarray(x).ndim,
+                                         dtype=np.asarray(x).dtype)),
             batch)
         if self._eval_step_fn is None:
             self._eval_step_fn = self._build_eval_step()

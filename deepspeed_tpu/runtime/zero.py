@@ -184,27 +184,39 @@ class ZeroShardingPlan:
     def opt_state_shardings(self, opt_state, base_specs=None):
         return self._to_sharding(self.opt_state_specs(opt_state, base_specs))
 
-    def batch_spec(self, batch_ndim: int, has_gas_dim: bool = False) -> P:
+    def batch_spec(self, batch_ndim: int, has_gas_dim: bool = False,
+                   dtype=None) -> P:
         """Batch arrays shard their batch dim over (data, expert); with
         sequence parallelism active the dim after batch (the sequence dim of
         [B, S] token arrays) shards over ``seq`` — inputs then arrive
         seq-sharded exactly like the reference's Ulysses input contract
-        ([s/P, b, h], ``sequence/layer.py``)."""
+        ([s/P, b, h], ``sequence/layer.py``).
+
+        The seq rule applies only to INTEGER arrays (token ids / masks /
+        position ids): a float [B, features] input has no sequence dim,
+        and guessing one would mis-shard it.  Pass ``dtype`` to engage
+        the check; ``dtype=None`` keeps the token-array assumption for
+        backward compatibility."""
         axes = tuple(a for a in ("data", "data_sub", "expert")
                      if self.topology.axis_size(a) > 1)
         specs = []
         if has_gas_dim:
             specs.append(None)  # scan (GAS) dim never sharded
         specs.append(axes if len(axes) > 1 else (axes[0] if axes else None))
-        if len(specs) < batch_ndim and self.topology.axis_size("seq") > 1:
+        token_like = dtype is None or np.issubdtype(np.dtype(dtype),
+                                                    np.integer)
+        if (len(specs) < batch_ndim and token_like and
+                self.topology.axis_size("seq") > 1):
             specs.append("seq")
         while len(specs) < batch_ndim:
             specs.append(None)
         return P(*specs)
 
-    def batch_sharding(self, batch_ndim: int, has_gas_dim: bool = False) -> NamedSharding:
+    def batch_sharding(self, batch_ndim: int, has_gas_dim: bool = False,
+                       dtype=None) -> NamedSharding:
         return NamedSharding(self.topology.mesh,
-                             self.batch_spec(batch_ndim, has_gas_dim))
+                             self.batch_spec(batch_ndim, has_gas_dim,
+                                             dtype=dtype))
 
     def describe(self, params, base_specs=None) -> str:
         n_sharded = 0

@@ -121,3 +121,24 @@ def test_topology_process_coords():
     c = topo.get_coord(5)
     assert topo.get_rank(pipe=c.pipe, data=c.data, model=c.model) == 5
     assert len(topo.get_axis_list("pipe", 0)) == 4
+
+
+def test_traced_broadcast_tree(topo8):
+    """In-graph (binomial tree) broadcast: every member gets src's value,
+    for several src positions including non-powers-of-two."""
+    import functools
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    for src in (0, 3, 7):
+        @functools.partial(
+            jax.shard_map, mesh=topo8.mesh,
+            in_specs=P((DATA_AXIS, "data_sub")),
+            out_specs=P((DATA_AXIS, "data_sub")), check_vma=False)
+        def bcast(xs):
+            return dist.broadcast(xs, src=src, group=DATA_AXIS)
+
+        x = jnp.arange(8.0).reshape(8, 1) * 10
+        out = np.asarray(jax.jit(bcast)(x))
+        np.testing.assert_array_equal(out, np.full((8, 1), src * 10.0))
