@@ -489,6 +489,34 @@ def _apply_elasticity(cfg: DeepSpeedConfig, dp_world_size: int) -> None:
         setattr(cfg, key, new)
 
 
+# Reference knobs accepted for config compatibility whose BEHAVIOR is owned
+# by XLA/GSPMD on TPU — tuning them cannot have an effect by design (unlike
+# unimplemented features, which warn loudly below).  Grouped by what owns
+# them now; surfaced once at info level when a user explicitly sets one.
+_XLA_OWNED_KNOBS = {
+    "bucketing/overlap (XLA schedules and fuses collectives)": (
+        "allgather_bucket_size", "reduce_bucket_size", "overlap_comm",
+        "allgather_partitions", "contiguous_gradients",
+        "round_robin_gradients", "stage3_prefetch_bucket_size",
+        "stage3_max_reuse_distance", "sub_group_size"),
+    "host-memory management (jax owns pinned staging)": (
+        "pin_memory", "buffer_count", "buffer_size", "max_in_cpu",
+        "fast_init"),
+    "cuda-graph/stream controls": ("graph_harvesting",),
+}
+
+
+def _inert_knob_notes(cfg: DeepSpeedConfig) -> list:
+    set_fields = cfg.zero_optimization.model_fields_set | \
+        cfg.model_fields_set
+    notes = []
+    for reason, knobs in _XLA_OWNED_KNOBS.items():
+        hit = sorted(set(knobs) & set_fields)
+        if hit:
+            notes.append(f"{', '.join(hit)} — {reason}")
+    return notes
+
+
 def warn_unimplemented(cfg: DeepSpeedConfig) -> None:
     """Accepted-but-not-yet-implemented knobs fail LOUDLY instead of
     silently doing nothing (reference configs keep loading; the user keeps
@@ -509,6 +537,15 @@ def warn_unimplemented(cfg: DeepSpeedConfig) -> None:
     if offl_o is not None and offl_o.device == "nvme":
         notes.append("offload_optimizer.device=nvme (device=cpu "
                      "pinned-host offload IS supported)")
+    if (cfg.zero_optimization.zero_quantized_weights or
+            cfg.zero_optimization.zero_quantized_gradients or
+            cfg.zero_optimization.zero_quantized_nontrainable_weights):
+        logger.warning(
+            "config: zero_quantized_weights/gradients have no automatic "
+            "engine wiring on TPU (GSPMD owns the train-step collectives); "
+            "the qwZ/qgZ wire primitives are available as "
+            "deepspeed_tpu.comm.quantized_all_gather / "
+            "quantized_reduce_scatter inside shard_map code")
     if cfg.data_efficiency.enabled:
         logger.warning(
             "config: data_efficiency has no automatic engine wiring on "
@@ -518,3 +555,8 @@ def warn_unimplemented(cfg: DeepSpeedConfig) -> None:
     for note in notes:
         logger.warning(f"config: {note} is NOT implemented on TPU yet; "
                        "the setting has no effect")
+    inert = _inert_knob_notes(cfg)
+    if inert:
+        logger.info("config: accepted knobs with no TPU-side effect "
+                    "(the compiler owns this behavior): " +
+                    "; ".join(inert))
