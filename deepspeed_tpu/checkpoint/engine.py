@@ -227,3 +227,33 @@ def zero_to_fp32(checkpoint_dir: str, tag: Optional[str] = None
     prefix = "module/"
     return {k[len(prefix):]: v.astype(np.float32)
             for k, v in full.items() if k.startswith(prefix)}
+
+
+def save_16bit_model(engine, save_dir: str,
+                     output_file: str = "pytorch_model.bin") -> str:
+    """Consolidated half-precision weights for serving handoff (reference
+    ``engine.save_16bit_model`` / ``stage3_gather_16bit_weights_on_model_
+    save``): params only — no optimizer state — cast to the engine's
+    compute dtype, gathered leaf-by-leaf so host memory holds one full
+    leaf at a time, written as a flat {path: array} pickle."""
+    import jax.numpy as jnp
+
+    os.makedirs(save_dir, exist_ok=True)
+    dtype = engine.compute_dtype
+    if dtype == jnp.float32:
+        logger.warning("save_16bit_model with fp32 compute dtype: weights "
+                       "are written in fp32 (enable bf16/fp16 for a "
+                       "half-precision export)")
+    flat: Dict[str, np.ndarray] = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(
+            engine.state.params)[0]:
+        arr = np.asarray(jax.device_get(leaf))
+        if np.issubdtype(arr.dtype, np.floating):
+            arr = np.asarray(jax.device_get(leaf.astype(dtype)))
+        flat[sharded.path_str(kp)] = arr
+    path = os.path.join(save_dir, output_file)
+    if jax.process_index() == 0:
+        with open(path, "wb") as f:
+            pickle.dump(flat, f)
+    log_dist(f"save_16bit_model: {len(flat)} tensors -> {path}", ranks=[0])
+    return path

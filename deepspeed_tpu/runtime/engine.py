@@ -1209,6 +1209,15 @@ class DeepSpeedEngine:
         return _save(self, save_dir, tag=tag, client_state=client_state,
                      save_latest=save_latest, async_save=async_save)
 
+    def save_16bit_model(self, save_dir: str,
+                         output_file: str = "pytorch_model.bin") -> str:
+        """Consolidated compute-dtype weights for serving (reference
+        ``engine.save_16bit_model``)."""
+        from deepspeed_tpu.checkpoint.engine import \
+            save_16bit_model as _save16
+
+        return _save16(self, save_dir, output_file)
+
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True):

@@ -173,3 +173,29 @@ def test_reader_slice_assembly(tmp_path):
     with pytest.raises(KeyError):
         r.read_slice("nope", (slice(0, 1),))
     r.close()
+
+
+def test_save_16bit_model(tmp_path):
+    import pickle
+
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from tests.unit.simple_model import random_tokens, tiny_gpt2
+
+    topo = dist.initialize_mesh(dp=8)
+    ds = {"train_batch_size": 8,
+          "zero_optimization": {"stage": 3,
+                                "stage3_param_persistence_threshold": 64},
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+          "steps_per_print": 10000}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=ds, topology=topo,
+        example_batch=random_tokens(8), rng=jax.random.PRNGKey(0))
+    engine.train_batch(batch=random_tokens(8))
+    path = engine.save_16bit_model(str(tmp_path / "export"))
+    flat = pickle.load(open(path, "rb"))
+    # params only, fully assembled, no optimizer state
+    assert any("wte" in k for k in flat)
+    assert not any("mu" in k or "nu" in k for k in flat)
+    wte = [v for k, v in flat.items() if "wte" in k][0]
+    assert wte.shape == (128, 32)
