@@ -1,0 +1,127 @@
+"""Hessian eigenvalue estimation by power iteration (MoQ support).
+
+Re-design of the reference ``runtime/eigenvalue.py:13 Eigenvalue``: the
+top Hessian eigenvalue per layer drives the Mixture-of-Quantization
+precision schedule (sharper layers keep more bits).  The reference power-
+iterates with ``torch.autograd.grad(create_graph=True)`` Hessian-vector
+products; in JAX an HVP is one composition —
+``jax.jvp(jax.grad(loss), (params,), (v,))`` — fully jittable, no graph
+retention bookkeeping.
+
+``eigenvalue(loss_fn, params, rng)`` -> {layer_path: eigenvalue} over
+the requested top-level param groups, normalized to [0, 1] by the max
+like the reference's post-processing.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _inner(xs, ys) -> jax.Array:
+    return sum(jnp.vdot(x.astype(jnp.float32), y.astype(jnp.float32))
+               for x, y in zip(jax.tree_util.tree_leaves(xs),
+                               jax.tree_util.tree_leaves(ys)))
+
+
+def _normalize(v, stability: float):
+    norm = jnp.sqrt(_inner(v, v)) + stability
+    return jax.tree_util.tree_map(
+        lambda x: jnp.nan_to_num(x / norm, nan=0.0, posinf=0.0,
+                                 neginf=0.0), v)
+
+
+class Eigenvalue:
+    def __init__(self, verbose: bool = False, max_iter: int = 100,
+                 tol: float = 1e-2, stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1,
+                 layer_name: str = "", layer_num: int = 0):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+        self.layer_name = layer_name
+        self.layer_num = layer_num
+        log_dist(f"enabled eigenvalue: max_iter={max_iter} tol={tol} "
+                 f"layer_name={layer_name!r}", ranks=[0])
+
+    def compute_eigenvalue(self, loss_fn: Callable, params: Any,
+                           rng: Optional[jax.Array] = None,
+                           sub_paths: Optional[list] = None
+                           ) -> Dict[str, float]:
+        """Top Hessian eigenvalue per selected param subtree.
+
+        ``loss_fn(params) -> scalar``; ``sub_paths``: top-level keys to
+        treat as layers (default: ``layer_name`` children, else every
+        top-level key).  Returns eigenvalues normalized by their max
+        (reference ``post_process``: ratios drive the MoQ schedule).
+        """
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        grad_fn = jax.grad(loss_fn)
+
+        def hvp(p, v):
+            return jax.jvp(grad_fn, (p,), (v,))[1]
+
+        root = params
+        if self.layer_name:
+            for part in self.layer_name.split("/"):
+                root = root[part]
+        keys = sub_paths if sub_paths is not None else list(root)
+        if self.layer_num:
+            keys = keys[:self.layer_num]
+
+        raw: Dict[str, float] = {}
+        for key in keys:
+            rng, sub = jax.random.split(rng)
+            v = jax.tree_util.tree_map(
+                lambda p, k=sub: jax.random.normal(
+                    jax.random.fold_in(k, hash(p.shape) % (2 ** 31)),
+                    p.shape, jnp.float32), root[key])
+            v = _normalize(v, self.stability)
+            ev = 0.0
+            for it in range(self.max_iter):
+                # HVP restricted to the subtree: zero tangents elsewhere
+                tangent = jax.tree_util.tree_map(jnp.zeros_like, params)
+                tangent = _set_subtree(tangent, self.layer_name, key, v)
+                hv_full = hvp(params, tangent)
+                hv = _get_subtree(hv_full, self.layer_name, key)
+                new_ev = float(_inner(v, hv))
+                v = _normalize(hv, self.stability)
+                if it > 0 and abs(new_ev - ev) <= self.tol * max(
+                        abs(ev), 1e-12):
+                    ev = new_ev
+                    break
+                ev = new_ev
+            raw[str(key)] = abs(ev)
+            if self.verbose:
+                log_dist(f"eigenvalue[{key}] = {ev:.4e}", ranks=[0])
+        mx = max(raw.values()) or 1.0
+        return {k: val / mx for k, val in raw.items()}
+
+
+def _set_subtree(tree, layer_name: str, key, value):
+    node = tree
+    parents = []
+    for part in [p for p in layer_name.split("/") if p]:
+        parents.append((node, part))
+        node = node[part]
+    new = dict(node)
+    new[key] = value
+    for parent, part in reversed(parents):
+        parent = dict(parent)
+        parent[part] = new
+        new = parent
+    return new
+
+
+def _get_subtree(tree, layer_name: str, key):
+    node = tree
+    for part in [p for p in layer_name.split("/") if p]:
+        node = node[part]
+    return node[key]

@@ -1,0 +1,76 @@
+"""Progressive Layer Dropping (arXiv:2010.13369).
+
+Re-design of the reference ``runtime/progressive_layer_drop.py:10
+ProgressiveLayerDrop`` + the layer-side gates its paper model uses: the
+keep probability decays from 1.0 toward ``theta`` as
+``(1 - theta) * exp(-gamma * step) + theta``, and layer i of L keeps
+tokens with probability ``1 - (i/L) * (1 - theta_t)`` (deeper layers
+drop more).  The host-side schedule is identical math; the TPU-side gate
+is a flax wrapper using stochastic depth on scan-stacked blocks:
+dropping a layer multiplies its residual branch by 0 (with 1/p rescale
+on keep), so compiled shapes never change — the dropped layer's compute
+is dead code the scheduler skips paying memory bandwidth for.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    """Host-side theta schedule (reference API parity)."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {theta})",
+                 ranks=[0])
+
+    def get_state(self):
+        return {"progressive_layer_drop": True,
+                "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = ((1.0 - self.theta) *
+                              np.exp(-self.gamma * global_step) +
+                              self.theta)
+        return self.current_theta
+
+
+def layer_keep_probs(theta_t: float, n_layers: int) -> np.ndarray:
+    """Per-layer keep probability: layer i keeps with
+    ``1 - i/L * (1 - theta_t)`` (paper's depth-linear schedule)."""
+    i = np.arange(n_layers, dtype=np.float32)
+    return 1.0 - (i / max(n_layers, 1)) * (1.0 - float(theta_t))
+
+
+class PLDBlock(nn.Module):
+    """Stochastic-depth wrapper: ``out = x + gate * block(x)`` where the
+    gate is Bernoulli(keep_p) / keep_p during training and 1 at eval —
+    the TPU-native realization of PLD's layer skip (static shapes; XLA
+    dead-codes the dropped branch's memory traffic)."""
+
+    block: Any
+    keep_prob: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, *args, deterministic: bool = False):
+        out = self.block(x, *args)
+        if deterministic or self.keep_prob >= 1.0:
+            return out
+        rng = self.make_rng("pld")
+        keep = jax.random.bernoulli(rng, self.keep_prob)
+        # residual-style: dropping the layer returns the input unchanged,
+        # keeping rescales so the expectation matches eval
+        scale = jnp.where(keep, 1.0 / self.keep_prob, 0.0).astype(x.dtype)
+        return x + (out - x) * scale

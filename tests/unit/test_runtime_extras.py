@@ -1,0 +1,140 @@
+"""Progressive layer drop, eigenvalue, and tiled linear tests (reference
+``tests/unit/runtime/test_pld.py`` + ``runtime/test_ds_config_*`` style)."""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import (
+    PLDBlock, ProgressiveLayerDrop, layer_keep_probs)
+from deepspeed_tpu.runtime.tiling import TiledLinear
+
+
+class TestPLDSchedule:
+    def test_theta_decays_from_one_to_theta(self):
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert pld.get_theta() == 1.0
+        t0 = pld.update_state(0)
+        assert t0 == pytest.approx(1.0)
+        t_mid = pld.update_state(100)
+        t_late = pld.update_state(100000)
+        assert 0.5 < t_mid < 1.0
+        assert t_late == pytest.approx(0.5, abs=1e-4)
+
+    def test_reference_formula(self):
+        pld = ProgressiveLayerDrop(theta=0.3, gamma=0.001)
+        got = pld.update_state(500)
+        want = (1 - 0.3) * np.exp(-0.001 * 500) + 0.3
+        assert got == pytest.approx(want)
+
+    def test_state_dict(self):
+        pld = ProgressiveLayerDrop()
+        s = pld.get_state()
+        assert s["progressive_layer_drop"] is True
+        assert s["pld_theta"] == 1.0
+
+    def test_layer_keep_probs_depth_linear(self):
+        p = layer_keep_probs(0.5, 4)
+        np.testing.assert_allclose(p, [1.0, 0.875, 0.75, 0.625])
+
+
+class _Double(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        return x * 2.0
+
+
+class TestPLDBlock:
+    def test_eval_mode_always_applies(self):
+        m = PLDBlock(block=_Double(), keep_prob=0.5)
+        x = jnp.ones((2, 4))
+        v = m.init({"params": jax.random.PRNGKey(0),
+                    "pld": jax.random.PRNGKey(1)}, x, deterministic=True)
+        out = m.apply(v, x, deterministic=True)
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+
+    def test_training_drop_returns_input(self):
+        m = PLDBlock(block=_Double(), keep_prob=1e-9)  # ~always drop
+        x = jnp.ones((2, 4))
+        v = m.init({"params": jax.random.PRNGKey(0),
+                    "pld": jax.random.PRNGKey(1)}, x)
+        out = m.apply(v, x, rngs={"pld": jax.random.PRNGKey(2)})
+        np.testing.assert_allclose(np.asarray(out), 1.0)  # identity
+
+    def test_expectation_preserved(self):
+        m = PLDBlock(block=_Double(), keep_prob=0.5)
+        x = jnp.ones((1, 1))
+        v = m.init({"params": jax.random.PRNGKey(0),
+                    "pld": jax.random.PRNGKey(1)}, x)
+        outs = [float(np.asarray(m.apply(
+            v, x, rngs={"pld": jax.random.PRNGKey(i)}))[0, 0])
+            for i in range(400)]
+        # E[out] = x + E[gate]*(2x - x) = 2x = 2
+        assert np.mean(outs) == pytest.approx(2.0, abs=0.15)
+
+
+class TestEigenvalue:
+    def test_quadratic_eigenvalues(self):
+        """loss = sum_k a_k/2 * ||w_k||^2 has Hessian a_k * I: the power
+        iteration must recover the a_k ratios."""
+        params = {"layers": {"0": {"w": jnp.ones((4,))},
+                             "1": {"w": jnp.ones((4,))}}}
+
+        def loss(p):
+            return (1.0 * jnp.sum(p["layers"]["0"]["w"] ** 2) / 2 +
+                    4.0 * jnp.sum(p["layers"]["1"]["w"] ** 2) / 2)
+
+        ev = Eigenvalue(max_iter=50, tol=1e-4, layer_name="layers",
+                        layer_num=2).compute_eigenvalue(loss, params)
+        assert ev["1"] == pytest.approx(1.0)          # normalized max
+        assert ev["0"] == pytest.approx(0.25, abs=0.02)
+
+    def test_nonconvex_model(self):
+        from tests.unit.simple_model import random_tokens, tiny_gpt2
+
+        model = tiny_gpt2()
+        batch = random_tokens(2)
+        params = model.init(jax.random.PRNGKey(0), batch)
+
+        def loss(p):
+            return model.apply(p, batch)
+
+        ev = Eigenvalue(max_iter=8, tol=1e-2).compute_eigenvalue(
+            loss, params)
+        assert set(ev) == {"params"}
+        assert np.isfinite(list(ev.values())).all()
+
+
+class TestTiledLinear:
+    @pytest.mark.parametrize("in_splits,out_splits", [(1, 1), (2, 2),
+                                                      (4, 2)])
+    def test_matches_dense(self, in_splits, out_splits):
+        m = TiledLinear(features=12, in_splits=in_splits,
+                        out_splits=out_splits)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(3, 8)),
+                        jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), x)
+        # assemble the equivalent full matrix from the tiles
+        din, dout = 8 // in_splits, 12 // out_splits
+        W = np.zeros((8, 12), np.float32)
+        for o in range(out_splits):
+            for i in range(in_splits):
+                W[i * din:(i + 1) * din, o * dout:(o + 1) * dout] = \
+                    np.asarray(v["params"][f"tile_{i}_{o}"])
+        want = np.asarray(x) @ W + np.asarray(v["params"]["bias"])
+        np.testing.assert_allclose(np.asarray(m.apply(v, x)), want,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_max_param_size_bounded(self):
+        m = TiledLinear(features=64, in_splits=4, out_splits=4,
+                        use_bias=False)
+        v = m.init(jax.random.PRNGKey(0), jnp.ones((1, 64)))
+        sizes = [p.size for p in jax.tree_util.tree_leaves(v)]
+        assert max(sizes) == (64 // 4) * (64 // 4)
+
+    def test_divisibility_asserted(self):
+        m = TiledLinear(features=10, out_splits=3)
+        with pytest.raises(AssertionError):
+            m.init(jax.random.PRNGKey(0), jnp.ones((1, 9)))
