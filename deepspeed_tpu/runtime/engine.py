@@ -1209,6 +1209,59 @@ class DeepSpeedEngine:
         return _save(self, save_dir, tag=tag, client_state=client_state,
                      save_latest=save_latest, async_save=async_save)
 
+    def offload_states(self, include: Optional[Tuple[str, ...]] = None
+                       ) -> None:
+        """Move optimizer state (and optionally params) to host memory at
+        runtime (reference ``engine.offload_states:3839`` /
+        ``zero/offload_states.py``): frees HBM between training phases —
+        e.g. while a hybrid engine generates.  ``include``: subset of
+        ("optimizer", "params"); default optimizer only.  The next
+        train step streams them back in-graph (H2D fetch), or call
+        :meth:`reload_states` to move them back eagerly."""
+        if self.mesh.devices.flat[0].platform == "cpu":
+            logger.warning("offload_states: backend has no host memory "
+                           "space; no-op")
+            return
+        include = include or ("optimizer",)
+        to_host = jax.memory.TransferToMemoryKind("pinned_host")
+        state = self.state
+        if "optimizer" in include:
+            host_opt = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, to_host), state.opt_state)
+            self._fetch_opt = (
+                lambda o, _s=jax.tree_util.tree_map(
+                    lambda x: x.sharding, state.opt_state):
+                jax.device_put(o, _s))
+            state = state.replace(opt_state=host_opt)
+        if "params" in include:
+            host_p = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, to_host), state.params)
+            self._fetch_params = (
+                lambda p, _s=jax.tree_util.tree_map(
+                    lambda x: x.sharding, state.params):
+                jax.device_put(p, _s))
+            state = state.replace(params=host_p)
+        self.state = state
+        self._train_step_fn = None            # rebuild with fetch hooks
+        log_dist(f"offload_states: {include} moved to pinned host memory",
+                 ranks=[0])
+
+    def reload_states(self) -> None:
+        """Inverse of :meth:`offload_states` (reference
+        ``engine.reload_states:3871``)."""
+        if self.mesh.devices.flat[0].platform == "cpu":
+            return
+        to_dev = jax.memory.TransferToMemoryKind("device")
+        self.state = self.state.replace(
+            opt_state=jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, to_dev), self.state.opt_state),
+            params=jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, to_dev), self.state.params))
+        self._fetch_opt = lambda o: o
+        self._fetch_params = lambda p: p
+        self._train_step_fn = None
+        log_dist("reload_states: state back in device memory", ranks=[0])
+
     def save_16bit_model(self, save_dir: str,
                          output_file: str = "pytorch_model.bin") -> str:
         """Consolidated compute-dtype weights for serving (reference

@@ -84,3 +84,24 @@ def test_nvme_offload_warns(caplog):
     finally:
         ds_logger.removeHandler(caplog.handler)
     assert "nvme" in caplog.text
+
+
+def test_offload_reload_states_cpu_noop(devices):
+    """CPU backend: offload_states warns and no-ops; training continues."""
+    import deepspeed_tpu
+    from tests.unit.simple_model import random_tokens, tiny_gpt2
+
+    import deepspeed_tpu.comm as dist
+
+    topo = dist.initialize_mesh(dp=8)
+    ds = {"train_batch_size": 8,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+          "steps_per_print": 10000}
+    eng, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=ds, topology=topo,
+        example_batch=random_tokens(8), rng=jax.random.PRNGKey(0))
+    l0 = float(jax.device_get(eng.train_batch(batch=random_tokens(8))))
+    eng.offload_states()
+    eng.reload_states()
+    l1 = float(jax.device_get(eng.train_batch(batch=random_tokens(8))))
+    assert np.isfinite(l0) and np.isfinite(l1)
