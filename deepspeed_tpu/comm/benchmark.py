@@ -1,0 +1,84 @@
+"""Collective micro-benchmark (``ds_bench`` equivalent).
+
+Reference: ``bin/ds_bench`` + the DeepSpeedExamples communication
+benchmarks — time each collective across message sizes and report
+algorithmic + bus bandwidth.  Here the collectives are the eager facade
+ops (``deepspeed_tpu.comm``), timed with device synchronization, and
+busbw uses the same formulas as ``comms_logging.get_bw``.
+
+Run: ``python -m deepspeed_tpu.comm.benchmark [--ops all_reduce ...]
+[--maxsize 26]`` (sizes are powers of two bytes, fp32 elements).
+"""
+from __future__ import annotations
+
+import argparse
+import time
+from typing import Dict, List
+
+import jax
+import numpy as np
+
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.comm.comms_logging import get_bw
+
+OPS = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+       "broadcast")
+
+
+def _run_op(op: str, x, group):
+    fn = getattr(dist, op)
+    if op == "broadcast":
+        return fn(x, src=0, group=group)
+    return fn(x, group=group)
+
+
+def time_collective(op: str, nbytes: int, group=None, trials: int = 20,
+                    warmups: int = 5) -> Dict[str, float]:
+    topo = dist.get_topology()
+    world = topo.zero_partition_count()
+    # eager facade contract: leading dim = group size (one slice/member)
+    n = max(nbytes // 4 // world, 1)
+    x = jax.device_put(np.ones((world, n), np.float32))
+    for _ in range(warmups):
+        out = _run_op(op, x, group)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        out = _run_op(op, x, group)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / trials
+    bws = get_bw(op, nbytes, dt, world)       # already GB/s
+    return {"size_bytes": nbytes, "latency_us": dt * 1e6,
+            "algbw_gbps": bws["algbw"], "busbw_gbps": bws["busbw"]}
+
+
+def run_benchmark(ops: List[str], max_log_size: int = 24,
+                  min_log_size: int = 12, trials: int = 20) -> None:
+    dist.init_distributed()
+    topo = dist.get_topology()
+    print(f"# comms benchmark: {topo.describe()}")
+    for op in ops:
+        print(f"\n## {op}")
+        print(f"{'size':>12} {'latency(us)':>14} {'algbw(GB/s)':>12} "
+              f"{'busbw(GB/s)':>12}")
+        for p in range(min_log_size, max_log_size + 1, 2):
+            r = time_collective(op, 1 << p, trials=trials)
+            print(f"{r['size_bytes']:>12} {r['latency_us']:>14.1f} "
+                  f"{r['algbw_gbps']:>12.2f} {r['busbw_gbps']:>12.2f}")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ops", nargs="*", default=["all_reduce"],
+                   choices=list(OPS) + ["all"])
+    p.add_argument("--maxsize", type=int, default=24,
+                   help="log2 of the largest message in bytes")
+    p.add_argument("--minsize", type=int, default=12)
+    p.add_argument("--trials", type=int, default=20)
+    args = p.parse_args()
+    ops = list(OPS) if "all" in args.ops else args.ops
+    run_benchmark(ops, args.maxsize, args.minsize, args.trials)
+
+
+if __name__ == "__main__":
+    main()

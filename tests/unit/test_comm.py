@@ -142,3 +142,25 @@ def test_traced_broadcast_tree(topo8):
         x = jnp.arange(8.0).reshape(8, 1) * 10
         out = np.asarray(jax.jit(bcast)(x))
         np.testing.assert_array_equal(out, np.full((8, 1), src * 10.0))
+
+
+def test_accelerator_facade(topo8):
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    acc = get_accelerator()
+    assert acc.is_available()
+    assert acc.device_count() >= 1
+    assert acc.is_bf16_supported()
+    assert acc.communication_backend_name() == "xla"
+    assert isinstance(acc.device_kind(), str)
+    acc.synchronize()
+    key = acc.manual_seed(0)
+    assert key is not None
+
+
+def test_comms_benchmark_runs(topo8, capsys):
+    from deepspeed_tpu.comm.benchmark import time_collective
+
+    r = time_collective("all_reduce", 1 << 14, trials=2, warmups=1)
+    assert r["latency_us"] > 0
+    assert r["busbw_gbps"] >= 0
