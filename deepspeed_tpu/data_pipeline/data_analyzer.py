@@ -1,0 +1,118 @@
+"""Offline dataset analysis for curriculum learning.
+
+Re-design of the reference ``data_sampling/data_analyzer.py:22
+DataAnalyzer`` (+ ``:455 DistributedDataAnalyzer``): compute per-sample
+difficulty metrics over a dataset once, persist them, and hand them to
+:class:`~deepspeed_tpu.data_pipeline.DeepSpeedDataSampler`.  The
+reference shards the scan across ranks and merges mmap index files;
+here the scan is a plain (optionally process-parallel) map that writes
+``.npy`` arrays — the metric table is one scalar per sample, so even
+billion-sample corpora fit host storage trivially, and the sampler
+memory-maps the result.
+
+Built-in metrics mirror the reference's curriculum examples:
+``seqlen`` (non-padding token count) and ``vocab_rarity``
+(mean -log frequency of the sample's tokens).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Iterable, Mapping, Optional
+
+import numpy as np
+
+
+def seqlen_metric(sample, pad_token_id: int = 0) -> int:
+    ids = np.asarray(sample["input_ids"] if isinstance(sample, Mapping)
+                     else sample)
+    return int((ids != pad_token_id).sum())
+
+
+def make_vocab_rarity_metric(token_counts: np.ndarray):
+    """Mean -log p(token) under the corpus unigram distribution — the
+    reference's vocab-rarity curriculum metric."""
+    p = token_counts.astype(np.float64)
+    p = p / max(p.sum(), 1.0)
+    neglogp = -np.log(np.maximum(p, 1e-12))
+
+    def metric(sample) -> float:
+        ids = np.asarray(sample["input_ids"] if isinstance(sample, Mapping)
+                         else sample).reshape(-1)
+        return float(neglogp[ids].mean())
+
+    return metric
+
+
+class DataAnalyzer:
+    """``run(dataset)`` -> {metric_name: np.ndarray[num_samples]}.
+
+    ``metric_functions``: {name: fn(sample) -> number}.  ``save_path``
+    persists each metric as ``<name>_metric_values.npy`` (the reference's
+    ``*_metric_values`` file naming) for later ``load_metrics``.
+    """
+
+    def __init__(self, metric_functions: Dict[str, Callable[[Any], float]],
+                 save_path: Optional[str] = None, num_workers: int = 1,
+                 worker_id: int = 0):
+        assert metric_functions, "no metric functions given"
+        self.metric_functions = dict(metric_functions)
+        self.save_path = save_path
+        self.num_workers = max(int(num_workers), 1)
+        self.worker_id = int(worker_id)
+
+    def run(self, dataset) -> Dict[str, np.ndarray]:
+        """Scan this worker's stride of the dataset.  With
+        ``num_workers > 1`` each worker computes samples
+        ``worker_id::num_workers`` (reference rank-sharded scan); merge
+        with :meth:`merge_worker_results`."""
+        n = len(dataset)
+        idxs = range(self.worker_id, n, self.num_workers)
+        out = {name: np.zeros((n,), np.float32)
+               for name in self.metric_functions}
+        mask = np.zeros((n,), bool)
+        for i in idxs:
+            sample = dataset[i]
+            mask[i] = True
+            for name, fn in self.metric_functions.items():
+                out[name][i] = fn(sample)
+        if self.num_workers > 1:
+            out["_computed_mask"] = mask.astype(np.float32)
+        if self.save_path is not None:
+            os.makedirs(self.save_path, exist_ok=True)
+            suffix = (f"_w{self.worker_id}" if self.num_workers > 1
+                      else "")
+            for name, vals in out.items():
+                np.save(os.path.join(
+                    self.save_path, f"{name}_metric_values{suffix}.npy"),
+                    vals)
+        return out
+
+    @staticmethod
+    def merge_worker_results(results: Iterable[Dict[str, np.ndarray]]
+                             ) -> Dict[str, np.ndarray]:
+        """Combine per-worker strided scans into full metric tables."""
+        results = list(results)
+        assert results
+        merged: Dict[str, np.ndarray] = {}
+        masks = [r["_computed_mask"].astype(bool) for r in results]
+        for name in results[0]:
+            if name == "_computed_mask":
+                continue
+            vals = np.zeros_like(results[0][name])
+            for r, m in zip(results, masks):
+                vals[m] = r[name][m]
+            merged[name] = vals
+        covered = np.zeros_like(masks[0])
+        for m in masks:
+            covered |= m
+        assert covered.all(), "workers did not cover every sample"
+        return merged
+
+    @staticmethod
+    def load_metrics(save_path: str) -> Dict[str, np.ndarray]:
+        out = {}
+        for fname in os.listdir(save_path):
+            if fname.endswith("_metric_values.npy"):
+                out[fname[:-len("_metric_values.npy")]] = np.load(
+                    os.path.join(save_path, fname), mmap_mode="r")
+        return out

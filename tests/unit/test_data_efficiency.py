@@ -246,3 +246,64 @@ class TestEngineCurriculum:
         assert all(np.isfinite(l) for l in losses)
         # difficulty reached max by step 4
         assert engine.curriculum_scheduler.get_current_difficulty() == 16
+
+
+class TestDataAnalyzer:
+    def _dataset(self, n=32, seq=16, vocab=50, seed=0):
+        from tests.unit.simple_model import TokenDataset
+
+        return TokenDataset(n_samples=n, seq_len=seq, vocab=vocab,
+                            seed=seed)
+
+    def test_seqlen_metric_counts_nonpad(self):
+        from deepspeed_tpu.data_pipeline.data_analyzer import seqlen_metric
+
+        s = {"input_ids": np.array([5, 3, 0, 0, 7])}
+        assert seqlen_metric(s, pad_token_id=0) == 3
+
+    def test_run_and_feed_sampler(self, tmp_path):
+        from deepspeed_tpu.data_pipeline.data_analyzer import (DataAnalyzer,
+                                                               seqlen_metric)
+
+        ds = self._dataset()
+        an = DataAnalyzer({"seqlen": seqlen_metric},
+                          save_path=str(tmp_path))
+        metrics = an.run(ds)
+        assert metrics["seqlen"].shape == (32,)
+        loaded = DataAnalyzer.load_metrics(str(tmp_path))
+        np.testing.assert_array_equal(np.asarray(loaded["seqlen"]),
+                                      metrics["seqlen"])
+        # plugs straight into the curriculum sampler
+        sampler = DeepSpeedDataSampler(
+            total_samples=32, micro_batch_size=4, data_parallel_rank=0,
+            data_parallel_size=1,
+            curriculum_metrics={"seqlen": metrics["seqlen"]},
+            curriculum_schedulers={"seqlen": {
+                "min_difficulty": 16, "max_difficulty": 16,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4,
+                                    "difficulty_step": 8}}})
+        micro = next(iter(sampler))
+        assert len(micro) == 4
+        assert all(metrics["seqlen"][i] <= 16 for i in micro)
+
+    def test_worker_sharded_scan_merges(self):
+        from deepspeed_tpu.data_pipeline.data_analyzer import (DataAnalyzer,
+                                                               seqlen_metric)
+
+        ds = self._dataset()
+        parts = [DataAnalyzer({"seqlen": seqlen_metric}, num_workers=3,
+                              worker_id=w).run(ds) for w in range(3)]
+        merged = DataAnalyzer.merge_worker_results(parts)
+        full = DataAnalyzer({"seqlen": seqlen_metric}).run(ds)
+        np.testing.assert_array_equal(merged["seqlen"], full["seqlen"])
+
+    def test_vocab_rarity(self):
+        from deepspeed_tpu.data_pipeline.data_analyzer import \
+            make_vocab_rarity_metric
+
+        counts = np.array([100.0, 1.0])      # token 1 is rare
+        metric = make_vocab_rarity_metric(counts)
+        common = metric({"input_ids": np.zeros(4, np.int32)})
+        rare = metric({"input_ids": np.ones(4, np.int32)})
+        assert rare > common
