@@ -247,9 +247,17 @@ def save_16bit_model(engine, save_dir: str,
     flat: Dict[str, np.ndarray] = {}
     for kp, leaf in jax.tree_util.tree_flatten_with_path(
             engine.state.params)[0]:
-        arr = np.asarray(jax.device_get(leaf))
-        if np.issubdtype(arr.dtype, np.floating):
-            arr = np.asarray(jax.device_get(leaf.astype(dtype)))
+        # cast BEFORE the transfer (half the D2H bytes) and assemble
+        # cross-process shards when the leaf spans hosts
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            leaf = leaf.astype(dtype)
+        if getattr(leaf, "is_fully_addressable", True):
+            arr = np.asarray(jax.device_get(leaf))
+        else:
+            from jax.experimental import multihost_utils
+
+            arr = np.asarray(multihost_utils.process_allgather(
+                leaf, tiled=True))
         flat[sharded.path_str(kp)] = arr
     path = os.path.join(save_dir, output_file)
     if jax.process_index() == 0:

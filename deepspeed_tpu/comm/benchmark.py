@@ -34,10 +34,12 @@ def _run_op(op: str, x, group):
 
 def time_collective(op: str, nbytes: int, group=None, trials: int = 20,
                     warmups: int = 5) -> Dict[str, float]:
-    topo = dist.get_topology()
-    world = topo.zero_partition_count()
-    # eager facade contract: leading dim = group size (one slice/member)
-    n = max(nbytes // 4 // world, 1)
+    # the group the op actually runs over (default = all non-trivial axes)
+    world = dist.get_world_size(group)
+    # eager facade contract: leading dim = group size (one slice/member);
+    # ``nbytes`` is the PER-MEMBER payload (the ds_bench per-rank
+    # message-size convention, so numbers compare with the reference)
+    n = max(nbytes // 4, 1)
     x = jax.device_put(np.ones((world, n), np.float32))
     for _ in range(warmups):
         out = _run_op(op, x, group)

@@ -507,8 +507,13 @@ _XLA_OWNED_KNOBS = {
 
 
 def _inert_knob_notes(cfg: DeepSpeedConfig) -> list:
-    set_fields = cfg.zero_optimization.model_fields_set | \
-        cfg.model_fields_set
+    set_fields = set(cfg.zero_optimization.model_fields_set) | \
+        set(cfg.model_fields_set)
+    # host-memory knobs live on the offload sub-models
+    for sub in (cfg.zero_optimization.offload_param,
+                cfg.zero_optimization.offload_optimizer):
+        if sub is not None:
+            set_fields |= set(sub.model_fields_set)
     notes = []
     for reason, knobs in _XLA_OWNED_KNOBS.items():
         hit = sorted(set(knobs) & set_fields)

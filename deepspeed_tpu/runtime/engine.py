@@ -1224,7 +1224,13 @@ class DeepSpeedEngine:
             return
         include = include or ("optimizer",)
         to_host = jax.memory.TransferToMemoryKind("pinned_host")
+
+        def host_kind(shardings):
+            return jax.tree_util.tree_map(
+                lambda s: s.with_memory_kind("pinned_host"), shardings)
+
         state = self.state
+        shardings = self._state_shardings
         if "optimizer" in include:
             host_opt = jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, to_host), state.opt_state)
@@ -1233,6 +1239,8 @@ class DeepSpeedEngine:
                     lambda x: x.sharding, state.opt_state):
                 jax.device_put(o, _s))
             state = state.replace(opt_state=host_opt)
+            shardings = shardings.replace(
+                opt_state=host_kind(shardings.opt_state))
         if "params" in include:
             host_p = jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, to_host), state.params)
@@ -1241,8 +1249,13 @@ class DeepSpeedEngine:
                     lambda x: x.sharding, state.params):
                 jax.device_put(p, _s))
             state = state.replace(params=host_p)
+            shardings = shardings.replace(
+                params=host_kind(shardings.params))
         self.state = state
-        self._train_step_fn = None            # rebuild with fetch hooks
+        # the jitted steps bake in_shardings AND the fetch closures; every
+        # cached program must rebuild against the host-resident layout
+        self._state_shardings = shardings
+        self._invalidate_compiled_steps()
         log_dist(f"offload_states: {include} moved to pinned host memory",
                  ranks=[0])
 
@@ -1252,15 +1265,29 @@ class DeepSpeedEngine:
         if self.mesh.devices.flat[0].platform == "cpu":
             return
         to_dev = jax.memory.TransferToMemoryKind("device")
+
+        def dev_kind(shardings):
+            return jax.tree_util.tree_map(
+                lambda s: s.with_memory_kind("device"), shardings)
+
         self.state = self.state.replace(
             opt_state=jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, to_dev), self.state.opt_state),
             params=jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, to_dev), self.state.params))
+        self._state_shardings = self._state_shardings.replace(
+            opt_state=dev_kind(self._state_shardings.opt_state),
+            params=dev_kind(self._state_shardings.params))
         self._fetch_opt = lambda o: o
         self._fetch_params = lambda p: p
-        self._train_step_fn = None
+        self._invalidate_compiled_steps()
         log_dist("reload_states: state back in device memory", ranks=[0])
+
+    def _invalidate_compiled_steps(self) -> None:
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        self._grad_step_fn = None
+        self._apply_step_fn = None
 
     def save_16bit_model(self, save_dir: str,
                          output_file: str = "pytorch_model.bin") -> str:

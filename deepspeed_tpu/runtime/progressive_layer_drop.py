@@ -64,13 +64,20 @@ class PLDBlock(nn.Module):
     keep_prob: float = 1.0
 
     @nn.compact
-    def __call__(self, x, *args, deterministic: bool = False):
+    def __call__(self, x, *args, keep_prob=None,
+                 deterministic: bool = False):
+        """``keep_prob`` may be passed per call as a TRACED value (the
+        theta schedule changes every step — baking it into the module
+        attribute would recompile the train step per step)."""
         out = self.block(x, *args)
-        if deterministic or self.keep_prob >= 1.0:
+        p = self.keep_prob if keep_prob is None else keep_prob
+        if deterministic or (keep_prob is None and self.keep_prob >= 1.0):
             return out
+        p = jnp.asarray(p, jnp.float32)
         rng = self.make_rng("pld")
-        keep = jax.random.bernoulli(rng, self.keep_prob)
+        keep = jax.random.bernoulli(rng, p)
         # residual-style: dropping the layer returns the input unchanged,
         # keeping rescales so the expectation matches eval
-        scale = jnp.where(keep, 1.0 / self.keep_prob, 0.0).astype(x.dtype)
+        scale = jnp.where(keep, 1.0 / jnp.maximum(p, 1e-6),
+                          0.0).astype(x.dtype)
         return x + (out - x) * scale
