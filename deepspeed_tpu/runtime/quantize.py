@@ -1,0 +1,142 @@
+"""MoQ: Mixture-of-Quantization training quantizer.
+
+Re-design of the reference ``runtime/quantize.py:14 Quantizer`` (the MoQ
+engine): weights quantize progressively during training — bit-width
+halves from ``q_start_bits`` toward ``q_target_bits`` every
+``q_period[layer]`` steps, the quantized value blends with the
+full-precision value by a decaying ratio (``q_mixed_fp16``), and when
+Hessian eigenvalue ratios are supplied (``runtime/eigenvalue.py``),
+sharper layers stretch their periods — ``period * (1 + floor(ev * 4))``
+— so they keep precision longer.
+
+Functional: ``quantize_params(params, step)`` returns a new tree; the
+actual rounding reuses the STE quantizers in ``compression/utils.py``
+(sym/asym/binary/ternary), so gradients pass straight through when used
+inside the loss for QAT.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.compression.utils import (asym_quantize, binary_quantize,
+                                             sym_quantize, ternary_quantize)
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class Quantizer:
+    """Reference constructor surface; ``layer_paths`` names the param
+    subtrees treated as layers (defaults to every 2-D+ leaf's parent)."""
+
+    def __init__(self, q_groups: int = 1, q_mixed_fp16: bool = False,
+                 q_change_ratio: float = 0.01, q_type: str = "symmetric",
+                 q_rounding: str = "nearest", q_verbose: bool = False,
+                 q_eigenvalue: bool = False,
+                 use_quantizer_kernel: bool = False,
+                 q_start_bits: int = 16, q_target_bits: int = 8,
+                 q_period: int = 1000, layer_num: int = 0):
+        self.q_groups = q_groups
+        self.q_mixed_fp16 = q_mixed_fp16
+        self.q_change_ratio = q_change_ratio
+        self.q_type = q_type
+        self.q_rounding = q_rounding
+        self.q_verbose = q_verbose
+        self.q_eigenvalue = q_eigenvalue
+        self.use_quantizer_kernel = use_quantizer_kernel
+        self.q_start_bits = q_start_bits
+        self.q_target_bits = q_target_bits
+        self.q_period = q_period
+        self.layer_num = layer_num
+        self.qsteps = 0
+        self.quantize_real_ratio = 1.0
+
+    # -- schedule -------------------------------------------------------
+
+    def step(self) -> None:
+        self.qsteps += 1
+
+    def update_fp16_ratio(self) -> None:
+        """Mixed-precision blend decays toward pure-quantized (reference
+        ``update_fp16_ratio``)."""
+        if self.q_mixed_fp16:
+            self.quantize_real_ratio = max(
+                0.0, self.quantize_real_ratio - self.q_change_ratio)
+
+    def bits_at(self, step: int, eigenvalue_ratio: Optional[float] = None
+                ) -> int:
+        """Current bit-width: halves every (possibly eigenvalue-
+        stretched) period until the target."""
+        period = self.q_period
+        if eigenvalue_ratio is not None:
+            period = period * (1 + math.floor(eigenvalue_ratio * 4))
+        bits = self.q_start_bits
+        halvings = step // max(period, 1)
+        for _ in range(halvings):
+            if bits <= self.q_target_bits:
+                break
+            bits = max(bits // 2, self.q_target_bits)
+        return bits
+
+    # -- quantization ---------------------------------------------------
+
+    def _fake_quant(self, w: jax.Array, bits: int) -> jax.Array:
+        groups = min(self.q_groups, max(w.size, 1))
+        if bits == 1:
+            return binary_quantize(w, groups)
+        if bits == 2:
+            return ternary_quantize(w, groups)
+        fn = asym_quantize if self.q_type == "asymmetric" else sym_quantize
+        return fn(w, bits, groups)
+
+    def compute_quantization(self, w: jax.Array, index: int = 0,
+                             factor: float = 1.0,
+                             eigenvalue_ratio: Optional[float] = None
+                             ) -> jax.Array:
+        bits = self.bits_at(self.qsteps, eigenvalue_ratio)
+        if bits >= 16:
+            return w                       # not yet in the schedule
+        wq = self._fake_quant(w.astype(jnp.float32), bits).astype(w.dtype)
+        if self.q_mixed_fp16 and bits >= self.q_target_bits - 1:
+            wq = (w * self.quantize_real_ratio +
+                  (1.0 - self.quantize_real_ratio) * wq)
+        return wq
+
+    def quantize_params(self, params: Any, overflow: bool = False,
+                        eigenvalue_ratios: Optional[Dict[str, float]]
+                        = None) -> Any:
+        """One MoQ tick over a param tree (reference ``quantize``):
+        advances the step, decays the blend ratio, fake-quantizes every
+        2-D+ floating leaf.  ``eigenvalue_ratios``: {path-substring:
+        normalized eigenvalue} stretching that layer's period."""
+        if overflow and not self.q_eigenvalue:
+            return params
+        self.step()
+        self.update_fp16_ratio()
+        import jax.tree_util as jtu
+
+        flat, treedef = jtu.tree_flatten_with_path(params)
+        out = []
+        for kp, leaf in flat:
+            path = "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in kp)
+            if (getattr(leaf, "ndim", 0) < 2 or
+                    not jnp.issubdtype(leaf.dtype, jnp.floating)):
+                out.append(leaf)
+                continue
+            ev = None
+            if eigenvalue_ratios:
+                for frag, val in eigenvalue_ratios.items():
+                    if frag in path:
+                        ev = val
+                        break
+            out.append(self.compute_quantization(
+                leaf, eigenvalue_ratio=ev))
+        if self.q_verbose:
+            log_dist(f"MoQ step {self.qsteps}: bits="
+                     f"{self.bits_at(self.qsteps)} "
+                     f"ratio={self.quantize_real_ratio:.3f}", ranks=[0])
+        return jtu.tree_unflatten(treedef, out)

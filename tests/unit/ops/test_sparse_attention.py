@@ -1,0 +1,139 @@
+"""Block-sparse attention tests (reference
+``tests/unit/ops/sparse_attention/test_sparse_attention.py`` strategy:
+layout structure + parity against dense attention under the same mask)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                BSLongformerSparsityConfig,
+                                                DenseSparsityConfig,
+                                                FixedSparsityConfig,
+                                                SparseSelfAttention,
+                                                block_sparse_attention)
+
+
+def _qkv(B=1, H=2, S=64, D=8, seed=0):
+    r = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(r.normal(size=(B, H, S, D)) * 0.5, jnp.float32)
+    return mk(), mk(), mk()
+
+
+def dense_with_mask(q, k, v, token_mask):
+    """Reference oracle: dense softmax attention under a [H, S, S] bool
+    token mask."""
+    D = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    s = jnp.where(jnp.asarray(token_mask)[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def layout_to_token_mask(layout, block, causal=False):
+    H, nb, _ = layout.shape
+    S = nb * block
+    m = np.kron(layout, np.ones((block, block), bool))
+    if causal:
+        m = m & np.tril(np.ones((S, S), bool))[None]
+    return m
+
+
+class TestLayouts:
+    def test_dense_all_active(self):
+        lay = DenseSparsityConfig(num_heads=2, block=16).make_layout(64)
+        assert lay.all()
+
+    def test_fixed_local_windows(self):
+        cfg = FixedSparsityConfig(num_heads=2, block=16,
+                                  num_local_blocks=2, num_global_blocks=1)
+        lay = cfg.make_layout(64)          # 4 blocks, windows of 2
+        assert lay[0, 0, 0] and lay[0, 0, 1]     # own window
+        assert lay[0, 0, 3]                      # global col of window 2
+        assert not lay[0, 0, 2]                  # non-global far block
+
+    def test_fixed_unidirectional_is_lower_triangular(self):
+        cfg = FixedSparsityConfig(num_heads=1, block=16,
+                                  num_local_blocks=2,
+                                  attention="unidirectional")
+        lay = cfg.make_layout(96)
+        assert not np.triu(lay[0], k=1).any()
+
+    def test_longformer_window_and_global(self):
+        cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                         num_sliding_window_blocks=3,
+                                         global_block_indices=[0])
+        lay = cfg.make_layout(96)          # 6 blocks
+        assert lay[0, 3, 2] and lay[0, 3, 3] and lay[0, 3, 4]  # window
+        assert not lay[0, 3, 5]
+        assert lay[0, 0].all()             # global row
+        assert lay[0, :, 0].all()          # global col
+
+    def test_bigbird_has_window_global_random(self):
+        cfg = BigBirdSparsityConfig(num_heads=1, block=16,
+                                    num_random_blocks=1,
+                                    num_sliding_window_blocks=3,
+                                    num_global_blocks=1)
+        lay = cfg.make_layout(128)
+        assert lay[0, :, 0].all() and lay[0, 0].all()
+        for i in range(1, 7):
+            assert lay[0, i, i]            # diagonal in window
+
+    def test_heads_share_layout_by_default(self):
+        lay = BigBirdSparsityConfig(num_heads=4, block=16).make_layout(64)
+        for h in range(1, 4):
+            np.testing.assert_array_equal(lay[h], lay[0])
+
+    def test_block_divisibility_asserted(self):
+        with pytest.raises(AssertionError):
+            FixedSparsityConfig(num_heads=1, block=16).make_layout(40)
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("cfg_cls,kw", [
+        (DenseSparsityConfig, {}),
+        (FixedSparsityConfig, {"num_local_blocks": 2}),
+        (BSLongformerSparsityConfig, {"num_sliding_window_blocks": 3}),
+        (BigBirdSparsityConfig, {"num_random_blocks": 1}),
+    ])
+    def test_matches_dense_under_same_mask(self, cfg_cls, kw):
+        q, k, v = _qkv()
+        cfg = cfg_cls(num_heads=2, block=16, **kw)
+        lay = cfg.make_layout(64)
+        got = block_sparse_attention(q, k, v, lay, 16)
+        ref = dense_with_mask(q, k, v, layout_to_token_mask(lay, 16))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_causal_unidirectional_matches(self):
+        q, k, v = _qkv(seed=1)
+        cfg = FixedSparsityConfig(num_heads=2, block=16,
+                                  num_local_blocks=2,
+                                  attention="unidirectional")
+        lay = cfg.make_layout(64)
+        got = block_sparse_attention(q, k, v, lay, 16, causal=True)
+        ref = dense_with_mask(q, k, v,
+                              layout_to_token_mask(lay, 16, causal=True))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_gradients_flow(self):
+        q, k, v = _qkv(S=32)
+        lay = BSLongformerSparsityConfig(num_heads=2, block=16)\
+            .make_layout(32)
+
+        def loss(q):
+            return jnp.sum(block_sparse_attention(q, k, v, lay, 16) ** 2)
+
+        g = jax.grad(loss)(q)
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.any(np.asarray(g) != 0)
+
+    def test_module_surface(self):
+        q, k, v = _qkv()
+        attn = SparseSelfAttention(FixedSparsityConfig(
+            num_heads=2, block=16, num_local_blocks=2,
+            attention="unidirectional"))
+        out = attn(q, k, v)
+        assert out.shape == q.shape
+        assert np.isfinite(np.asarray(out)).all()

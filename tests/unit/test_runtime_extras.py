@@ -138,3 +138,58 @@ class TestTiledLinear:
         m = TiledLinear(features=10, out_splits=3)
         with pytest.raises(AssertionError):
             m.init(jax.random.PRNGKey(0), jnp.ones((1, 9)))
+
+
+class TestMoQQuantizer:
+    def _q(self, **kw):
+        from deepspeed_tpu.runtime.quantize import Quantizer
+
+        kw.setdefault("q_start_bits", 16)
+        kw.setdefault("q_target_bits", 4)
+        kw.setdefault("q_period", 10)
+        return Quantizer(**kw)
+
+    def test_bit_schedule_halves_per_period(self):
+        q = self._q()
+        assert q.bits_at(0) == 16
+        assert q.bits_at(10) == 8
+        assert q.bits_at(20) == 4
+        assert q.bits_at(1000) == 4
+
+    def test_eigenvalue_stretches_period(self):
+        q = self._q()
+        # sharp layer (ratio 1.0): period x5 -> still 16 bits at step 40
+        assert q.bits_at(40, eigenvalue_ratio=1.0) == 16
+        assert q.bits_at(40, eigenvalue_ratio=None) == 4
+
+    def test_quantize_params_respects_schedule(self):
+        q = self._q()
+        params = {"layer": {"w": jnp.asarray(
+            np.random.default_rng(0).normal(size=(8, 8)), jnp.float32),
+            "b": jnp.ones((8,))}}
+        out1 = q.quantize_params(params)          # step 1: 16 bits, no-op
+        np.testing.assert_array_equal(np.asarray(out1["layer"]["w"]),
+                                      np.asarray(params["layer"]["w"]))
+        for _ in range(15):
+            out = q.quantize_params(params)
+        w = np.asarray(out["layer"]["w"])          # 8-bit grid now
+        assert not np.array_equal(w, np.asarray(params["layer"]["w"]))
+        assert len(np.unique(w)) <= 256
+        # 1-D bias untouched
+        np.testing.assert_array_equal(np.asarray(out["layer"]["b"]), 1.0)
+
+    def test_mixed_fp16_blend_decays(self):
+        q = self._q(q_mixed_fp16=True, q_change_ratio=0.5)
+        assert q.quantize_real_ratio == 1.0
+        q.quantize_params({"w": jnp.ones((4, 4))})
+        assert q.quantize_real_ratio == 0.5
+        q.quantize_params({"w": jnp.ones((4, 4))})
+        assert q.quantize_real_ratio == 0.0
+
+    def test_overflow_skips_without_eigenvalue(self):
+        q = self._q()
+        q.quantize_params({"w": jnp.ones((4, 4))}, overflow=True)
+        assert q.qsteps == 0
+        q2 = self._q(q_eigenvalue=True)
+        q2.quantize_params({"w": jnp.ones((4, 4))}, overflow=True)
+        assert q2.qsteps == 1
