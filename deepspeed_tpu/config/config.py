@@ -503,6 +503,9 @@ _XLA_OWNED_KNOBS = {
         "pin_memory", "buffer_count", "buffer_size", "max_in_cpu",
         "fast_init"),
     "cuda-graph/stream controls": ("graph_harvesting",),
+    "sparse embedding-gradient allreduce (XLA AD emits dense grads; "
+    "sparse scatter-grads don't map to static-shape SPMD)": (
+        "sparse_gradients",),
 }
 
 

@@ -1,0 +1,20 @@
+from deepspeed_tpu.ops.flash_attention import flash_attention
+from deepspeed_tpu.ops.fused_adam import (scale_by_fused_adam,
+                                          scale_by_fused_lion)
+from deepspeed_tpu.ops.quantization import (dequantize, dequantize_fp6,
+                                            dequantize_fp8, quantize,
+                                            quantize_fp6, quantize_fp8)
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                BSLongformerSparsityConfig,
+                                                DenseSparsityConfig,
+                                                FixedSparsityConfig,
+                                                SparseSelfAttention,
+                                                block_sparse_attention)
+
+__all__ = [
+    "flash_attention", "scale_by_fused_adam", "scale_by_fused_lion",
+    "quantize", "dequantize", "quantize_fp8", "dequantize_fp8",
+    "quantize_fp6", "dequantize_fp6", "block_sparse_attention",
+    "SparseSelfAttention", "FixedSparsityConfig", "BigBirdSparsityConfig",
+    "BSLongformerSparsityConfig", "DenseSparsityConfig",
+]
