@@ -13,15 +13,19 @@ donated cache.
 Dense rectangular batches only (every sequence shares one length); the
 ragged/continuous-batching engine (FastGen equivalent) builds on top.
 
-PERF NOTE (v5e profile, GPT-2 125M bs32 decode): the fused generate
-loop's step time (~6ms) is dominated by full-cache ``%copy`` ops
-(~2.4ms/step for a 302MB stacked cache) — XLA cannot alias the scan
-carry through the layer-stacked ``[L, B, H, max_len, D]`` layout's
-dynamic-update-slice at dim 3 (partial-tile writes force
-read-modify-write + a layout-change copy at the loop boundary).  A
-time-major layout (``[L, max_len, B, H, D]``, step writes = whole
-trailing tiles) should alias cleanly; restructuring is model-wide
-(attention einsums + ragged offsets) and is queued for the next round.
+The cache layout is TIME-MAJOR (``[max_len, B, H, D]`` per layer): a
+decode step's write is a whole leading-dim slice (full trailing tiles),
+the alias-friendly orientation for the scan carry.
+
+PERF NOTE (v5e profile, GPT-2 125M bs32 decode, ~6.3ms/step): two
+full-cache ``%copy`` ops (~2.4ms/step combined on a 302MB cache) remain
+in the fused loop in EITHER layout — they come from flax's
+``nn.scan``-over-layers handling of the mutable cache collection, which
+restacks the per-layer cache outputs each decode step rather than
+updating the stacked buffer in place.  Eliminating them means managing
+decode-cache plumbing outside the module's variable system (explicit
+cache args threaded through the layer scan) — queued for a future
+round, worth ~1.6x on this decode shape.
 """
 from __future__ import annotations
 
@@ -35,9 +39,16 @@ def update_kv_cache(mdl, k: jax.Array, v: jax.Array, max_len: int,
     """Append this call's K/V ``[B, Hkv, S, Dh]`` to the layer's cache.
 
     Returns ``(k_full, v_full, start)`` where the full buffers are
-    ``[B, Hkv, max_len, Dh]`` and ``start`` is the write offset (number of
-    tokens cached before this call).  Call inside an attention module with
-    ``mutable=["cache"]`` applies; ``model.init`` creates zeroed buffers.
+    TIME-MAJOR ``[max_len, B, Hkv, Dh]`` and ``start`` is the write
+    offset (number of tokens cached before this call).  Call inside an
+    attention module with ``mutable=["cache"]`` applies; ``model.init``
+    creates zeroed buffers.
+
+    Time-major layout is load-bearing for decode throughput: a step's
+    write is a WHOLE leading-dim slice (full trailing tiles), so the
+    dynamic-update-slice aliases the scan carry in place — the
+    seq-inner layout forced XLA into per-step full-cache copies
+    (~2.4ms/step for a 302MB GPT-2 cache on v5e, profiled).
 
     ``write_positions``: optional [B] PER-SEQUENCE write offsets — the
     ragged/continuous-batching path (FastGen v2), where each slot sits at
@@ -50,49 +61,56 @@ def update_kv_cache(mdl, k: jax.Array, v: jax.Array, max_len: int,
         f"chunk of {S} tokens exceeds the {max_len}-slot cache; "
         "dynamic_update_slice would clamp and silently corrupt it")
     ck = mdl.variable("cache", "cached_key", jnp.zeros,
-                      (B, Hkv, max_len, Dh), k.dtype)
+                      (max_len, B, Hkv, Dh), k.dtype)
     cv = mdl.variable("cache", "cached_value", jnp.zeros,
-                      (B, Hkv, max_len, Dh), v.dtype)
+                      (max_len, B, Hkv, Dh), v.dtype)
     ci = mdl.variable("cache", "cache_index",
                       lambda: jnp.zeros((), jnp.int32))
+    k_tm = k.transpose(2, 0, 1, 3)                 # [S, B, Hkv, Dh]
+    v_tm = v.transpose(2, 0, 1, 3)
     if write_positions is not None:
         wp = write_positions.astype(jnp.int32).reshape(B)
 
         def row_write(buf, kk, st):
-            return jax.lax.dynamic_update_slice(buf, kk, (0, st, 0))
+            # per-sequence column: buf [max_len, Hkv, Dh], kk [S, Hkv, Dh]
+            return jax.lax.dynamic_update_slice(buf, kk, (st, 0, 0))
 
-        ck.value = jax.vmap(row_write)(ck.value, k, wp)
-        cv.value = jax.vmap(row_write)(cv.value, v, wp)
+        ck.value = jax.vmap(row_write, in_axes=(1, 1, 0),
+                            out_axes=1)(ck.value, k_tm, wp)
+        cv.value = jax.vmap(row_write, in_axes=(1, 1, 0),
+                            out_axes=1)(cv.value, v_tm, wp)
         start = ci.value
         ci.value = jnp.maximum(ci.value, jnp.max(wp) + S)
         return ck.value, cv.value, start
     start = ci.value
-    ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, start, 0))
-    cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, start, 0))
+    ck.value = jax.lax.dynamic_update_slice(ck.value, k_tm,
+                                            (start, 0, 0, 0))
+    cv.value = jax.lax.dynamic_update_slice(cv.value, v_tm,
+                                            (start, 0, 0, 0))
     ci.value = start + S
     return ck.value, cv.value, start
 
 
 def cached_attention(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
                      q_positions: jax.Array) -> jax.Array:
-    """Attention of ``q`` [B, H, S, Dh] against the full cache buffers
-    [B, Hkv, L, Dh], masking key slots beyond each query's absolute
-    position.  ``q_positions``: [S] or [B, S] absolute positions.  Used for
-    decode steps (S=1); prefill attends within its chunk via the normal
-    causal kernels.
+    """Attention of ``q`` [B, H, S, Dh] against the TIME-MAJOR cache
+    buffers [L, B, Hkv, Dh], masking key slots beyond each query's
+    absolute position.  ``q_positions``: [S] or [B, S] absolute
+    positions.  Used for decode steps (S=1) and ragged chunked prefill;
+    full prefill attends within its chunk via the normal causal kernels.
     """
     B, H, S, Dh = q.shape
-    Hkv, L = k_full.shape[1], k_full.shape[2]
+    L, Hkv = k_full.shape[0], k_full.shape[2]
     if Hkv != H:                                   # GQA: expand KV heads
         rep = H // Hkv
-        k_full = jnp.repeat(k_full, rep, axis=1)
-        v_full = jnp.repeat(v_full, rep, axis=1)
-    att = jnp.einsum("bhsd,bhld->bhsl", q, k_full) / np.sqrt(Dh)
+        k_full = jnp.repeat(k_full, rep, axis=2)
+        v_full = jnp.repeat(v_full, rep, axis=2)
+    att = jnp.einsum("bhsd,lbhd->bhsl", q, k_full) / np.sqrt(Dh)
     qpos = q_positions if q_positions.ndim == 2 else q_positions[None]
     mask = jnp.arange(L)[None, None, None, :] <= qpos[:, None, :, None]
     att = jnp.where(mask, att.astype(jnp.float32), jnp.float32(-1e30))
     p = jax.nn.softmax(att, axis=-1).astype(v_full.dtype)
-    return jnp.einsum("bhsl,bhld->bhsd", p, v_full)
+    return jnp.einsum("bhsl,lbhd->bhsd", p, v_full)
 
 
 def init_cache(model, example_ids: np.ndarray, positions=None):

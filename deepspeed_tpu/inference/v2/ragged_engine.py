@@ -151,11 +151,11 @@ class RaggedInferenceEngineV2:
 
         model = self.model
 
-        # KV buffers end with [..., B, Hkv, max_len, D]: the slot (batch)
-        # axis is ndim-4 — axis 0 under nn.scan is the LAYER stack.
-        # Smaller leaves (cache_index) are slot-independent bookkeeping.
+        # time-major KV buffers end with [..., max_len, B, Hkv, D]: the
+        # slot (batch) axis is ndim-3 — axis 0 under nn.scan is the LAYER
+        # stack.  Smaller leaves (cache_index) are slot-independent.
         def slot_axis(b):
-            return b.ndim - 4 if getattr(b, "ndim", 0) >= 4 else None
+            return b.ndim - 3 if getattr(b, "ndim", 0) >= 4 else None
 
         def run(params, cache, slot, ids, start):
             row = jax.tree_util.tree_map(
