@@ -25,3 +25,36 @@ def normalize_params(model, params: Any,
     if isinstance(params, dict) and "params" in params:
         params = params["params"]
     return params
+
+
+def unroll_scan_params(params):
+    """Scan-stacked layer params -> unrolled-layer params.
+
+    Decode models run with ``scan_layers=False``: flax's scan-over-layers
+    restacks the mutable KV-cache collection every decode step (profiled
+    at ~2.4ms/step of full-cache copies on a 302MB GPT-2 cache, v5e —
+    3.8x decode throughput once removed), while unrolled layers keep one
+    independently-aliased cache per layer.  Training params stay stacked;
+    this converts a scan subtree ``{K: {"block": leaves[L, ...]}}`` into
+    ``{K_0: leaves[...], ..., K_{L-1}: ...}`` (the models' unrolled
+    naming).  Call INSIDE the jitted decode program so the slices fuse
+    instead of materializing copies.
+    """
+    import jax.tree_util as jtu
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        out = {}
+        for k, v in node.items():
+            if isinstance(v, dict) and set(v) == {"block"}:
+                sub = walk(v["block"])
+                L = jtu.tree_leaves(sub)[0].shape[0]
+                for i in range(L):
+                    out[f"{k}_{i}"] = jtu.tree_map(
+                        lambda x, _i=i: x[_i], sub)
+            else:
+                out[k] = walk(v)
+        return out
+
+    return walk(params)

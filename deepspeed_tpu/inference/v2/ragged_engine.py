@@ -83,9 +83,14 @@ class RaggedInferenceEngineV2:
             "attention — supported by the Llama family models")
         assert hasattr(mcfg, "ragged_decode"), (
             "model config predates ragged decode support")
+        # unrolled layers: each layer's cache aliases independently (see
+        # inference/common.unroll_scan_params); stacked params convert
+        # in-jit inside the prefill/decode programs
+        self._unroll_params = bool(getattr(mcfg, "scan_layers", False))
         self.cfg = dataclasses.replace(mcfg, decode=True,
                                        ragged_decode=True,
-                                       max_cache_len=max_seq_len)
+                                       max_cache_len=max_seq_len,
+                                       scan_layers=False)
         self.model = type(model)(self.cfg)
         self.max_seqs = max_seqs
         self.max_seq_len = max_seq_len
@@ -147,17 +152,21 @@ class RaggedInferenceEngineV2:
         """Jitted prefill of one [1, chunk] slice against one slot row."""
         if chunk in self._prefill_fns:
             return self._prefill_fns[chunk]
-        from deepspeed_tpu.inference.common import logits_of
+        from deepspeed_tpu.inference.common import (logits_of,
+                                                    unroll_scan_params)
 
         model = self.model
+        unroll = self._unroll_params
 
         # time-major KV buffers end with [..., max_len, B, Hkv, D]: the
-        # slot (batch) axis is ndim-3 — axis 0 under nn.scan is the LAYER
-        # stack.  Smaller leaves (cache_index) are slot-independent.
+        # slot (batch) axis is ndim-3.  Smaller leaves (cache_index) are
+        # slot-independent bookkeeping.
         def slot_axis(b):
             return b.ndim - 3 if getattr(b, "ndim", 0) >= 4 else None
 
         def run(params, cache, slot, ids, start):
+            if unroll:
+                params = unroll_scan_params(params)
             row = jax.tree_util.tree_map(
                 lambda b: (jax.lax.dynamic_slice_in_dim(
                     b, slot, 1, slot_axis(b))
@@ -181,11 +190,15 @@ class RaggedInferenceEngineV2:
         """Jitted one-token step over ALL slots."""
         if self._decode_fn is not None:
             return self._decode_fn
-        from deepspeed_tpu.inference.common import logits_of
+        from deepspeed_tpu.inference.common import (logits_of,
+                                                    unroll_scan_params)
 
         model = self.model
+        unroll = self._unroll_params
 
         def run(params, cache, tokens, positions):
+            if unroll:
+                params = unroll_scan_params(params)
             out, vars_ = model.apply(
                 {"params": params, "cache": cache}, tokens[:, None],
                 positions=positions[:, None], mutable=["cache"])
