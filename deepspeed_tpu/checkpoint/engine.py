@@ -67,6 +67,11 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     snap = sharded.save_tree(
         {"module": engine.state.params, "optimizer": engine.state.opt_state},
         path, materialize=bool(async_save))
+    if getattr(engine, "nvme_swapper", None) is not None:
+        # NVMe-swapped moments already live on disk: checkpointing them is
+        # a file copy (reference engine.py:3277 copies offloaded state
+        # alongside)
+        engine.nvme_swapper.save_to(path)
     extra = {
         "loss_scale": jax.device_get(engine.state.scale),
         "step": int(jax.device_get(engine.state.step)),
@@ -151,12 +156,28 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         extra = pickle.load(f)
 
     shardings = engine._state_shardings
+    # cross-mode resume guard: an NVMe-offload run checkpoints opt_state as
+    # an empty tuple (the moments travel as files, see nvme_optimizer/),
+    # so a device-resident engine restoring it must not expect
+    # "optimizer/..." records — warn and keep fresh moments instead of
+    # crashing mid-restore
+    reader = None
+    if load_optimizer_states and \
+            jax.tree_util.tree_leaves(engine.state.opt_state):
+        reader = sharded._Reader(path)
+        if not any(p.startswith("optimizer/") for p in reader.paths()):
+            logger.warning(
+                f"checkpoint {path} holds no optimizer records (saved by "
+                "an NVMe-offload engine?); optimizer state starts fresh")
+            load_optimizer_states = False
+            reader.close()
+            reader = None
     if load_optimizer_states:
         tree = sharded.load_tree(
             {"module": engine.state.params,
              "optimizer": engine.state.opt_state},
             {"module": shardings.params, "optimizer": shardings.opt_state},
-            path)
+            path, reader=reader)
         params, opt_state = tree["module"], tree["optimizer"]
     else:
         params = sharded.load_tree(
@@ -175,6 +196,9 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     engine.global_samples = int(extra.get("global_samples", 0))
     if load_lr_scheduler_states and engine.lr_scheduler is not None:
         engine.lr_scheduler.load_state_dict(extra["lr_scheduler"])
+    if load_optimizer_states and \
+            getattr(engine, "nvme_swapper", None) is not None:
+        engine.nvme_swapper.load_from(path)
     log_dist(f"loaded checkpoint {path} (global_steps="
              f"{engine.global_steps})", ranks=[0])
     return path, extra.get("client_state")

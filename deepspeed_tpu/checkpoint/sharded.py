@@ -253,13 +253,15 @@ class _Reader:
 
 
 def load_tree(template: Any, shardings: Any, path: str,
-              cast: bool = True) -> Any:
+              cast: bool = True, reader: Optional["_Reader"] = None) -> Any:
     """Load a tree saved by :func:`save_tree` onto ``shardings``
     (a matching tree of ``jax.sharding.Sharding``), resharding as needed.
     ``template`` supplies the pytree structure and leaf dtypes (host-side
     dtype cast when the stored dtype differs and ``cast`` is set).
+    ``reader``: reuse an already-open :class:`_Reader` for ``path``
+    (closed on return either way).
     """
-    reader = _Reader(path)
+    reader = reader if reader is not None else _Reader(path)
     flat, treedef = jax.tree_util.tree_flatten_with_path(template)
     shard_flat = jax.tree_util.tree_leaves(
         shardings, is_leaf=lambda s: isinstance(s, jax.sharding.Sharding))

@@ -542,9 +542,9 @@ def warn_unimplemented(cfg: DeepSpeedConfig) -> None:
     if offl_p is not None and offl_p.device == "nvme":
         notes.append("offload_param.device=nvme (device=cpu pinned-host "
                      "offload IS supported)")
-    if offl_o is not None and offl_o.device == "nvme":
-        notes.append("offload_optimizer.device=nvme (device=cpu "
-                     "pinned-host offload IS supported)")
+    # offload_optimizer.device=nvme is implemented (NVMe-swapped Adam
+    # moments, runtime/swap_tensor.py); eligibility beyond the config —
+    # adam-family optimizer, single controller — is checked by the engine.
     if (cfg.zero_optimization.zero_quantized_weights or
             cfg.zero_optimization.zero_quantized_gradients or
             cfg.zero_optimization.zero_quantized_nontrainable_weights):

@@ -1,0 +1,263 @@
+"""NVMe optimizer-state swapping — the ZeRO-Infinity tier.
+
+TPU-native re-design of the reference swap-tensor stack
+(``runtime/swap_tensor/partitioned_optimizer_swapper.py:37``,
+``optimizer_utils.py``, backed by ``csrc/aio``): Adam moments live on
+local SSD/NVMe, not in HBM or host RAM.  Each train step streams them
+through the device leaf-by-leaf:
+
+    read moments(i+1) from NVMe   ─┐ overlapped (native AIO threads)
+    update leaf i on device        ─┘
+    write moments(i) back to NVMe  — async, drained at step end
+
+The reference pipelines bucket reads/writes against CUDA streams
+(``pipelined_optimizer_swapper.py``); here the overlap is host-side —
+the AIO thread pool prefetches the next leaf's moments while XLA runs
+the current leaf's fused update kernel.  HBM and host RAM hold O(largest
+leaf), not O(model): the memory watermark the reference achieves with
+swap buffers falls out of the double-buffered loop.
+
+The optimizer math is the Adam/AdamW family only (the reference swapper
+equally assumes a ``DeepSpeedCPUAdam``-style optimizer whose state is
+two moments per parameter); the engine falls back to device-resident
+state, with a warning, for anything else.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def _float_leaf(x) -> bool:
+    return jnp.issubdtype(np.asarray(x).dtype if not hasattr(x, "dtype")
+                          else x.dtype, jnp.floating)
+
+
+@partial(jax.jit, donate_argnums=(2, 3))
+def _adam_update(p, g, m, v, count, lr, gscale, b1, b2, eps, wd, adam_w):
+    """One leaf's AdamW update (reference ``csrc/adam`` kernel math /
+    ``optax.scale_by_adam`` + decoupled decay).  ``gscale`` folds the
+    1/(loss_scale*gas) unscale and the clip coefficient; ``adam_w``
+    selects decoupled (True) vs L2 (folded into the gradient) decay."""
+    g = g.astype(jnp.float32) * gscale
+    g = jnp.where(adam_w, g, g + wd * p)
+    m = b1 * m + (1.0 - b1) * g
+    v = b2 * v + (1.0 - b2) * jnp.square(g)
+    m_hat = m / (1.0 - b1 ** count)
+    v_hat = v / (1.0 - b2 ** count)
+    u = m_hat / (jnp.sqrt(v_hat) + eps)
+    u = jnp.where(adam_w, u + wd * p, u)
+    p_new = (p - lr * u).astype(p.dtype)
+    return p_new, m, v
+
+
+class NvmeOptimizerSwapper:
+    """Adam moments on NVMe, streamed through the device per step.
+
+    One file per parameter leaf holding ``[m; v]`` contiguously in the
+    master dtype; files are created lazily on the first successful step
+    (zero-init moments never touch the disk).
+    """
+
+    def __init__(self, swap_dir: str, params: Any, *,
+                 betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 adam_w_mode: bool = True,
+                 aio_block_size: int = 1 << 20,
+                 aio_thread_count: int = 8):
+        from deepspeed_tpu.io.aio import aio_handle
+
+        self.swap_dir = os.path.join(swap_dir, "zero_stage_nvme_opt")
+        os.makedirs(self.swap_dir, exist_ok=True)
+        self.handle = aio_handle(block_size=aio_block_size,
+                                 thread_count=aio_thread_count)
+        self.b1, self.b2 = float(betas[0]), float(betas[1])
+        self.eps = float(eps)
+        self.wd = float(weight_decay)
+        self.adam_w_mode = bool(adam_w_mode)
+        self.count = 0                      # successful (non-overflow) steps
+        self._initialized: set = set()      # leaf keys with moments on disk
+        # leaf registry: key -> (file path, shape, np dtype, nbytes)
+        self._meta: Dict[str, Tuple[str, tuple, np.dtype, int]] = {}
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        from deepspeed_tpu.checkpoint.sharded import path_str
+
+        for kp, leaf in flat:
+            if not _float_leaf(leaf):
+                continue
+            key = path_str(kp)
+            # moments are ALWAYS fp32 on disk regardless of the param
+            # (master) dtype — the update math promotes to fp32, and
+            # sizing the layout by a bf16 param dtype would interleave
+            # the m/v byte ranges
+            dt = np.dtype(np.float32)
+            nbytes = int(np.prod(leaf.shape)) * dt.itemsize
+            # hash suffix keeps the name→file map injective ("/"→"__" alone
+            # would collide for module names containing literal "__")
+            digest = hashlib.sha1(key.encode()).hexdigest()[:8]
+            fname = os.path.join(
+                self.swap_dir,
+                f"{key.replace('/', '__')}-{digest}.bin")
+            self._meta[key] = (fname, tuple(leaf.shape), dt, nbytes)
+        total = sum(2 * nb for _, _, _, nb in self._meta.values())
+        log_dist(f"NVMe optimizer swap: {len(self._meta)} leaves, "
+                 f"{total / 1e9:.2f} GB of moments at {self.swap_dir}",
+                 ranks=[0])
+
+    # -- per-step IO ----------------------------------------------------
+
+    def start_read(self, key: str) -> Optional[Tuple[int, int, np.ndarray,
+                                                     np.ndarray]]:
+        """Begin the async moment read for ``key``; None if zero-init."""
+        fname, shape, dt, nbytes = self._meta[key]
+        if key not in self._initialized:
+            return None
+        m = np.empty(shape, dt)
+        v = np.empty(shape, dt)
+        op_m = self.handle.async_pread(m, fname, 0)
+        op_v = self.handle.async_pread(v, fname, nbytes)
+        return op_m, op_v, m, v
+
+    def finish_read(self, key: str, started) -> Tuple[np.ndarray, np.ndarray]:
+        _, shape, dt, _ = self._meta[key]
+        if started is None:
+            z = np.zeros(shape, dt)
+            return z, z.copy()
+        op_m, op_v, m, v = started
+        self.handle.wait(op_m)
+        self.handle.wait(op_v)
+        return m, v
+
+    def write(self, key: str, m: np.ndarray, v: np.ndarray) -> None:
+        fname, _, dt, nbytes = self._meta[key]
+        from deepspeed_tpu.io.aio import _pretruncate
+
+        _pretruncate(fname, 2 * nbytes, exact=False)
+        self._pending = getattr(self, "_pending", [])
+        self._pending.append(self.handle.async_pwrite(
+            np.ascontiguousarray(m, dtype=dt), fname, 0, _truncate=False))
+        self._pending.append(self.handle.async_pwrite(
+            np.ascontiguousarray(v, dtype=dt), fname, nbytes,
+            _truncate=False))
+        self._initialized.add(key)
+
+    def drain(self) -> None:
+        for op in getattr(self, "_pending", []):
+            self.handle.wait(op)
+        self._pending = []
+
+    # -- the step --------------------------------------------------------
+
+    def apply(self, params: Any, grads: Any, *, lr, gscale) -> Any:
+        """Update every float leaf in ``params`` against ``grads``;
+        returns the new params tree.  Moments stream NVMe→HBM→NVMe with
+        the next leaf's read overlapping the current leaf's update."""
+        from deepspeed_tpu.checkpoint.sharded import path_str
+
+        self.count += 1
+        count = jnp.asarray(self.count, jnp.float32)
+        lr = jnp.asarray(lr, jnp.float32)
+        gscale = jnp.asarray(gscale, jnp.float32)
+        flat_p = jax.tree_util.tree_flatten_with_path(params)
+        flat_g = jax.tree_util.tree_flatten(grads)[0]
+        keys = [path_str(kp) for kp, _ in flat_p[0]]
+        leaves = [leaf for _, leaf in flat_p[0]]
+        todo = [i for i, leaf in enumerate(leaves) if _float_leaf(leaf)]
+
+        started = {}
+        if todo:
+            i0 = todo[0]
+            started[i0] = self.start_read(keys[i0])
+        new_leaves = list(leaves)
+        for pos, i in enumerate(todo):
+            if pos + 1 < len(todo):                     # prefetch next leaf
+                nxt = todo[pos + 1]
+                started[nxt] = self.start_read(keys[nxt])
+            m, v = self.finish_read(keys[i], started.pop(i))
+            p, g = leaves[i], flat_g[i]
+            m_dev = jax.device_put(m, p.sharding if hasattr(p, "sharding")
+                                   else None)
+            v_dev = jax.device_put(v, p.sharding if hasattr(p, "sharding")
+                                   else None)
+            p_new, m_new, v_new = _adam_update(
+                p, g, m_dev, v_dev, count, lr, gscale,
+                self.b1, self.b2, self.eps, self.wd, self.adam_w_mode)
+            if hasattr(p, "sharding"):
+                # keep the param's placement (incl. pinned_host when
+                # offload_param=cpu composes with the NVMe tier) — the jit
+                # output lands in default device memory otherwise
+                p_new = jax.device_put(p_new, p.sharding)
+            new_leaves[i] = p_new
+            self.write(keys[i], np.asarray(jax.device_get(m_new)),
+                       np.asarray(jax.device_get(v_new)))
+        self.drain()
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(params), new_leaves)
+
+    # -- checkpoint integration ------------------------------------------
+
+    def save_to(self, ckpt_dir: str) -> None:
+        """Copy the moment files into ``ckpt_dir`` (they already live on
+        disk — checkpointing the swapped state is a file copy, the same
+        trick the reference plays when NVMe-offloaded state is checkpointed
+        alongside, ``engine.py:3277``)."""
+        import shutil
+
+        out = os.path.join(ckpt_dir, "nvme_optimizer")
+        os.makedirs(out, exist_ok=True)
+        self.drain()
+        for key in self._initialized:
+            fname = self._meta[key][0]
+            shutil.copy2(fname, os.path.join(out, os.path.basename(fname)))
+        with open(os.path.join(out, "swap_meta.json"), "w") as f:
+            import json
+
+            json.dump({"count": self.count,
+                       "initialized": sorted(self._initialized),
+                       "adam_w_mode": self.adam_w_mode,
+                       "betas": [self.b1, self.b2], "eps": self.eps,
+                       "weight_decay": self.wd}, f)
+
+    def load_from(self, ckpt_dir: str) -> bool:
+        """Restore moment files saved by :meth:`save_to`; False when the
+        checkpoint holds no swapped state (fresh moments)."""
+        import json
+        import shutil
+
+        src = os.path.join(ckpt_dir, "nvme_optimizer")
+        meta_f = os.path.join(src, "swap_meta.json")
+        if not os.path.exists(meta_f):
+            logger.warning("checkpoint has no NVMe-swapped optimizer state; "
+                           "moments start fresh")
+            return False
+        with open(meta_f) as f:
+            meta = json.load(f)
+        saved = (tuple(meta.get("betas", (self.b1, self.b2))),
+                 meta.get("eps", self.eps),
+                 meta.get("weight_decay", self.wd),
+                 meta.get("adam_w_mode", self.adam_w_mode))
+        live = ((self.b1, self.b2), self.eps, self.wd, self.adam_w_mode)
+        if saved != live:
+            logger.warning(
+                f"NVMe-swapped moments were produced with (betas, eps, wd, "
+                f"adam_w_mode)={saved} but the live optimizer uses {live}; "
+                "resuming applies the NEW coefficients to the old moments")
+        self.count = int(meta["count"])
+        self._initialized = set()
+        for key in meta["initialized"]:
+            if key not in self._meta:
+                logger.warning(f"swapped state for unknown param {key!r} "
+                               "ignored")
+                continue
+            fname = self._meta[key][0]
+            shutil.copy2(os.path.join(src, os.path.basename(fname)), fname)
+            self._initialized.add(key)
+        return True

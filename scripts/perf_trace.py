@@ -62,10 +62,11 @@ def run_variant(spec: str) -> None:
     preset = kv.get("preset", "gpt2-125m")
     zero = int(kv.get("zero", 0))
     opt = kv.get("opt", "AdamW")
+    scan = bool(int(kv.get("scan", 1)))
 
     cfg_model = get_config(preset, n_positions=seq, dtype=jnp.bfloat16,
                            remat=remat != "none", remat_policy=remat,
-                           scan_layers=True, use_flash_attention=flash)
+                           scan_layers=scan, use_flash_attention=flash)
     topo = dist.initialize_mesh()
     dp = topo.zero_partition_count()
     ds_config = {
