@@ -1,3 +1,19 @@
 from deepspeed_tpu.io.aio import AsyncIOBuilder, aio_handle
 
-__all__ = ["AsyncIOBuilder", "aio_handle"]
+
+def io_sweep(*args, **kwargs):
+    """See :func:`deepspeed_tpu.io.bench.sweep` (lazy import keeps
+    ``python -m deepspeed_tpu.io.bench`` runpy-clean)."""
+    from deepspeed_tpu.io.bench import sweep
+
+    return sweep(*args, **kwargs)
+
+
+def io_tune(*args, **kwargs):
+    """See :func:`deepspeed_tpu.io.bench.tune`."""
+    from deepspeed_tpu.io.bench import tune
+
+    return tune(*args, **kwargs)
+
+
+__all__ = ["AsyncIOBuilder", "aio_handle", "io_sweep", "io_tune"]

@@ -1,0 +1,168 @@
+"""``ds_io`` / ``ds_nvme_tune`` equivalent: benchmark + auto-tune the
+native async-IO engine.
+
+The reference ships a sweep harness for its AIO kernels
+(``deepspeed/nvme/perf_run_sweep.py``, ``ds_aio_handle.py``, CLIs
+``ds_io`` / ``ds_nvme_tune``) that searches (block_size, queue_depth,
+io_parallel) for the storage device backing offload/checkpoint traffic.
+Same idea here, sized to the TPU runtime's AIO engine (``io/aio.py``):
+sweep (block_size, thread_count), measure sync read/write GB/s against a
+target directory, and report the best configuration — the values to put
+in ``aio_block_size`` / ``aio_thread_count`` knobs (NVMe optimizer swap,
+checkpoint writer).
+
+CLI::
+
+    python -m deepspeed_tpu.io.bench --dir /mnt/nvme --size-mb 256
+    python -m deepspeed_tpu.io.bench --dir /mnt/nvme --tune
+
+Each line of output is one sweep point; ``--tune`` ends with a JSON line
+of the winning config (machine-readable, like the reference's generated
+aio param).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+DEFAULT_BLOCK_SIZES = [1 << 20, 8 << 20]          # 1M, 8M
+DEFAULT_THREAD_COUNTS = [1, 4, 8, 16]
+
+
+def _sync_and_evict(path: str) -> None:
+    """fsync + best-effort page-cache eviction so the subsequent read hits
+    the device rather than memory (the reference drops the cache via
+    /proc/sys/vm — needs root; POSIX_FADV_DONTNEED is the portable part)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+            if hasattr(os, "posix_fadvise"):
+                os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+
+
+def bench_point(directory: str, size_bytes: int, block_size: int,
+                thread_count: int, loops: int = 3
+                ) -> Tuple[float, float]:
+    """(read_gbps, write_gbps) for one (block_size, thread_count) point.
+
+    Write timing includes the fsync (device flush), and the page cache is
+    evicted (best effort) before each read so both directions measure
+    storage, not memory.  Residual cache effects remain possible on
+    filesystems where fadvise is a no-op — run with a ``--size-mb`` well
+    above RAM for authoritative device numbers, as with the reference's
+    ``ds_io``.
+    """
+    from deepspeed_tpu.io.aio import aio_handle
+
+    if loops < 1:
+        raise ValueError(f"loops must be >= 1, got {loops}")
+    h = aio_handle(block_size=block_size, thread_count=thread_count)
+    path = os.path.join(directory, f"dstpu_io_bench_{os.getpid()}.bin")
+    buf = np.random.default_rng(0).integers(
+        0, 255, size_bytes, dtype=np.uint8)
+    rbuf = np.empty(size_bytes, np.uint8)
+    try:
+        wt = rt = 0.0
+        for _ in range(loops):
+            t0 = time.perf_counter()
+            h.sync_pwrite(buf, path)
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+            wt += time.perf_counter() - t0
+            _sync_and_evict(path)
+            t0 = time.perf_counter()
+            h.sync_pread(rbuf, path)
+            rt += time.perf_counter() - t0
+        assert rbuf[:4096].tobytes() == buf[:4096].tobytes(), \
+            "read-back mismatch"
+        gb = size_bytes * loops / 1e9
+        return gb / rt, gb / wt
+    finally:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+def sweep(directory: str, size_bytes: int,
+          block_sizes: Optional[List[int]] = None,
+          thread_counts: Optional[List[int]] = None,
+          loops: int = 3, verbose: bool = True) -> List[Dict]:
+    """Full sweep; returns one record per point, best-read-GB/s first."""
+    results = []
+    for bs in (block_sizes or DEFAULT_BLOCK_SIZES):
+        for tc in (thread_counts or DEFAULT_THREAD_COUNTS):
+            read_gbps, write_gbps = bench_point(
+                directory, size_bytes, bs, tc, loops=loops)
+            rec = {"block_size": bs, "thread_count": tc,
+                   "read_gbps": read_gbps, "write_gbps": write_gbps}
+            results.append(rec)
+            if verbose:
+                print(f"block={bs >> 20}M threads={tc:<3d} "
+                      f"read={read_gbps:6.2f} GB/s "
+                      f"write={write_gbps:6.2f} GB/s", flush=True)
+    return sorted(results, key=lambda r: -(r["read_gbps"] +
+                                           r["write_gbps"]))
+
+
+def tune(directory: str, size_bytes: int = 256 << 20,
+         block_sizes: Optional[List[int]] = None,
+         thread_counts: Optional[List[int]] = None,
+         loops: int = 3, verbose: bool = True) -> Dict:
+    """``ds_nvme_tune`` equivalent: run the sweep, return the winning
+    config (put its values in ``aio_block_size``/``aio_thread_count``)."""
+    results = sweep(directory, size_bytes, block_sizes=block_sizes,
+                    thread_counts=thread_counts, loops=loops,
+                    verbose=verbose)
+    best = dict(results[0])
+    best["config"] = {"aio_block_size": best["block_size"],
+                      "aio_thread_count": best["thread_count"]}
+    return best
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        description="benchmark / tune the native async-IO engine")
+    p.add_argument("--dir", default="/tmp", help="target directory "
+                   "(point at the NVMe mount you plan to offload to)")
+    p.add_argument("--size-mb", type=int, default=256,
+                   help="file size per point")
+    def _positive(v):
+        v = int(v)
+        if v < 1:
+            raise argparse.ArgumentTypeError("must be >= 1")
+        return v
+
+    p.add_argument("--loops", type=_positive, default=3)
+    p.add_argument("--block-sizes", type=int, nargs="*",
+                   help="block sizes in bytes")
+    p.add_argument("--threads", type=int, nargs="*",
+                   help="thread counts")
+    p.add_argument("--tune", action="store_true",
+                   help="print the winning config as a JSON line")
+    args = p.parse_args(argv)
+    size = args.size_mb << 20
+    if args.tune:
+        best = tune(args.dir, size, block_sizes=args.block_sizes,
+                    thread_counts=args.threads, loops=args.loops)
+        print(json.dumps(best))
+    else:
+        sweep(args.dir, size, block_sizes=args.block_sizes,
+              thread_counts=args.threads, loops=args.loops)
+
+
+if __name__ == "__main__":
+    main()

@@ -95,3 +95,17 @@ class TestAsync:
         before = handle.bytes_written()
         handle.sync_pwrite(_rand(2048), str(tmp_path / "st.bin"))
         assert handle.bytes_written() - before == 2048
+
+
+def test_io_bench_sweep_and_tune(tmp_path):
+    """ds_io/ds_nvme_tune equivalent: sweep runs, tune returns a usable
+    config (reference deepspeed/nvme/perf_run_sweep.py)."""
+    from deepspeed_tpu.io.bench import sweep, tune
+
+    results = sweep(str(tmp_path), 1 << 20, block_sizes=[1 << 18],
+                    thread_counts=[1, 2], loops=1, verbose=False)
+    assert len(results) == 2
+    assert all(r["read_gbps"] > 0 and r["write_gbps"] > 0 for r in results)
+    best = tune(str(tmp_path), 1 << 20, loops=1, verbose=False)
+    assert best["config"]["aio_thread_count"] in (1, 4, 8, 16)
+    assert best["config"]["aio_block_size"] >= 1 << 20
