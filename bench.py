@@ -204,7 +204,11 @@ def bench_gpt2_zero2_fused(args) -> None:
                                            flops_per_token, get_config)
 
     on_tpu = not args.smoke
-    size = args.size or ("gpt2-1.3b" if on_tpu else "gpt2-125m")
+    # 1.3B needs ~18GB of state (bf16 params + fp32 master + moments):
+    # ZeRO-2 shards the optimizer over dp, so >=4 chips fit it; a single
+    # chip benches the 760M shape (measured: 1.3B OOMs at 23.3G/15.75G)
+    default_size = "gpt2-1.3b" if len(jax.devices()) >= 4 else "gpt2-760m"
+    size = args.size or (default_size if on_tpu else "gpt2-125m")
     if on_tpu:
         cfg = get_config(size, n_positions=1024, dtype=jnp.bfloat16,
                          remat=True, remat_policy="dots_saveable",
@@ -248,7 +252,11 @@ def bench_llama_zero3(args) -> None:
                                             flops_per_token, get_config)
 
     on_tpu = not args.smoke
-    size = args.size or ("llama2-7b" if on_tpu else "tinyllama")
+    # the 7B target (BASELINE.md config 3) needs >=8 chips for its ~98GB
+    # of bf16 params + fp32 master state; a single chip benches the
+    # TinyLlama-1.1B shape instead
+    default_size = "llama2-7b" if len(jax.devices()) >= 8 else "llama-1b"
+    size = args.size or (default_size if on_tpu else "tinyllama")
     if on_tpu:
         cfg = get_config(size, max_position_embeddings=2048,
                          dtype=jnp.bfloat16, remat=True,
@@ -261,10 +269,15 @@ def bench_llama_zero3(args) -> None:
 
     topo = dist.initialize_mesh()
     dp = topo.zero_partition_count()
+    # single chip: ZeRO-3 shards nothing, so the fp32 master+moments of
+    # the 1.1B model exceed HBM (measured 17.6G/15.75G) — run the
+    # documented pure-bf16 mode there (moments in bf16, no fp32 master);
+    # >=8 chips run the reference-style bf16-compute/fp32-state scheme
+    pure_bf16 = on_tpu and dp < 8
     ds = {
         "train_batch_size": micro * dp,
         "train_micro_batch_size_per_gpu": micro,
-        "bf16": {"enabled": on_tpu},
+        "bf16": {"enabled": on_tpu, "master_weights": not pure_bf16},
         "zero_optimization": {"stage": 3,
                               "stage3_param_persistence_threshold": 10000},
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
@@ -280,7 +293,7 @@ def bench_llama_zero3(args) -> None:
         flops_per_tok=flops_per_token(cfg, seq),
         metric=f"{size.replace('-', '_')}_zero3_train_mfu",
         extra_detail={"params": count_params(engine.state.params),
-                      "zero_stage": 3})
+                      "zero_stage": 3, "pure_bf16": pure_bf16})
 
 
 def bench_ulysses_longctx(args) -> None:
@@ -294,13 +307,23 @@ def bench_ulysses_longctx(args) -> None:
     n_dev = len(jax.devices())
     sp = n_dev  # whole mesh on the sequence axis
     if on_tpu:
-        size = args.size or "llama2-7b"
-        seq = 32768
+        # single chip: a ~500M shape + full remat — the 1.1B model's
+        # bf16 state + fp32 grads + fp32 CE temporaries exhaust HBM at
+        # runtime even with full remat (measured)
+        single = n_dev < 8
+        size = args.size or ("llama2-7b" if not single else "llama-1b")
+        seq = 32768 if not single else 8192
+        shrink = dict(hidden_size=1536, intermediate_size=4096,
+                      num_hidden_layers=16, num_attention_heads=12,
+                      num_key_value_heads=4) \
+            if single and args.size is None else {}
         cfg = get_config(size, max_position_embeddings=seq,
                          dtype=jnp.bfloat16, remat=True,
-                         remat_policy="dots_saveable", scan_layers=True,
+                         remat_policy="full" if single else "dots_saveable",
+                         scan_layers=True,
                          use_flash_attention=True,
-                         sequence_parallel="ulysses" if sp > 1 else "none")
+                         sequence_parallel="ulysses" if sp > 1 else "none",
+                         **shrink)
         micro, steps = 1, max(args.steps // 2, 3)
     else:
         size = args.size or "tinyllama"
@@ -311,10 +334,11 @@ def bench_ulysses_longctx(args) -> None:
         micro, steps = 1, 3
 
     topo = dist.initialize_mesh(sp=sp) if sp > 1 else dist.initialize_mesh()
+    pure_bf16 = on_tpu and n_dev < 8    # see bench_llama_zero3
     ds = {
         "train_batch_size": micro,
         "train_micro_batch_size_per_gpu": micro,
-        "bf16": {"enabled": on_tpu},
+        "bf16": {"enabled": on_tpu, "master_weights": not pure_bf16},
         "zero_optimization": {"stage": 1 if sp > 1 else 0},
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
         "steps_per_print": 1000000,
@@ -343,19 +367,24 @@ def bench_moe_ep(args) -> None:
     on_tpu = not args.smoke
     n_dev = len(jax.devices())
     if on_tpu:
-        # single-chip-sized mixtral (~1B total, ~0.4B active)
-        cfg = get_config("tinymixtral", vocab_size=32000, hidden_size=1024,
-                         intermediate_size=3584, num_hidden_layers=12,
-                         num_attention_heads=16, num_key_value_heads=8,
+        # sized to the mesh: ~1B total on >=4 chips, ~0.65B on one chip
+        # (bf16 state + fp32 grads of the 1B shape exhaust one chip's HBM)
+        dims = (dict(hidden_size=1024, intermediate_size=3584,
+                     num_attention_heads=16, num_key_value_heads=8)
+                if n_dev >= 4 else
+                dict(hidden_size=768, intermediate_size=2688,
+                     num_attention_heads=12, num_key_value_heads=4))
+        cfg = get_config("tinymixtral", vocab_size=32000,
+                         num_hidden_layers=12,
                          num_local_experts=8, num_experts_per_tok=2,
                          max_position_embeddings=1024,
                          dtype=jnp.bfloat16, remat=True,
                          remat_policy="dots_saveable", scan_layers=True,
-                         use_flash_attention=True) \
+                         use_flash_attention=True, **dims) \
             if args.size is None else get_config(
                 args.size, dtype=jnp.bfloat16, remat=True,
                 scan_layers=True, use_flash_attention=True)
-        micro, seq, steps = 4, 1024, args.steps
+        micro, seq, steps = (4 if n_dev >= 4 else 2), 1024, args.steps
     else:
         cfg = get_config("tinymixtral", dtype=jnp.float32, remat=False)
         micro, seq, steps = 2, 32, 3
@@ -364,10 +393,11 @@ def bench_moe_ep(args) -> None:
     topo = dist.initialize_mesh(dp=n_dev // ep, ep=ep) if ep > 1 \
         else dist.initialize_mesh()
     dp = topo.zero_partition_count()
+    pure_bf16 = on_tpu and n_dev < 4    # see bench_llama_zero3
     ds = {
         "train_batch_size": micro * max(dp, 1),
         "train_micro_batch_size_per_gpu": micro,
-        "bf16": {"enabled": on_tpu},
+        "bf16": {"enabled": on_tpu, "master_weights": not pure_bf16},
         "zero_optimization": {"stage": 2},
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
         "steps_per_print": 1000000,

@@ -165,7 +165,13 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     if load_optimizer_states and \
             jax.tree_util.tree_leaves(engine.state.opt_state):
         reader = sharded._Reader(path)
-        if not any(p.startswith("optimizer/") for p in reader.paths()):
+        try:
+            has_opt = any(p.startswith("optimizer/")
+                          for p in reader.paths())
+        except Exception:
+            reader.close()
+            raise
+        if not has_opt:
             logger.warning(
                 f"checkpoint {path} holds no optimizer records (saved by "
                 "an NVMe-offload engine?); optimizer state starts fresh")

@@ -262,33 +262,35 @@ def load_tree(template: Any, shardings: Any, path: str,
     (closed on return either way).
     """
     reader = reader if reader is not None else _Reader(path)
-    flat, treedef = jax.tree_util.tree_flatten_with_path(template)
-    shard_flat = jax.tree_util.tree_leaves(
-        shardings, is_leaf=lambda s: isinstance(s, jax.sharding.Sharding))
-    assert len(flat) == len(shard_flat), (
-        f"template has {len(flat)} leaves, shardings {len(shard_flat)}")
-    out = []
-    for (kp, leaf), sharding in zip(flat, shard_flat):
-        key = path_str(kp)
-        shape = tuple(np.shape(leaf))
-        # dtype without any D2H transfer (template leaves may span
-        # non-addressable devices on multi-host meshes)
-        want_dtype = (np.dtype(getattr(leaf, "dtype", None) or
-                               np.result_type(leaf)) if cast else None)
+    try:
+        flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+        shard_flat = jax.tree_util.tree_leaves(
+            shardings, is_leaf=lambda s: isinstance(s, jax.sharding.Sharding))
+        assert len(flat) == len(shard_flat), (
+            f"template has {len(flat)} leaves, shardings {len(shard_flat)}")
+        out = []
+        for (kp, leaf), sharding in zip(flat, shard_flat):
+            key = path_str(kp)
+            shape = tuple(np.shape(leaf))
+            # dtype without any D2H transfer (template leaves may span
+            # non-addressable devices on multi-host meshes)
+            want_dtype = (np.dtype(getattr(leaf, "dtype", None) or
+                                   np.result_type(leaf)) if cast else None)
 
-        def cb(index, key=key, want_dtype=want_dtype):
-            arr = reader.read_slice(key, index)
-            if want_dtype is not None and arr.dtype != want_dtype:
-                arr = arr.astype(want_dtype)
-            return arr
+            def cb(index, key=key, want_dtype=want_dtype):
+                arr = reader.read_slice(key, index)
+                if want_dtype is not None and arr.dtype != want_dtype:
+                    arr = arr.astype(want_dtype)
+                return arr
 
-        saved_shape, _ = reader.meta(key)
-        if saved_shape != shape:
-            raise ValueError(
-                f"{key!r}: checkpoint shape {saved_shape} != model shape "
-                f"{shape} (different model config?)")
-        out.append(jax.make_array_from_callback(shape, sharding, cb))
-    reader.close()
+            saved_shape, _ = reader.meta(key)
+            if saved_shape != shape:
+                raise ValueError(
+                    f"{key!r}: checkpoint shape {saved_shape} != model "
+                    f"shape {shape} (different model config?)")
+            out.append(jax.make_array_from_callback(shape, sharding, cb))
+    finally:
+        reader.close()
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

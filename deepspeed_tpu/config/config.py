@@ -244,6 +244,20 @@ class CheckpointConfig(ConfigModel):
     async_save: bool = False
 
 
+class AioConfig(ConfigModel):
+    """``aio`` subtree (reference ``deepspeed/runtime/swap_tensor/
+    aio_config.py``): tuning knobs for the native async-IO engine.
+    ``python -m deepspeed_tpu.io.bench --tune`` reports the best values
+    for the target mount.  queue_depth/single_submit/overlap_events are
+    libaio-era knobs accepted for config compatibility; the thread-pooled
+    engine uses block_size and thread_count."""
+    block_size: int = 1 << 20
+    queue_depth: int = 128
+    thread_count: int = 8
+    single_submit: bool = False
+    overlap_events: bool = True
+
+
 class DataTypesConfig(ConfigModel):
     grad_accum_dtype: Optional[str] = None
 
@@ -342,6 +356,7 @@ class DeepSpeedConfig(ConfigModel):
     wandb: Optional[WandbConfig] = None
     csv_monitor: Optional[CSVConfig] = None
     checkpoint: CheckpointConfig = Field(default_factory=CheckpointConfig)
+    aio: AioConfig = Field(default_factory=AioConfig)
     data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
     compression_training: CompressionConfig = Field(default_factory=CompressionConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)

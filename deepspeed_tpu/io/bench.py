@@ -101,7 +101,9 @@ def sweep(directory: str, size_bytes: int,
           block_sizes: Optional[List[int]] = None,
           thread_counts: Optional[List[int]] = None,
           loops: int = 3, verbose: bool = True) -> List[Dict]:
-    """Full sweep; returns one record per point, best-read-GB/s first."""
+    """Full sweep; one record per point, best combined read+write GB/s
+    first (the swap workload is symmetric: every step reads AND writes
+    the full moment set)."""
     results = []
     for bs in (block_sizes or DEFAULT_BLOCK_SIZES):
         for tc in (thread_counts or DEFAULT_THREAD_COUNTS):

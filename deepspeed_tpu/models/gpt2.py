@@ -174,7 +174,11 @@ def remat_policy_fn(name: str):
     """Map a policy name to a jax.checkpoint policy (None = save nothing)."""
     policies = {
         "full": None,
+        # "dots" is the short form of dots_with_no_batch_dims_saveable;
+        # "dots_saveable" (the reference config name) additionally saves
+        # batch-dim dots
         "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
         "checkpoint_dots": jax.checkpoint_policies.checkpoint_dots,
         "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
     }

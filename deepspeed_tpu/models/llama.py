@@ -85,6 +85,10 @@ PRESETS = {
                       intermediate_size=14336, num_hidden_layers=32,
                       num_attention_heads=32, num_key_value_heads=8,
                       rope_theta=500000.0, max_position_embeddings=8192),
+    # TinyLlama-1.1B shape: the single-chip stand-in for the 7B bench
+    "llama-1b": dict(hidden_size=2048, intermediate_size=5632,
+                     num_hidden_layers=22, num_attention_heads=32,
+                     num_key_value_heads=4),
     "tinyllama": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
                       num_hidden_layers=2, num_attention_heads=4,
                       num_key_value_heads=2, max_position_embeddings=64),

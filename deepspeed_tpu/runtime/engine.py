@@ -352,7 +352,9 @@ class DeepSpeedEngine:
                 # optax path this replaces always uses decoupled decay
                 # (optimizers.py documented divergence) and toggling the
                 # NVMe tier must not change the math
-                adam_w_mode=bool(p_cfg.get("adam_w_mode", True)))
+                adam_w_mode=bool(p_cfg.get("adam_w_mode", True)),
+                aio_block_size=config.aio.block_size,
+                aio_thread_count=config.aio.thread_count)
             opt_state, opt_shardings, opt_specs = (), (), None
         elif self._onebit_axes is not None:
             opt_state, opt_shardings = self._init_onebit_opt_state(params)
@@ -571,7 +573,7 @@ class DeepSpeedEngine:
             return model
         # config policy names -> (model remat_policy, remat on?)
         mapping = {"nothing_saveable": ("full", True),
-                   "dots_saveable": ("dots", True),
+                   "dots_saveable": ("dots_saveable", True),
                    "everything_saveable": ("none", False)}
         if acfg.policy not in mapping:
             raise ValueError(
@@ -1191,6 +1193,23 @@ class DeepSpeedEngine:
         from deepspeed_tpu.profiling import FlopsProfiler
 
         fp = self.config.flops_profiler
+        if self._train_step_fn is None:
+            # NVMe-offloaded step: no single fused program — cost the
+            # fwd+bwd micro step (the dominant FLOPs; the optimizer apply
+            # is a host-side leaf loop with no jaxpr)
+            assert self._grad_step_fn is not None
+            mb = jax.tree_util.tree_map(lambda x: x[0], gbatch)
+            prof = FlopsProfiler(self._grad_step_fn, ds_engine=self)
+            prof.start_profile()
+            prof.profile(self.state, mb, self.state.rng,
+                         params=self.state.params)
+            prof.print_model_profile(profile_step=fp.profile_step,
+                                     module_depth=fp.module_depth,
+                                     top_modules=fp.top_modules,
+                                     detailed=fp.detailed,
+                                     output_file=fp.output_file)
+            prof.end_profile()
+            return
         prof = FlopsProfiler(self._train_step_fn, ds_engine=self)
         prof.start_profile()
         # duration: the step jit donates the state, so it cannot be re-run

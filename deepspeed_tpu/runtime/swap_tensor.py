@@ -75,7 +75,11 @@ class NvmeOptimizerSwapper:
                  aio_thread_count: int = 8):
         from deepspeed_tpu.io.aio import aio_handle
 
-        self.swap_dir = os.path.join(swap_dir, "zero_stage_nvme_opt")
+        # pid-scoped: two jobs pointing at the same NVMe mount must not
+        # interleave moment files (swap state is transient — a resumed run
+        # re-seeds its fresh dir from the checkpoint's nvme_optimizer/)
+        self.swap_dir = os.path.join(
+            swap_dir, f"zero_stage_nvme_opt.{os.getpid()}")
         os.makedirs(self.swap_dir, exist_ok=True)
         self.handle = aio_handle(block_size=aio_block_size,
                                  thread_count=aio_thread_count)

@@ -295,7 +295,7 @@ def test_activation_checkpointing_config_drives_remat(devices):
         topology=topo, example_batch=random_tokens(8),
         rng=jax.random.PRNGKey(0))[0]
     assert engine.module.config.remat is True
-    assert engine.module.config.remat_policy == "dots"
+    assert engine.module.config.remat_policy == "dots_saveable"
     assert np.isfinite(float(engine.train_batch(batch=random_tokens(16))))
 
 

@@ -272,3 +272,18 @@ def test_nvme_checkpoint_into_device_engine_warns(tmp_path, devices, caplog):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
                                       err_msg=str(kp))
     b.train_batch(batch=random_tokens(8, seed=1))
+
+
+def test_nvme_flops_profiler_fwd_bwd_only(tmp_path, capsys, devices):
+    """flops_profiler under NVMe offload profiles the fwd+bwd micro step
+    instead of crashing on the missing fused program."""
+    cfg = _nvme_cfg(tmp_path)
+    cfg["flops_profiler"] = {"enabled": True, "profile_step": 1,
+                             "top_modules": 2}
+    topo = dist.initialize_mesh(dp=8)
+    eng, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=cfg, topology=topo,
+        example_batch=random_tokens(8), rng=jax.random.PRNGKey(0))
+    eng.train_batch(batch=random_tokens(8))
+    out = capsys.readouterr().out
+    assert "flops" in out.lower()
