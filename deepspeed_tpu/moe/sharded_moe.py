@@ -26,6 +26,14 @@ class GatingResult(NamedTuple):
     combine: jax.Array        # [G, E, C] float combine weights
     dispatch: jax.Array       # [G, E, C] bool dispatch mask
     exp_counts: jax.Array     # [E] tokens routed per expert (pre-drop)
+    # sparse routing view (the gather/scatter dispatch path; under jit the
+    # dense combine/dispatch tensors are dead-code-eliminated when only
+    # these are consumed): per choice k and token g —
+    experts: jax.Array        # [k, G] int32 selected expert
+    positions: jax.Array      # [k, G] int32 slot within the expert buffer
+    weights: jax.Array        # [k, G] f32 renormalized combine weight
+    #                           (0 for capacity-dropped choices)
+    # (capacity C is static — recover it as combine.shape[-1])
 
 
 def capacity(num_tokens: int, num_experts: int, capacity_factor: float,
@@ -57,12 +65,13 @@ def topkgating(logits: jax.Array, k: int = 1,
             noise_rng, select_from.shape, minval=1.0 - noise_eps,
             maxval=1.0 + noise_eps)
 
-    masks = []
+    masks, indices = [], []
     remaining = select_from
     for _ in range(k):
         idx = jnp.argmax(remaining, axis=-1)
         mask = jax.nn.one_hot(idx, E, dtype=jnp.float32)
         masks.append(mask)
+        indices.append(idx.astype(jnp.int32))
         remaining = jnp.where(mask > 0, -jnp.inf, remaining)
 
     # aux loss: fraction of tokens * fraction of router prob per expert
@@ -96,14 +105,19 @@ def topkgating(logits: jax.Array, k: int = 1,
     denom = jnp.maximum(denom, jnp.finfo(jnp.float32).eps)
 
     combine = jnp.zeros((G, E, C), jnp.float32)
+    weights_k = []
     for mask, g, pos, keep in zip(masks, gate_k, positions, keeps):
         w = g * keep / denom                                      # [G]
+        weights_k.append(w)
         combine = combine + (w[:, None, None] * mask[:, :, None] *
                              jax.nn.one_hot(pos, C, dtype=jnp.float32
                                             )[:, None, :])
     dispatch = combine > 0
     return GatingResult(l_aux=l_aux, combine=combine, dispatch=dispatch,
-                        exp_counts=exp_counts)
+                        exp_counts=exp_counts,
+                        experts=jnp.stack(indices),
+                        positions=jnp.stack(positions),
+                        weights=jnp.stack(weights_k))
 
 
 def top1gating(logits, capacity_factor: float = 1.0, min_capacity: int = 4,
@@ -129,3 +143,45 @@ def moe_combine(expert_out: jax.Array, combine: jax.Array) -> jax.Array:
     ``einsum("sec,ecm->sm")``)."""
     return jnp.einsum("gec,ecm->gm", combine.astype(expert_out.dtype),
                       expert_out)
+
+
+def _dest_slots(gr: GatingResult, num_experts: int, cap: int) -> jax.Array:
+    """[k, G] flat destination slot per routed copy; capacity-dropped
+    copies point one past the end (scatter mode='drop' discards them)."""
+    dest = gr.experts * cap + gr.positions
+    return jnp.where(gr.weights > 0, dest, num_experts * cap)
+
+
+def moe_dispatch_gather(x: jax.Array, gr: GatingResult,
+                        num_experts: int) -> jax.Array:
+    """[G, M] tokens -> [E, C, M] expert buffers by row scatter.
+
+    Same result as :func:`moe_dispatch` with ~1% of the FLOPs, but NOTE:
+    measured on TPU v5e the scatter lowering is ~20x SLOWER than the
+    dense einsum (the einsum rides the MXU; the row scatter does not) —
+    this path is for CPU/debug and as a parity oracle.  (expert,
+    position) pairs are unique across choices by construction (later
+    choices are offset past all earlier choices' counts), so the scatter
+    has no collisions."""
+    k, G = gr.weights.shape
+    E, M = num_experts, x.shape[-1]
+    C = gr.combine.shape[-1]
+    dest = _dest_slots(gr, E, C).reshape(-1)                # [k*G]
+    xk = jnp.broadcast_to(x[None], (k, G, M)).reshape(k * G, M)
+    buf = jnp.zeros((E * C, M), x.dtype)
+    # no unique_indices promise: dropped copies all alias the same
+    # out-of-bounds slot before mode="drop" discards them
+    buf = buf.at[dest].set(xk, mode="drop")
+    return buf.reshape(E, C, M)
+
+
+def moe_combine_gather(expert_out: jax.Array, gr: GatingResult
+                       ) -> jax.Array:
+    """[E, C, M] expert outputs -> [G, M] by row gather + weighted sum
+    over the k choices (inverse of :func:`moe_dispatch_gather`)."""
+    E, C, M = expert_out.shape
+    flat = expert_out.reshape(E * C, M)
+    dest = _dest_slots(gr, E, C)                            # [k, G]
+    rows = flat.at[dest].get(mode="fill", fill_value=0)     # [k, G, M]
+    w = gr.weights.astype(expert_out.dtype)[:, :, None]
+    return jnp.sum(w * rows, axis=0)
