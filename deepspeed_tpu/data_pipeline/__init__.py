@@ -1,8 +1,11 @@
 from deepspeed_tpu.data_pipeline.curriculum_scheduler import \
     CurriculumScheduler
 from deepspeed_tpu.data_pipeline.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.data_pipeline.indexed_dataset import (
+    IndexedDatasetBuilder, MMapIndexedDataset)
 from deepspeed_tpu.data_pipeline.random_ltd import (RandomLayerTokenDrop,
                                                     RandomLTDScheduler)
 
 __all__ = ["CurriculumScheduler", "DeepSpeedDataSampler",
+           "IndexedDatasetBuilder", "MMapIndexedDataset",
            "RandomLayerTokenDrop", "RandomLTDScheduler"]

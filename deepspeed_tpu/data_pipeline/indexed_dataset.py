@@ -1,0 +1,146 @@
+"""Memory-mapped ragged-sequence dataset.
+
+TPU-native equivalent of the reference's ``MMapIndexedDataset``
+(``runtime/data_pipeline/data_sampling/indexed_dataset.py:369``, the
+Megatron ``.bin``/``.idx`` pair): token sequences of varying length
+stored contiguously in one binary blob, with an index giving each
+sequence's dtype, length, and byte offset.  Reads are ``np.memmap``
+views — no copy, no parse, O(1) open time regardless of corpus size —
+which is what keeps host-side input pipelines off the profile at
+training time.
+
+Format (little-endian):
+
+    <path>.bin   raw sample data, concatenated
+    <path>.idx   magic 'DSTPUIDX' | version u32 | dtype code u32 |
+                 count u64 | sizes u64[count] | offsets u64[count]
+
+Offsets are in ELEMENTS (not bytes) into the flat blob, so a slice is
+``blob[offsets[i] : offsets[i] + sizes[i]]``.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Sequence, Union
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+# stable on-disk dtype codes (subset of the reference's _code_to_dtype)
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32,
+           5: np.int64, 6: np.float32, 7: np.float64, 8: np.uint16,
+           9: np.uint32}
+_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class IndexedDatasetBuilder:
+    """Streaming writer: ``add_item`` per sequence, then ``finalize``.
+
+    (reference ``MMapIndexedDatasetBuilder``; also supports
+    ``merge_file_`` for combining per-worker shards.)
+    """
+
+    def __init__(self, prefix: str, dtype=np.int32):
+        self.prefix = prefix
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        d = os.path.dirname(prefix)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._data = open(data_file_path(prefix), "wb")
+        self._sizes: list = []
+        self._offsets: list = []
+        self._tell = 0                      # in elements
+
+    def add_item(self, array: Union[np.ndarray, Sequence]) -> None:
+        arr = np.ascontiguousarray(np.asarray(array), dtype=self.dtype)
+        if arr.ndim != 1:
+            arr = arr.reshape(-1)
+        self._data.write(arr.tobytes(order="C"))
+        self._offsets.append(self._tell)
+        self._sizes.append(arr.size)
+        self._tell += arr.size
+
+    def merge_file_(self, other_prefix: str) -> None:
+        """Append another indexed dataset written with the same dtype
+        (per-worker shard merging, reference ``merge_file_``)."""
+        other = MMapIndexedDataset(other_prefix)
+        if other._dtype != self.dtype:
+            raise ValueError(
+                f"dtype mismatch: {other._dtype} vs {self.dtype}")
+        with open(data_file_path(other_prefix), "rb") as f:
+            while True:
+                chunk = f.read(1 << 24)
+                if not chunk:
+                    break
+                self._data.write(chunk)
+        for size in other.sizes:
+            self._offsets.append(self._tell)
+            self._sizes.append(int(size))
+            self._tell += int(size)
+
+    def finalize(self) -> None:
+        self._data.close()
+        with open(index_file_path(self.prefix), "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<II", _VERSION, _CODES[self.dtype]))
+            f.write(struct.pack("<Q", len(self._sizes)))
+            f.write(np.asarray(self._sizes, np.uint64).tobytes())
+            f.write(np.asarray(self._offsets, np.uint64).tobytes())
+
+
+class MMapIndexedDataset:
+    """Zero-copy reads of a finalized dataset: ``ds[i]`` is a memmap
+    view (wrap in ``np.array`` to own the memory)."""
+
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+        with open(index_file_path(prefix), "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(
+                    f"{index_file_path(prefix)}: not a DSTPU indexed "
+                    f"dataset (bad magic {magic!r})")
+            version, code = struct.unpack("<II", f.read(8))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            (count,) = struct.unpack("<Q", f.read(8))
+            self.sizes = np.frombuffer(f.read(8 * count), np.uint64)
+            self._offsets = np.frombuffer(f.read(8 * count), np.uint64)
+        self._dtype = np.dtype(_DTYPES[code])
+        if os.path.getsize(data_file_path(prefix)) == 0:
+            # np.memmap refuses empty files; an empty shard is legal
+            self._blob = np.empty((0,), self._dtype)
+        else:
+            self._blob = np.memmap(data_file_path(prefix),
+                                   dtype=self._dtype, mode="r")
+
+    def __len__(self) -> int:
+        return len(self.sizes)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        off, size = int(self._offsets[i]), int(self.sizes[i])
+        return self._blob[off:off + size]
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @staticmethod
+    def exists(prefix: str) -> bool:
+        return (os.path.exists(index_file_path(prefix)) and
+                os.path.exists(data_file_path(prefix)))

@@ -307,3 +307,78 @@ class TestDataAnalyzer:
         common = metric({"input_ids": np.zeros(4, np.int32)})
         rare = metric({"input_ids": np.ones(4, np.int32)})
         assert rare > common
+
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    """Ragged sequences survive the .bin/.idx roundtrip as memmap views
+    (reference MMapIndexedDataset, indexed_dataset.py:369)."""
+    import numpy as np
+
+    from deepspeed_tpu.data_pipeline import (IndexedDatasetBuilder,
+                                             MMapIndexedDataset)
+
+    prefix = str(tmp_path / "corpus")
+    rng = np.random.default_rng(0)
+    seqs = [rng.integers(0, 50000, size=n, dtype=np.int32)
+            for n in (3, 17, 1, 256)]
+    b = IndexedDatasetBuilder(prefix, dtype=np.int32)
+    for s in seqs:
+        b.add_item(s)
+    b.finalize()
+
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 4
+    assert list(ds.sizes) == [3, 17, 1, 256]
+    for want, got in zip(seqs, ds[:]):
+        np.testing.assert_array_equal(want, np.asarray(got))
+    assert isinstance(ds[0], np.memmap)  # zero-copy view
+    assert MMapIndexedDataset.exists(prefix)
+
+
+def test_indexed_dataset_merge_and_errors(tmp_path):
+    import numpy as np
+    import pytest
+
+    from deepspeed_tpu.data_pipeline import (IndexedDatasetBuilder,
+                                             MMapIndexedDataset)
+
+    a, bpfx = str(tmp_path / "a"), str(tmp_path / "b")
+    for prefix, vals in ((a, [[1, 2], [3]]), (bpfx, [[4, 5, 6]])):
+        bld = IndexedDatasetBuilder(prefix, dtype=np.uint16)
+        for v in vals:
+            bld.add_item(np.asarray(v, np.uint16))
+        bld.finalize()
+
+    merged = IndexedDatasetBuilder(str(tmp_path / "m"), dtype=np.uint16)
+    merged.merge_file_(a)
+    merged.merge_file_(bpfx)
+    merged.finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "m"))
+    assert [list(np.asarray(x)) for x in ds[:]] == [[1, 2], [3], [4, 5, 6]]
+
+    with pytest.raises(ValueError, match="bad magic"):
+        bad = str(tmp_path / "bad")
+        open(bad + ".idx", "wb").write(b"NOTMAGIC" + b"\0" * 24)
+        open(bad + ".bin", "wb").close()
+        MMapIndexedDataset(bad)
+
+
+def test_indexed_dataset_empty_shard(tmp_path):
+    """Zero-item shards open and merge cleanly (np.memmap refuses empty
+    files; the reader must not)."""
+    import numpy as np
+
+    from deepspeed_tpu.data_pipeline import (IndexedDatasetBuilder,
+                                             MMapIndexedDataset)
+
+    empty = str(tmp_path / "empty")
+    b = IndexedDatasetBuilder(empty)
+    b.finalize()
+    assert len(MMapIndexedDataset(empty)) == 0
+
+    m = IndexedDatasetBuilder(str(tmp_path / "m"))
+    m.merge_file_(empty)
+    m.add_item(np.array([7], np.int32))
+    m.finalize()
+    ds = MMapIndexedDataset(str(tmp_path / "m"))
+    assert len(ds) == 1 and int(ds[0][0]) == 7
