@@ -55,22 +55,10 @@ def peak_flops(kind: str) -> float:
     return 197e12
 
 
-def device_seconds_per_call(fn, n: int = 10):
-    """(device_seconds, wall_seconds) per fn() call.  Device time comes from
-    profiler XPlane events (jit_* entries), averaged over the TPU planes so
-    multi-chip hosts aren't overcounted; wall time brackets only the call
-    loop + sync.  Device time falls back to wall when no device events are
-    captured (CPU smoke runs)."""
-    trace_dir = "/tmp/dstpu_bench_trace"
-    shutil.rmtree(trace_dir, ignore_errors=True)
-    jax.profiler.start_trace(trace_dir)
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(n):
-        out = fn()
-    jax.device_get(jax.tree_util.tree_map(jnp.sum, out))
-    wall = (time.perf_counter() - t0) / n
-    jax.profiler.stop_trace()
+def _device_seconds_from_trace(trace_dir: str):
+    """Total jit_* device seconds from a profiler trace, averaged over
+    the TPU planes so multi-chip hosts aren't overcounted.  None when no
+    device events were captured (CPU smoke runs)."""
     try:
         from jax.profiler import ProfileData
 
@@ -91,9 +79,30 @@ def device_seconds_per_call(fn, n: int = 10):
                 n_planes += 1
                 total_ns += plane_ns
         if total_ns > 0:
-            return total_ns / 1e9 / n / n_planes, wall
+            return total_ns / 1e9 / n_planes
     except Exception:
         pass
+    return None
+
+
+def device_seconds_per_call(fn, n: int = 10):
+    """(device_seconds, wall_seconds) per fn() call.  Device time comes from
+    profiler XPlane events (jit_* entries); wall time brackets only the call
+    loop + sync.  Device time falls back to wall when no device events are
+    captured (CPU smoke runs)."""
+    trace_dir = "/tmp/dstpu_bench_trace"
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    jax.profiler.start_trace(trace_dir)
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n):
+        out = fn()
+    jax.device_get(jax.tree_util.tree_map(jnp.sum, out))
+    wall = (time.perf_counter() - t0) / n
+    jax.profiler.stop_trace()
+    dev = _device_seconds_from_trace(trace_dir)
+    if dev is not None:
+        return dev / n, wall
     return wall, wall
 
 
@@ -378,6 +387,7 @@ def bench_moe_ep(args) -> None:
                          num_hidden_layers=12,
                          num_local_experts=8, num_experts_per_tok=2,
                          max_position_embeddings=1024,
+                         capacity_factor=1.0,   # reference train default
                          dtype=jnp.bfloat16, remat=True,
                          remat_policy="dots_saveable", scan_layers=True,
                          use_flash_attention=True, **dims) \
@@ -498,12 +508,16 @@ def bench_ragged(args) -> None:
         eng.put_request(rng.integers(0, cfg.vocab_size, int(plen),
                                      dtype=np.int32),
                         max_new_tokens=new)
-    # compile the full-chunk prefill + decode programs before timing
-    # (tail-sized prefill chunks still compile inside the loop — charged
-    # to wall only; device events exclude host-side compilation)
+    # warm up until the decode program has compiled (first decode happens
+    # only once some prompt finishes its chunked prefill); tail-sized
+    # prefill chunks still compile inside the loop — charged to wall
+    # only, device events exclude host-side compilation
     eng.step()
-    warmup_tokens = sum(len(s.generated) for s in eng.slots
-                        if s is not None)
+    while eng._decode_fn is None and eng.has_work():
+        eng.step()
+    warmup_tokens = (sum(len(s.generated) for s in eng.slots
+                         if s is not None) +
+                     sum(len(r.generated) for r in eng.finished))
 
     # device time via profiler: the host-driven scheduler pays one tunnel
     # round-trip per step under this harness (wall is an artifact there)
@@ -517,24 +531,7 @@ def bench_ragged(args) -> None:
         steps += 1
     wall = time.perf_counter() - t0
     jax.profiler.stop_trace()
-    dev_s = None
-    try:
-        from jax.profiler import ProfileData
-
-        path = sorted(glob.glob(trace_dir + "/**/*.xplane.pb",
-                                recursive=True))[-1]
-        total_ns = 0
-        for plane in ProfileData.from_file(path).planes:
-            if "TPU" not in plane.name:
-                continue
-            for line in plane.lines:
-                for ev in line.events:
-                    if ev.name.startswith("jit_"):
-                        total_ns += ev.duration_ns
-        if total_ns > 0:
-            dev_s = total_ns / 1e9
-    except Exception:
-        pass
+    dev_s = _device_seconds_from_trace(trace_dir)
     outs = eng.get_outputs()
     gen_tokens = sum(len(toks) - plen
                      for (_, toks), plen in zip(sorted(outs), prompt_lens))

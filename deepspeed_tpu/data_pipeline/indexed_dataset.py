@@ -117,8 +117,14 @@ class MMapIndexedDataset:
             if version != _VERSION:
                 raise ValueError(f"unsupported index version {version}")
             (count,) = struct.unpack("<Q", f.read(8))
-            self.sizes = np.frombuffer(f.read(8 * count), np.uint64)
-            self._offsets = np.frombuffer(f.read(8 * count), np.uint64)
+            raw_sizes = f.read(8 * count)
+            raw_offsets = f.read(8 * count)
+            if len(raw_sizes) != 8 * count or len(raw_offsets) != 8 * count:
+                raise ValueError(
+                    f"{index_file_path(prefix)}: truncated index "
+                    f"(expected {count} entries)")
+            self.sizes = np.frombuffer(raw_sizes, np.uint64)
+            self._offsets = np.frombuffer(raw_offsets, np.uint64)
         self._dtype = np.dtype(_DTYPES[code])
         if os.path.getsize(data_file_path(prefix)) == 0:
             # np.memmap refuses empty files; an empty shard is legal
