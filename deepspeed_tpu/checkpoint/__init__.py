@@ -1,0 +1,7 @@
+from deepspeed_tpu.checkpoint.engine import (load_checkpoint,
+                                              save_16bit_model,
+                                              save_checkpoint,
+                                              wait_checkpoint, zero_to_fp32)
+
+__all__ = ["save_checkpoint", "load_checkpoint", "wait_checkpoint",
+           "save_16bit_model", "zero_to_fp32"]

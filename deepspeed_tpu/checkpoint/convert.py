@@ -1,0 +1,76 @@
+"""Offline ZeRO-checkpoint consolidation CLI.
+
+Reference: ``deepspeed/utils/zero_to_fp32.py`` — the script users run
+next to a checkpoint directory to merge ZeRO shards into one fp32 state
+dict.  The sharded store here is topology-independent, so "consolidation"
+is just reading every record at full shape (no per-stage merge logic)::
+
+    python -m deepspeed_tpu.checkpoint.convert <ckpt_dir> <out.pkl>
+    python -m deepspeed_tpu.checkpoint.convert <ckpt_dir> <out.npz> --tag t5
+
+(The module is named ``convert`` so it does not shadow the package's
+``zero_to_fp32`` *function* export.)
+
+Output: ``.npz`` (numpy archive) when the filename ends in .npz, else a
+pickle of ``{param_path: np.float32 ndarray}`` — loadable without jax,
+torch, or this package.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        description="merge a deepspeed_tpu checkpoint's module weights "
+                    "into a single fp32 state dict (offline; no devices)")
+    p.add_argument("checkpoint_dir", help="directory passed to "
+                   "save_checkpoint (holds 'latest' + tag dirs)")
+    p.add_argument("output_file", help="destination .pkl or .npz")
+    p.add_argument("--tag", default=None,
+                   help="checkpoint tag (default: the 'latest' file)")
+    args = p.parse_args(argv)
+
+    import json
+
+    from deepspeed_tpu.checkpoint import sharded
+    from deepspeed_tpu.checkpoint.engine import (LATEST_FILE, META_FILE,
+                                                 zero_to_fp32)
+
+    tag = args.tag
+    if tag is None:
+        with open(os.path.join(args.checkpoint_dir, LATEST_FILE)) as f:
+            tag = f.read().strip()
+    # incomplete multi-process saves (crash / still-writing) would
+    # silently drop the missing processes' tensors — refuse, like
+    # load_checkpoint does
+    meta_path = os.path.join(args.checkpoint_dir, tag, META_FILE)
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            procs = json.load(f).get("process_count", 1)
+        if not sharded.is_complete(os.path.join(args.checkpoint_dir, tag),
+                                   procs):
+            raise SystemExit(
+                f"checkpoint {tag!r} is incomplete: not all of its "
+                f"{procs} processes finished writing")
+
+    state = zero_to_fp32(args.checkpoint_dir, tag=tag)
+    out = args.output_file
+    d = os.path.dirname(out)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    if out.endswith(".npz"):
+        import numpy as np
+
+        np.savez(out, **state)
+    else:
+        with open(out, "wb") as f:
+            pickle.dump(state, f)
+    total = sum(v.size for v in state.values())
+    print(f"wrote {len(state)} tensors ({total:,} fp32 elements) -> {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -199,3 +199,42 @@ def test_save_16bit_model(tmp_path):
     assert not any("mu" in k or "nu" in k for k in flat)
     wte = [v for k, v in flat.items() if "wte" in k][0]
     assert wte.shape == (128, 32)
+
+
+def test_zero_to_fp32_cli(tmp_path, devices, capsys):
+    """Offline consolidation CLI (reference deepspeed/utils/zero_to_fp32.py
+    script UX): ckpt dir -> pickle/npz, loadable without jax."""
+    import pickle
+
+    from deepspeed_tpu.checkpoint.convert import main as z2f_main
+
+    topo = dist.initialize_mesh(dp=8)
+    ds = {"train_batch_size": 8, "steps_per_print": 10000,
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+          "zero_optimization": {"stage": 2}}
+    eng, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=ds, topology=topo,
+        example_batch=random_tokens(8), rng=jax.random.PRNGKey(0))
+    eng.train_batch(batch=random_tokens(8))
+    ck = str(tmp_path / "ck")
+    eng.save_checkpoint(ck, tag="t", async_save=False)
+
+    out_pkl = str(tmp_path / "consolidated.pkl")
+    z2f_main([ck, out_pkl])
+    assert "wrote" in capsys.readouterr().out
+    with open(out_pkl, "rb") as f:
+        state = pickle.load(f)
+    want = jax.device_get(eng.state.params)
+    flat = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(want)[0]:
+        flat[sharded.path_str(kp)] = np.asarray(leaf)
+    assert set(state) == set(flat)
+    for k in flat:
+        assert state[k].dtype == np.float32
+        np.testing.assert_allclose(state[k], flat[k].astype(np.float32),
+                                   rtol=1e-6)
+
+    out_npz = str(tmp_path / "consolidated.npz")
+    z2f_main([ck, out_npz, "--tag", "t"])
+    loaded = np.load(out_npz)
+    assert set(loaded.files) == set(flat)
