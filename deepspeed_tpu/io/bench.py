@@ -8,8 +8,8 @@ io_parallel) for the storage device backing offload/checkpoint traffic.
 Same idea here, sized to the TPU runtime's AIO engine (``io/aio.py``):
 sweep (block_size, thread_count), measure sync read/write GB/s against a
 target directory, and report the best configuration — the values to put
-in ``aio_block_size`` / ``aio_thread_count`` knobs (NVMe optimizer swap,
-checkpoint writer).
+in the config's ``aio.block_size`` / ``aio.thread_count`` knobs (NVMe
+optimizer swap, checkpoint writer).
 
 CLI::
 
@@ -125,13 +125,15 @@ def tune(directory: str, size_bytes: int = 256 << 20,
          thread_counts: Optional[List[int]] = None,
          loops: int = 3, verbose: bool = True) -> Dict:
     """``ds_nvme_tune`` equivalent: run the sweep, return the winning
-    config (put its values in ``aio_block_size``/``aio_thread_count``)."""
+    config.  ``best["config"]`` is shaped exactly like the DeepSpeed
+    config subtree it belongs in (``AioConfig``): paste it as the
+    ``aio`` section."""
     results = sweep(directory, size_bytes, block_sizes=block_sizes,
                     thread_counts=thread_counts, loops=loops,
                     verbose=verbose)
     best = dict(results[0])
-    best["config"] = {"aio_block_size": best["block_size"],
-                      "aio_thread_count": best["thread_count"]}
+    best["config"] = {"aio": {"block_size": best["block_size"],
+                              "thread_count": best["thread_count"]}}
     return best
 
 

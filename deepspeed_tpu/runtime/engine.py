@@ -343,16 +343,24 @@ class DeepSpeedEngine:
             from deepspeed_tpu.runtime.swap_tensor import NvmeOptimizerSwapper
 
             p_cfg = dict(opt_cfg.params) if opt_cfg else {}
+            # toggling offload_optimizer.device=nvme must not change the
+            # weight-decay math, so mirror exactly what the device-resident
+            # transform this swapper replaces would have done: the fused
+            # Pallas path honors adam_w_mode (default: decoupled unless
+            # plain "Adam" — optimizers.py:84), while the optax fallback
+            # always decouples (documented divergence) regardless of the
+            # flag
+            _name = (self.optimizer_name or "adamw").lower()
+            if is_fused_optimizer(_name, p_cfg):
+                _adam_w = bool(p_cfg.get("adam_w_mode", _name != "adam"))
+            else:
+                _adam_w = True
             self.nvme_swapper = NvmeOptimizerSwapper(
                 offl_o.nvme_path, params,
                 betas=tuple(p_cfg.get("betas", (0.9, 0.999))),
                 eps=float(p_cfg.get("eps", 1e-8)),
                 weight_decay=float(p_cfg.get("weight_decay", 0.0)),
-                # default True even for plain "Adam": the device-resident
-                # optax path this replaces always uses decoupled decay
-                # (optimizers.py documented divergence) and toggling the
-                # NVMe tier must not change the math
-                adam_w_mode=bool(p_cfg.get("adam_w_mode", True)),
+                adam_w_mode=_adam_w,
                 aio_block_size=config.aio.block_size,
                 aio_thread_count=config.aio.thread_count)
             opt_state, opt_shardings, opt_specs = (), (), None

@@ -24,8 +24,11 @@ state, with a warning, for anything else.
 """
 from __future__ import annotations
 
+import atexit
 import hashlib
 import os
+import re
+import shutil
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
@@ -34,6 +37,54 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True        # exists, owned by someone else — leave it alone
+    return True
+
+
+def _swap_dir_name() -> str:
+    # host+pid scoped: the liveness probe in _prune_stale_swap_dirs is
+    # os.kill, which only means anything for OUR host's pids — on a mount
+    # shared across hosts, a bare-pid name would let host B rmtree host A's
+    # live swap dir just because A's pid happens to be unused on B
+    import socket
+
+    return f"zero_stage_nvme_opt.{socket.gethostname()}.{os.getpid()}"
+
+
+def _prune_stale_swap_dirs(root: str) -> None:
+    """Best-effort removal of this host's ``zero_stage_nvme_opt.<host>.<pid>``
+    dirs whose owning process is dead (crashed/killed runs never reach
+    teardown).  Other hosts' dirs are never touched (their pids are
+    unknowable here); pid recycling can keep a stale dir alive — harmless,
+    it is reclaimed once that pid dies."""
+    import socket
+
+    host = re.escape(socket.gethostname())
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return
+    for name in entries:
+        m = re.fullmatch(rf"zero_stage_nvme_opt\.{host}\.(\d+)", name)
+        if not m or _pid_alive(int(m.group(1))):
+            continue
+        path = os.path.join(root, name)
+        logger.info(f"pruning stale NVMe swap dir {path}")
+        shutil.rmtree(path, ignore_errors=True)
+
+
+def _close_weak(ref) -> None:
+    swapper = ref()
+    if swapper is not None:
+        swapper.close()
 
 
 def _float_leaf(x) -> bool:
@@ -77,10 +128,22 @@ class NvmeOptimizerSwapper:
 
         # pid-scoped: two jobs pointing at the same NVMe mount must not
         # interleave moment files (swap state is transient — a resumed run
-        # re-seeds its fresh dir from the checkpoint's nvme_optimizer/)
-        self.swap_dir = os.path.join(
-            swap_dir, f"zero_stage_nvme_opt.{os.getpid()}")
+        # re-seeds its fresh dir from the checkpoint's nvme_optimizer/).
+        # Swap state is worthless once its owning process is gone, so
+        # (a) prune sibling dirs whose pids are dead before claiming ours
+        # and (b) remove our own dir at exit — without this, long-lived
+        # mounts accumulate dead 2x-fp32 moment sets until disk exhaustion.
+        _prune_stale_swap_dirs(swap_dir)
+        self.swap_dir = os.path.join(swap_dir, _swap_dir_name())
         os.makedirs(self.swap_dir, exist_ok=True)
+        # weakref: an atexit handler holding `self` would pin every swapper
+        # (and its native AIO thread pool) for process lifetime even after
+        # its engine is dropped
+        import weakref
+
+        self._atexit = partial(_close_weak, weakref.ref(self))
+        atexit.register(self._atexit)
+        self._pending: list = []
         self.handle = aio_handle(block_size=aio_block_size,
                                  thread_count=aio_thread_count)
         self.b1, self.b2 = float(betas[0]), float(betas[1])
@@ -145,7 +208,6 @@ class NvmeOptimizerSwapper:
         from deepspeed_tpu.io.aio import _pretruncate
 
         _pretruncate(fname, 2 * nbytes, exact=False)
-        self._pending = getattr(self, "_pending", [])
         self._pending.append(self.handle.async_pwrite(
             np.ascontiguousarray(m, dtype=dt), fname, 0, _truncate=False))
         self._pending.append(self.handle.async_pwrite(
@@ -154,16 +216,50 @@ class NvmeOptimizerSwapper:
         self._initialized.add(key)
 
     def drain(self) -> None:
-        for op in getattr(self, "_pending", []):
-            self.handle.wait(op)
-        self._pending = []
+        """Wait EVERY pending write (even after one fails — a raised
+        ``wait`` means that op finished; abandoning the rest would leave
+        live IO racing later writes to the same files), then re-raise the
+        first failure."""
+        first_err = None
+        try:
+            for op in self._pending:
+                try:
+                    self.handle.wait(op)
+                except Exception as e:       # op completed (failed); keep going
+                    first_err = first_err or e
+        finally:
+            self._pending = []
+        if first_err is not None:
+            raise first_err
+
+    def close(self) -> None:
+        """Drain in-flight IO and delete the swap dir (moments are
+        transient — resumable state lives in the checkpoint's
+        ``nvme_optimizer/``, not here).  Idempotent; registered atexit
+        (via weakref) and safe to call from engine teardown."""
+        try:
+            self.drain()
+        except Exception:
+            pass
+        shutil.rmtree(self.swap_dir, ignore_errors=True)
+        try:
+            atexit.unregister(self._atexit)
+        except Exception:
+            pass
 
     # -- the step --------------------------------------------------------
 
     def apply(self, params: Any, grads: Any, *, lr, gscale) -> Any:
         """Update every float leaf in ``params`` against ``grads``;
         returns the new params tree.  Moments stream NVMe→HBM→NVMe with
-        the next leaf's read overlapping the current leaf's update."""
+        the next leaf's read overlapping the current leaf's update.
+
+        A failure mid-loop leaves on-disk moments for already-processed
+        leaves one step ahead of the abandoned params tree, so the swap
+        state is INVALID after an exception escapes: in-flight IO is
+        drained (finally) and ``_initialized`` is cleared, forcing
+        zero-init moments (or a checkpoint reload) rather than silently
+        mixing half-advanced state into a retried step."""
         from deepspeed_tpu.checkpoint.sharded import path_str
 
         self.count += 1
@@ -177,32 +273,62 @@ class NvmeOptimizerSwapper:
         todo = [i for i, leaf in enumerate(leaves) if _float_leaf(leaf)]
 
         started = {}
-        if todo:
-            i0 = todo[0]
-            started[i0] = self.start_read(keys[i0])
-        new_leaves = list(leaves)
-        for pos, i in enumerate(todo):
-            if pos + 1 < len(todo):                     # prefetch next leaf
-                nxt = todo[pos + 1]
-                started[nxt] = self.start_read(keys[nxt])
-            m, v = self.finish_read(keys[i], started.pop(i))
-            p, g = leaves[i], flat_g[i]
-            m_dev = jax.device_put(m, p.sharding if hasattr(p, "sharding")
-                                   else None)
-            v_dev = jax.device_put(v, p.sharding if hasattr(p, "sharding")
-                                   else None)
-            p_new, m_new, v_new = _adam_update(
-                p, g, m_dev, v_dev, count, lr, gscale,
-                self.b1, self.b2, self.eps, self.wd, self.adam_w_mode)
-            if hasattr(p, "sharding"):
-                # keep the param's placement (incl. pinned_host when
-                # offload_param=cpu composes with the NVMe tier) — the jit
-                # output lands in default device memory otherwise
-                p_new = jax.device_put(p_new, p.sharding)
-            new_leaves[i] = p_new
-            self.write(keys[i], np.asarray(jax.device_get(m_new)),
-                       np.asarray(jax.device_get(v_new)))
-        self.drain()
+        ok = False
+        try:
+            if todo:
+                i0 = todo[0]
+                started[i0] = self.start_read(keys[i0])
+            new_leaves = list(leaves)
+            for pos, i in enumerate(todo):
+                if pos + 1 < len(todo):                 # prefetch next leaf
+                    nxt = todo[pos + 1]
+                    started[nxt] = self.start_read(keys[nxt])
+                m, v = self.finish_read(keys[i], started.pop(i))
+                p, g = leaves[i], flat_g[i]
+                m_dev = jax.device_put(m, p.sharding if hasattr(p, "sharding")
+                                       else None)
+                v_dev = jax.device_put(v, p.sharding if hasattr(p, "sharding")
+                                       else None)
+                p_new, m_new, v_new = _adam_update(
+                    p, g, m_dev, v_dev, count, lr, gscale,
+                    self.b1, self.b2, self.eps, self.wd, self.adam_w_mode)
+                if hasattr(p, "sharding"):
+                    # keep the param's placement (incl. pinned_host when
+                    # offload_param=cpu composes with the NVMe tier) — the jit
+                    # output lands in default device memory otherwise
+                    p_new = jax.device_put(p_new, p.sharding)
+                new_leaves[i] = p_new
+                self.write(keys[i], np.asarray(jax.device_get(m_new)),
+                           np.asarray(jax.device_get(v_new)))
+            ok = True
+        finally:
+            # drain whatever was issued — leaked in-flight ops would race a
+            # subsequent apply()/close() over the same files.  Cleanup waits
+            # themselves can raise (that IS the failure mode being handled),
+            # so every step is individually guarded: the `if not ok`
+            # invalidation must run no matter what.
+            for st in started.values():
+                if st is not None:
+                    for op in (st[0], st[1]):
+                        try:
+                            self.handle.wait(op)
+                        except Exception:
+                            pass             # op finished (failed read)
+            drain_err = None
+            try:
+                self.drain()
+            except Exception as e:           # a failed write corrupts a leaf
+                drain_err = e
+            if not ok or drain_err is not None:
+                logger.error(
+                    "NVMe optimizer apply() failed mid-stream; on-disk "
+                    "moments are ahead of the params tree — invalidating "
+                    "swap state (moments restart zero-init; reload the "
+                    "checkpoint to recover real state)")
+                self.count -= 1
+                self._initialized.clear()
+            if ok and drain_err is not None:
+                raise drain_err
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(params), new_leaves)
 
@@ -213,8 +339,6 @@ class NvmeOptimizerSwapper:
         disk — checkpointing the swapped state is a file copy, the same
         trick the reference plays when NVMe-offloaded state is checkpointed
         alongside, ``engine.py:3277``)."""
-        import shutil
-
         out = os.path.join(ckpt_dir, "nvme_optimizer")
         os.makedirs(out, exist_ok=True)
         self.drain()
@@ -234,7 +358,6 @@ class NvmeOptimizerSwapper:
         """Restore moment files saved by :meth:`save_to`; False when the
         checkpoint holds no swapped state (fresh moments)."""
         import json
-        import shutil
 
         src = os.path.join(ckpt_dir, "nvme_optimizer")
         meta_f = os.path.join(src, "swap_meta.json")

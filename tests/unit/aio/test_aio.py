@@ -107,5 +107,6 @@ def test_io_bench_sweep_and_tune(tmp_path):
     assert len(results) == 2
     assert all(r["read_gbps"] > 0 and r["write_gbps"] > 0 for r in results)
     best = tune(str(tmp_path), 1 << 20, loops=1, verbose=False)
-    assert best["config"]["aio_thread_count"] in (1, 4, 8, 16)
-    assert best["config"]["aio_block_size"] >= 1 << 20
+    # shaped like the AioConfig subtree so it pastes into a config as-is
+    assert best["config"]["aio"]["thread_count"] in (1, 4, 8, 16)
+    assert best["config"]["aio"]["block_size"] >= 1 << 20
