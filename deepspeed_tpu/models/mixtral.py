@@ -32,6 +32,10 @@ class MixtralConfig(LlamaConfig):
     min_capacity: int = 4
     drop_tokens: bool = True
     expert_parallel: bool = True
+    # "auto" resolves per-topology: sorted (grouped-GEMM-style gathers)
+    # when experts are device-local, einsum (GSPMD all-to-all) on a >1-way
+    # expert mesh axis — see moe/layer.py dispatch_impl
+    dispatch_impl: str = "auto"
 
 
 PRESETS = {
@@ -65,6 +69,7 @@ def _moe(cfg: MixtralConfig, name: str) -> MoE:
                dtype=cfg.dtype, param_dtype=cfg.param_dtype,
                expert_parallel=cfg.expert_parallel,
                tensor_parallel=cfg.tensor_parallel,
+               dispatch_impl=cfg.dispatch_impl,
                name=name)
 
 

@@ -28,7 +28,8 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.moe.sharded_moe import (moe_combine, moe_combine_gather,
                                            moe_dispatch, moe_dispatch_gather,
-                                           topkgating)
+                                           routing_plan, sorted_combine,
+                                           sorted_dispatch, topkgating)
 from deepspeed_tpu.utils.sharding import maybe_constrain as _maybe_constrain
 
 EXPERT_AXIS = "expert"
@@ -55,13 +56,31 @@ class MoE(nn.Module):
     expert_parallel: bool = True           # annotate the expert mesh axis
     tensor_parallel: bool = False          # shard expert FFN over `tensor`
     noisy_gate_policy: Optional[str] = None  # None | "Jitter"
-    # "einsum" (default): the reference's dense one-hot dispatch. It
-    # costs G·E·C·M MACs each way, but those ride the MXU — measured
-    # 57ms/step on v5e at the bench shape vs 1134ms for the "gather"
-    # row-scatter path (TPU scatter lowering is catastrophically slower
-    # than the einsum despite doing ~1% of the FLOPs).  "gather" remains
-    # for small-expert-count CPU/debug use and as a parity oracle.
-    dispatch_impl: str = "einsum"
+    # "sorted": expert-sorted row gathers feeding the dense batched FFN —
+    # linear in token count, no [G, E, C] one-hots, no scatter anywhere
+    # (fwd or bwd); the TPU equivalent of the reference's grouped MoE
+    # GEMM (cutlass_ops/moe_gemm).  "einsum" is the reference's dense
+    # one-hot dispatch: G*E*C*M MACs each way (QUADRATIC in G since
+    # C ~ kG/E) but expressed purely as einsums, which GSPMD knows how
+    # to shard over the expert axis — required for expert-parallel
+    # meshes, and the parity oracle.  "gather" is the row-scatter path:
+    # measured ~20x slower on v5e (TPU scatter lowering), CPU/debug only.
+    # "auto" (default) resolves to "sorted" only when the installed
+    # topology is single-device (or absent): the plan's global argsort and
+    # data-dependent gathers defeat GSPMD partitioning of ANY sharded
+    # token or expert axis, forcing per-layer all-gathers on multi-chip
+    # meshes — dp-only meshes included, not just expert-parallel ones.
+    dispatch_impl: str = "auto"
+
+    def _resolve_dispatch(self) -> str:
+        if self.dispatch_impl != "auto":
+            return self.dispatch_impl
+        import deepspeed_tpu.comm as dist
+
+        topo = dist.peek_topology()
+        if topo is not None and topo.mesh.size > 1:
+            return "einsum"
+        return "sorted"
 
     @nn.compact
     def __call__(self, x: jax.Array, is_training: bool = True
@@ -101,14 +120,18 @@ class MoE(nn.Module):
 
         # dispatch: [G, M] -> [E, C, M]; the sharding constraint onto the
         # expert axis is the reference's first all-to-all (_AllToAll fwd)
-        x_d = x.astype(cfg.dtype)      # one cast shared by both impls
-        if cfg.dispatch_impl == "gather":
+        x_d = x.astype(cfg.dtype)      # one cast shared by all impls
+        impl = cfg._resolve_dispatch()
+        plan = None
+        if impl == "gather":
             disp = moe_dispatch_gather(x_d, gr, cfg.num_experts)
-        elif cfg.dispatch_impl == "einsum":
+        elif impl == "einsum":
             disp = moe_dispatch(x_d, gr.dispatch.astype(cfg.dtype))
+        elif impl == "sorted":
+            plan = routing_plan(gr, cfg.num_experts)
+            disp = sorted_dispatch(x_d, plan.slot_token, plan.slot_of_copy)
         else:
-            raise ValueError(
-                f"unknown dispatch_impl {cfg.dispatch_impl!r}")
+            raise ValueError(f"unknown dispatch_impl {impl!r}")
         disp = _maybe_constrain(disp, (ep, None, None))
 
         if cfg.activation == "swiglu":                           # Mixtral
@@ -133,8 +156,11 @@ class MoE(nn.Module):
 
         out = _maybe_constrain(out, (ep, None, None))
         # combine: [E, C, M] -> [G, M] (the second all-to-all)
-        if cfg.dispatch_impl == "gather":
+        if impl == "gather":
             y = moe_combine_gather(out, gr)
+        elif impl == "sorted":
+            y = sorted_combine(out, gr.weights, plan.slot_token,
+                               plan.slot_of_copy)
         else:
             y = moe_combine(out, gr.combine.astype(cfg.dtype))
         return y.reshape(orig_shape), gr.l_aux.astype(jnp.float32)

@@ -1,0 +1,132 @@
+"""Parity tests for the sorted (gather-only) MoE dispatch vs the einsum
+oracle — values, gradients, and capacity-drop selection must all match
+(reference grouped-GEMM semantics: cutlass_ops/moe_gemm + sharded_moe.py
+dispatch masks)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.moe.sharded_moe import (moe_combine, moe_dispatch,
+                                           routing_plan, sorted_combine,
+                                           sorted_dispatch, topkgating)
+
+
+def _gating(G=64, E=4, k=2, cf=1.0, seed=0):
+    logits = jax.random.normal(jax.random.PRNGKey(seed), (G, E), jnp.float32)
+    return topkgating(logits, k=k, capacity_factor=cf, min_capacity=2)
+
+
+@pytest.mark.parametrize("cf", [1.0, 0.5, 2.0])
+@pytest.mark.parametrize("k", [1, 2, 3])
+def test_sorted_dispatch_matches_einsum(cf, k):
+    """The sorted-plan buffer equals the one-hot einsum buffer, including
+    which copies get capacity-dropped (same within-expert ordering)."""
+    G, E, M = 64, 4, 16
+    gr = _gating(G, E, k=k, cf=cf)
+    x = jax.random.normal(jax.random.PRNGKey(1), (G, M), jnp.float32)
+
+    disp_e = moe_dispatch(x, gr.dispatch.astype(x.dtype))
+    plan = routing_plan(gr, E)
+    disp_s = sorted_dispatch(x, plan.slot_token, plan.slot_of_copy)
+    np.testing.assert_allclose(np.asarray(disp_s), np.asarray(disp_e),
+                               rtol=1e-6, atol=1e-6)
+
+    out = jax.random.normal(jax.random.PRNGKey(2), disp_e.shape, jnp.float32)
+    y_e = moe_combine(out, gr.combine.astype(out.dtype))
+    y_s = sorted_combine(out, gr.weights, plan.slot_token, plan.slot_of_copy)
+    np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_e),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sorted_grads_match_einsum():
+    """Custom-VJP (gather-only) gradients equal autodiff through the dense
+    einsum path — for x, expert weights, and the gating weights."""
+    G, E, M, I, k = 64, 4, 16, 32, 2
+    gr = _gating(G, E, k=k, cf=1.0, seed=3)
+    key = jax.random.PRNGKey(4)
+    kx, k1, k2 = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (G, M), jnp.float32)
+    w1 = jax.random.normal(k1, (E, M, I), jnp.float32) * 0.1
+    w2 = jax.random.normal(k2, (E, I, M), jnp.float32) * 0.1
+
+    def einsum_loss(x, w1, w2, weights):
+        # weights enter through the combine tensor the same way gating
+        # builds it: combine = dispatch * per-copy weight
+        gr2 = gr._replace(weights=weights)
+        disp = moe_dispatch(x, gr.dispatch.astype(x.dtype))
+        out = jnp.einsum("eci,eim->ecm",
+                         jax.nn.silu(jnp.einsum("ecm,emi->eci", disp, w1)),
+                         w2)
+        # rebuild combine from weights to let grads flow
+        C = gr.combine.shape[-1]
+        comb = jnp.zeros((G, E, C), jnp.float32)
+        for j in range(k):
+            mask = jax.nn.one_hot(gr.experts[j], E)
+            pos = jax.nn.one_hot(gr.positions[j], C)
+            comb = comb + (weights[j][:, None, None] * mask[:, :, None] *
+                           pos[:, None, :])
+        y = jnp.einsum("gec,ecm->gm", comb, out)
+        return jnp.sum(y ** 2)
+
+    def sorted_loss(x, w1, w2, weights):
+        plan = routing_plan(gr, E)
+        disp = sorted_dispatch(x, plan.slot_token, plan.slot_of_copy)
+        out = jnp.einsum("eci,eim->ecm",
+                         jax.nn.silu(jnp.einsum("ecm,emi->eci", disp, w1)),
+                         w2)
+        y = sorted_combine(out, weights, plan.slot_token, plan.slot_of_copy)
+        return jnp.sum(y ** 2)
+
+    args = (x, w1, w2, gr.weights)
+    g_e = jax.grad(einsum_loss, argnums=(0, 1, 2, 3))(*args)
+    g_s = jax.grad(sorted_loss, argnums=(0, 1, 2, 3))(*args)
+    for name, a, b in zip("x w1 w2 weights".split(), g_e, g_s):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=f"grad mismatch for {name}")
+
+
+def test_sorted_layer_matches_einsum_layer(devices):
+    """Full MoE layer parity: dispatch_impl='sorted' vs 'einsum'."""
+    from deepspeed_tpu.moe.layer import MoE
+
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 16, 32), jnp.float32)
+    outs = {}
+    for impl in ("sorted", "einsum"):
+        moe = MoE(hidden_size=32, num_experts=4, intermediate_size=64,
+                  k=2, capacity_factor=1.0, min_capacity=2,
+                  dtype=jnp.float32, expert_parallel=False,
+                  dispatch_impl=impl)
+        params = moe.init(jax.random.PRNGKey(0), x)
+        y, l_aux = moe.apply(params, x)
+        outs[impl] = (np.asarray(y), float(l_aux))
+    np.testing.assert_allclose(outs["sorted"][0], outs["einsum"][0],
+                               rtol=1e-5, atol=1e-6)
+    assert np.isclose(outs["sorted"][1], outs["einsum"][1])
+
+
+def test_auto_resolves_einsum_on_multichip_mesh(devices):
+    """dispatch_impl='auto' must pick the GSPMD-shardable einsum path on
+    ANY multi-device mesh — the sorted plan's global gathers defeat GSPMD
+    partitioning of sharded token axes (dp-only meshes included)."""
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.moe.layer import MoE
+
+    dist.initialize_mesh(dp=2, ep=4)     # reset by the autouse fixture
+    moe = MoE(hidden_size=32, num_experts=4, intermediate_size=64)
+    assert moe._resolve_dispatch() == "einsum"
+    # dp-only mesh: tokens are sharded over data — still einsum
+    from deepspeed_tpu.comm import comm as _comm
+    _comm._state.topology = None
+    dist.initialize_mesh(dp=8)
+    assert moe._resolve_dispatch() == "einsum"
+
+
+def test_auto_resolves_sorted_without_topology():
+    from deepspeed_tpu.moe.layer import MoE
+
+    moe = MoE(hidden_size=32, num_experts=4, intermediate_size=64)
+    assert moe._resolve_dispatch() == "sorted"
