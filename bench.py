@@ -267,9 +267,14 @@ def bench_llama_zero3(args) -> None:
     default_size = "llama2-7b" if len(jax.devices()) >= 8 else "llama-1b"
     size = args.size or (default_size if on_tpu else "tinyllama")
     if on_tpu:
+        # unrolled blocks let XLA pipeline across layer boundaries
+        # (measured 55.9% vs 43.2% MFU for the 22-layer 1.1B shape on
+        # v5e); gated to the 1B default — the 7B/32-layer preset keeps
+        # scan for compile time and program size
         cfg = get_config(size, max_position_embeddings=2048,
                          dtype=jnp.bfloat16, remat=True,
-                         remat_policy="dots_saveable", scan_layers=True,
+                         remat_policy="dots_saveable",
+                         scan_layers=size != "llama-1b",
                          use_flash_attention=True)
         micro, seq, steps = 1, 2048, args.steps
     else:

@@ -622,19 +622,27 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     causal: bool = True, sm_scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 512,
+                    block_q: int = 1024, block_k: int = 1024,
                     interpret: bool = False) -> jax.Array:
     """Flash attention.  q: [B, H, S, D]; k, v: [B, Hkv, Sk, D] where Hkv
     divides H (grouped-query attention).  Returns [B, H, S, D] in q.dtype.
 
     Pallas kernel on TPU; blockwise-XLA everywhere else; O(S)-memory custom
     backward in both cases.  ``interpret=True`` forces the Pallas kernel in
-    interpreter mode (CPU testing).
+    interpreter mode (CPU testing).  Blocks clamp to the sequence length;
+    the 1024 default measured fastest at the 2k-seq bench shape on v5e
+    (fwd+bwd 1.57 ms vs 1.72 at 512, D=64 GQA) — VMEM comfortably holds
+    [1024, D] tiles for the head dims in use.
     """
     assert q.shape[1] % k.shape[1] == 0, (
         f"q heads {q.shape[1]} not a multiple of kv heads {k.shape[1]}")
     assert k.shape == v.shape
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
-    return _flash(q, k, v, float(sm_scale), bool(causal), int(block_q),
-                  int(block_k), bool(interpret))
+    # clamp here so EVERY backend sees it: the blockwise-XLA fallback pads
+    # S up to a block multiple, so an unclamped default would compute (and
+    # mask away) up to block_q/S times the work on short sequences
+    block_q = min(int(block_q), q.shape[2])
+    block_k = min(int(block_k), k.shape[2])
+    return _flash(q, k, v, float(sm_scale), bool(causal), block_q,
+                  block_k, bool(interpret))

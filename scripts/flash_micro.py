@@ -1,0 +1,84 @@
+"""Compare our Pallas flash attention vs JAX's built-in TPU kernels at the
+config-3 bench shape (B=1, H=32, Hkv=4, S=2048, D=64, causal)."""
+import functools
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import device_seconds_per_call
+
+B, H, Hkv, S, D = 1, 32, 4, 2048, 64
+key = jax.random.PRNGKey(0)
+kq, kk, kv = jax.random.split(key, 3)
+q = jax.random.normal(kq, (B, H, S, D), jnp.bfloat16)
+k = jax.random.normal(kk, (B, Hkv, S, D), jnp.bfloat16)
+v = jax.random.normal(kv, (B, Hkv, S, D), jnp.bfloat16)
+
+# theoretical: fwd 2*2*B*H*S^2*D ; bwd 2.5x fwd
+fwd_fl = 4 * B * H * S * S * D * 0.5          # causal halves it
+print(f"theoretical fwd {fwd_fl / 197e12 * 1e3:.2f} ms, "
+      f"fwd+bwd {3.5 * fwd_fl / 197e12 * 1e3:.2f} ms")
+
+
+def bench(name, fn):
+    try:
+        f = jax.jit(jax.value_and_grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2)))
+        jax.block_until_ready(f(q, k, v))
+        dev, wall = device_seconds_per_call(lambda: f(q, k, v), n=10)
+        ffwd = jax.jit(fn)
+        jax.block_until_ready(ffwd(q, k, v))
+        dfw, _ = device_seconds_per_call(lambda: ffwd(q, k, v), n=10)
+        print(f"{name:24s} fwd {dfw * 1e3:7.2f} ms   fwd+bwd {dev * 1e3:7.2f} ms")
+    except Exception as e:
+        print(f"{name:24s} FAILED {type(e).__name__}: {str(e)[:200]}")
+
+
+from deepspeed_tpu.ops.flash_attention import flash_attention
+
+bench("ours b512", lambda q, k, v: flash_attention(q, k, v, causal=True))
+bench("ours b1024", lambda q, k, v: flash_attention(
+    q, k, v, causal=True, block_q=1024, block_k=1024))
+bench("ours b256", lambda q, k, v: flash_attention(
+    q, k, v, causal=True, block_q=256, block_k=256))
+
+# built-in legacy flash (expects [B, H, S, D]; GQA by repeat)
+try:
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention as jax_flash)
+
+    def builtin(q, k, v):
+        kr = jnp.repeat(k, H // Hkv, axis=1)
+        vr = jnp.repeat(v, H // Hkv, axis=1)
+        return jax_flash(q, kr, vr, causal=True,
+                         sm_scale=1.0 / np.sqrt(D))
+
+    bench("jax flash_attention", builtin)
+except Exception as e:
+    print("builtin flash import failed:", e)
+
+# splash attention (supports GQA natively via MQA/grouped API)
+try:
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as sk,
+        splash_attention_mask as sm)
+
+    mask = sm.CausalMask((S, S))
+    mgrid = sm.MultiHeadMask([mask] * H)
+    kernel = sk.make_splash_mha(mask=mgrid, head_shards=1, q_seq_shards=1)
+
+    def splash(q, k, v):
+        kr = jnp.repeat(k, H // Hkv, axis=1)
+        vr = jnp.repeat(v, H // Hkv, axis=1)
+        scale = 1.0 / np.sqrt(D)
+        out = jax.vmap(kernel)((q * scale).astype(q.dtype), kr, vr)
+        return out
+
+    bench("jax splash", splash)
+except Exception as e:
+    print("splash import failed:", type(e).__name__, str(e)[:200])

@@ -1,0 +1,70 @@
+"""Device-time variant probe for bench config 3 (1.1B Llama ZeRO-3,
+single-chip pure-bf16).
+
+    python scripts/llama_profile.py micro=1 scan=1 remat=dots_saveable
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    kv = dict(item.split("=") for item in sys.argv[1:] if "=" in item)
+    import deepspeed_tpu
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.models.llama import (LlamaLMLoss, flops_per_token,
+                                            get_config)
+    from bench import peak_flops
+
+    micro = int(kv.get("micro", 1))
+    gas = int(kv.get("gas", 1))
+    seq = int(kv.get("seq", 2048))
+    remat = kv.get("remat", "dots_saveable")
+    cfg = get_config("llama-1b", max_position_embeddings=seq,
+                     dtype=jnp.bfloat16,
+                     remat=remat != "none", remat_policy=remat,
+                     scan_layers=bool(int(kv.get("scan", 1))),
+                     use_flash_attention=bool(int(kv.get("flash", 1))))
+    topo = dist.initialize_mesh()
+    ds = {"train_batch_size": micro * gas,
+          "train_micro_batch_size_per_gpu": micro,
+          "gradient_accumulation_steps": gas,
+          "bf16": {"enabled": True, "master_weights": False},
+          "zero_optimization": {
+              "stage": 3,
+              "stage3_param_persistence_threshold":
+                  int(kv.get("persist", 10000))},
+          "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+          "steps_per_print": 1000000}
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size,
+                                       size=(micro * gas, seq),
+                                       dtype=np.int32)}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=LlamaLMLoss(cfg), config=ds, topology=topo,
+        example_batch={"input_ids": batch["input_ids"][:1]},
+        rng=jax.random.PRNGKey(0))
+    dbatch = engine.put_batch(batch)
+    float(jax.device_get(engine.train_batch(batch=dbatch)))  # compile
+
+    from _prof import profile_device
+    step_ms, ops = profile_device(lambda: engine.train_batch(batch=dbatch),
+                                n=int(kv.get("n", 3)))
+    ftok = flops_per_token(cfg, seq)
+    mfu = 100 * micro * gas * seq * ftok / (step_ms / 1e3) / peak_flops(
+        jax.devices()[0].device_kind)
+    print(f"\nstep {step_ms:.1f} ms  MFU {mfu:.1f}%")
+    if int(kv.get("ops", 0)):
+        for name, ms in ops[:25]:
+            print(f"  {ms:8.3f} ms  {name[:110]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -7,9 +7,7 @@ is visible instead of guessed at.  Variant knobs via CLI:
 """
 from __future__ import annotations
 
-import glob
 import os
-import shutil
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -17,35 +15,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 import numpy as np
-
-
-def op_breakdown(fn, n=3, tag="moe"):
-    d = f"/tmp/dstpu_moeprof_{os.getpid()}"
-    shutil.rmtree(d, ignore_errors=True)
-    jax.profiler.start_trace(d)
-    out = None
-    for _ in range(n):
-        out = fn()
-    jax.device_get(jax.tree_util.tree_map(
-        lambda x: jnp.sum(x).astype(jnp.float32) if hasattr(x, "shape") else x,
-        out))
-    jax.profiler.stop_trace()
-    from jax.profiler import ProfileData
-
-    p = sorted(glob.glob(d + "/**/*.xplane.pb", recursive=True))[-1]
-    pd = ProfileData.from_file(p)
-    ops = {}
-    step_ms = 0.0
-    for plane in pd.planes:
-        if "TPU" not in plane.name:
-            continue
-        for line in plane.lines:
-            for ev in line.events:
-                if ev.name.startswith("jit_"):
-                    step_ms += ev.duration_ns / 1e6 / n
-                    continue
-                ops[ev.name] = ops.get(ev.name, 0) + ev.duration_ns / 1e6 / n
-    return step_ms, sorted(ops.items(), key=lambda kv: -kv[1])
 
 
 def main():
@@ -68,10 +37,8 @@ def main():
         remat=kv.get("remat", "dots_saveable") != "none",
         remat_policy=kv.get("remat", "dots_saveable"),
         scan_layers=bool(int(kv.get("scan", 1))),
-        use_flash_attention=bool(int(kv.get("flash", 1))))
-    if "dispatch" in kv:
-        cfg = cfg.replace(dispatch_impl=kv["dispatch"]) \
-            if hasattr(cfg, "replace") else cfg
+        use_flash_attention=bool(int(kv.get("flash", 1))),
+        dispatch_impl=kv.get("dispatch", "auto"))
 
     topo = dist.initialize_mesh()
     ds = {"train_batch_size": micro * gas,
@@ -92,7 +59,8 @@ def main():
     dbatch = engine.put_batch(batch)
     float(jax.device_get(engine.train_batch(batch=dbatch)))  # compile
 
-    step_ms, ops = op_breakdown(
+    from _prof import profile_device
+    step_ms, ops = profile_device(
         lambda: engine.train_batch(batch=dbatch), n=5)
     ftok = flops_per_token(cfg, seq)
     mfu = 100 * micro * gas * seq * ftok / (step_ms / 1e3) / peak_flops(
