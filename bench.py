@@ -496,14 +496,21 @@ def bench_ragged(args) -> None:
 
     on_tpu = not args.smoke
     if on_tpu:
+        # 128-dim heads: the Pallas ragged paged kernel's supported head
+        # dim (H*Dh = hidden, same param count as the 12x64 shape)
         cfg = get_config("llama-1b", hidden_size=768,
                          intermediate_size=2048, num_hidden_layers=12,
-                         num_attention_heads=12, num_key_value_heads=4,
+                         num_attention_heads=6, num_key_value_heads=2,
                          max_position_embeddings=512,
                          dtype=jnp.bfloat16, scan_layers=False,
                          remat=False, use_flash_attention=False,
                          decode=True)
-        max_seqs, max_len, chunk, n_req, new = 8, 512, 128, 16, 64
+        # 32 slots matches the static decode loop's batch size (config
+        # "infer" bs=32) so the two throughput numbers compare directly;
+        # measured 19.4k tok/s vs 9.4k at 8 slots (tick cost is nearly
+        # flat in slot count, so concurrency is pure win)
+        max_seqs = 32
+        max_len, chunk, n_req, new = 512, 128, 2 * max_seqs, 64
     else:
         cfg = get_config("tinyllama", dtype=jnp.float32, remat=False,
                          max_position_embeddings=64, decode=True)
@@ -524,13 +531,9 @@ def bench_ragged(args) -> None:
         eng.put_request(rng.integers(0, cfg.vocab_size, int(plen),
                                      dtype=np.int32),
                         max_new_tokens=new)
-    # warm up until the decode program has compiled (first decode happens
-    # only once some prompt finishes its chunked prefill); tail-sized
-    # prefill chunks still compile inside the loop — charged to wall
-    # only, device events exclude host-side compilation
+    # warm up: the fused SplitFuse engine compiles exactly ONE program on
+    # the first tick (statically shaped token batch) — one step suffices
     eng.step()
-    while eng._decode_fn is None and eng.has_work():
-        eng.step()
     warmup_tokens = (sum(len(s.generated) for s in eng.slots
                          if s is not None) +
                      sum(len(r.generated) for r in eng.finished))

@@ -1,0 +1,203 @@
+"""Blocked (paged) KV cache for ragged continuous batching.
+
+TPU-native re-design of the reference's FastGen blocked-KV machinery
+(``inference/v2/ragged/blocked_allocator.py:1`` ``BlockedAllocator``,
+``ragged/kv_cache.py`` ``BlockedKVCache``, and the ragged attention
+kernels under ``inference/v2/kernels/ragged_ops/``): KV lives in
+fixed-size pages addressed through a per-sequence page table, so device
+memory scales with tokens in flight — not ``max_seqs x max_seq_len`` —
+and one fused token batch mixes decode tokens with prefill chunks
+(Dynamic SplitFuse, ``engine_v2.py:107``).
+
+Device side, attention over the paged cache is JAX's built-in vLLM-TPU
+Pallas kernel (``jax.experimental.pallas.ops.tpu.ragged_paged_attention``)
+on TPU, and :func:`ref_paged_attention` — an XLA-compilable, mask-based
+equivalent of the kernel's reference math — everywhere else (CPU tests).
+The page allocator is host-side Python, like the reference's scheduler
+tier.
+
+Layout contract (the kernel's): pages are
+``[num_pages, page_size, 2 * Hkv, Dh]`` with K at even combined-head
+indices and V at odd; a tick's new K/V rows are scattered into the flat
+page buffer BEFORE attention, and ``kv_lens`` includes this tick's
+tokens.  Page 0 is reserved as the trash page: padding tokens write
+there, no sequence is ever allocated it.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TRASH_PAGE = 0
+
+
+# ---------------------------------------------------------------------------
+# Host-side page allocator (reference blocked_allocator.py)
+# ---------------------------------------------------------------------------
+
+def pages_for(n_tokens: int, page_size: int) -> int:
+    """Pages needed to hold ``n_tokens`` (ceil-div, min 1) — the single
+    rounding rule shared by the allocator and the engine's page-table
+    sizing."""
+    return -(-max(n_tokens, 1) // page_size)
+
+
+class PageAllocator:
+    """Free-list page allocator over ``num_pages`` fixed-size pages.
+
+    Page 0 is reserved (trash page for padding-token writes).  Sequences
+    reserve their worst case (``prompt + max_new_tokens``) at admission —
+    a documented divergence from the reference's on-demand growth +
+    scheduler backpressure: same memory ceiling, no mid-flight
+    out-of-pages state to unwind.
+    """
+
+    def __init__(self, num_pages: int, page_size: int):
+        assert num_pages >= 2, "need at least one non-trash page"
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: List[int] = list(range(num_pages - 1, TRASH_PAGE, -1))
+        self._owned: Dict[int, List[int]] = {}     # slot -> page ids
+
+    def pages_for(self, n_tokens: int) -> int:
+        return pages_for(n_tokens, self.page_size)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.pages_for(n_tokens) <= len(self._free)
+
+    def allocate(self, slot: int, n_tokens: int) -> List[int]:
+        need = self.pages_for(n_tokens)
+        assert slot not in self._owned, f"slot {slot} already allocated"
+        assert need <= len(self._free), "out of KV pages"
+        pages = [self._free.pop() for _ in range(need)]
+        self._owned[slot] = pages
+        return pages
+
+    def free(self, slot: int) -> None:
+        self._free.extend(reversed(self._owned.pop(slot, [])))
+
+
+# ---------------------------------------------------------------------------
+# XLA-compilable reference attention (CPU path / parity oracle)
+# ---------------------------------------------------------------------------
+
+def ref_paged_attention(q: jax.Array, pages: jax.Array, kv_lens: jax.Array,
+                        page_indices: jax.Array, cu_q_lens: jax.Array,
+                        num_seqs: jax.Array, *, sm_scale: float) -> jax.Array:
+    """Same math as the kernel's ``ref_ragged_paged_attention`` but with
+    static control flow (where-masks over the flat page buffer), so it
+    jits on any backend.  ``page_indices`` may pad unused entries with -1
+    (never matches a real page).  O(T * P * page_size) — test scale.
+    """
+    T, H, D = q.shape
+    P, page, combined, _ = pages.shape
+    Hkv = combined // 2
+    S, pp = page_indices.shape
+    k_flat = pages[:, :, 0::2, :].reshape(P * page, Hkv, D)
+    v_flat = pages[:, :, 1::2, :].reshape(P * page, Hkv, D)
+
+    page_of_r = jnp.arange(P * page, dtype=jnp.int32) // page     # [R]
+    pos_in_page = jnp.arange(P * page, dtype=jnp.int32) % page
+
+    # token -> sequence (padding tokens map past num_seqs and mask out)
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+    seq_of_t = jnp.sum((t_idx[:, None] >= cu_q_lens[None, 1:]).astype(
+        jnp.int32), axis=1)                                       # [T]
+    token_valid = t_idx < cu_q_lens[num_seqs[0]]
+    seq_of_t = jnp.minimum(seq_of_t, S - 1)
+
+    # per (seq, flat row): does the row belong to the seq, at which pos
+    match = page_indices[:, :, None] == page_of_r[None, None, :]  # [S,pp,R]
+    owned = jnp.any(match, axis=1)                                # [S, R]
+    kvpos = (jnp.sum(jnp.where(
+        match, jnp.arange(pp, dtype=jnp.int32)[None, :, None], 0),
+        axis=1) * page + pos_in_page[None, :])                    # [S, R]
+
+    q_len = cu_q_lens[1:] - cu_q_lens[:-1]                        # [S]
+    # absolute position of token t within its sequence
+    q_pos = (jnp.take(kv_lens - q_len, seq_of_t) +
+             (t_idx - jnp.take(cu_q_lens[:-1], seq_of_t)))        # [T]
+
+    mask = (jnp.take(owned, seq_of_t, axis=0) &
+            (jnp.take(kvpos, seq_of_t, axis=0) <= q_pos[:, None]) &
+            token_valid[:, None])                                 # [T, R]
+
+    groups = H // Hkv
+    k_r = jnp.repeat(k_flat, groups, axis=1)
+    v_r = jnp.repeat(v_flat, groups, axis=1)
+    att = jnp.einsum("thd,rhd->htr", q.astype(jnp.float32),
+                     k_r.astype(jnp.float32)) * sm_scale
+    att = jnp.where(mask[None], att, jnp.float32(-0.7 * np.finfo(
+        np.float32).max))
+    p = jax.nn.softmax(att, axis=-1)
+    y = jnp.einsum("htr,rhd->thd", p, v_r.astype(jnp.float32))
+    return jnp.where(token_valid[:, None, None], y, 0.0).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Flax-side: write new KV into pages, attend
+# ---------------------------------------------------------------------------
+
+def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
+                            ragged_meta: Dict[str, jax.Array], cfg
+                            ) -> jax.Array:
+    """Inside an attention module: scatter this tick's K/V rows into the
+    layer's page buffer, then ragged-paged attention for all T tokens.
+
+    q: [1, H, T, D]; k, v: [1, Hkv, T, D] (rotary already applied).
+    Returns [1, H, T, D].  Requires ``mutable=["cache"]`` on apply.
+    """
+    _, H, T, D = q.shape
+    Hkv = k.shape[1]
+    P, page = cfg.kv_num_pages, cfg.kv_page_size
+    assert P > 1, "paged_decode requires kv_num_pages (engine sets it)"
+
+    pages_var = mdl.variable(
+        "cache", "kv_pages", jnp.zeros, (P, page, 2 * Hkv, D), k.dtype)
+
+    # interleave K/V onto combined heads: [T, 2Hkv, D], K even, V odd
+    k_rows = k[0].transpose(1, 0, 2)                   # [T, Hkv, D]
+    v_rows = v[0].transpose(1, 0, 2)
+    combined = jnp.stack([k_rows, v_rows], axis=2).reshape(T, 2 * Hkv, D)
+
+    flat = pages_var.value.reshape(P * page, 2 * Hkv, D)
+    flat = flat.at[ragged_meta["new_kv_dest"]].set(
+        combined.astype(flat.dtype), mode="drop")
+    pages = flat.reshape(P, page, 2 * Hkv, D)
+    pages_var.value = pages
+
+    qt = q[0].transpose(1, 0, 2)                       # [T, H, D]
+    sm_scale = float(1.0 / np.sqrt(D))
+    kv_lens = ragged_meta["kv_lens"]
+    cu_q_lens = ragged_meta["cu_q_lens"]
+    num_seqs = ragged_meta["num_seqs"]
+    page_indices = ragged_meta["page_indices"]
+
+    # the vLLM-TPU kernel is built for head_dim 128 (its lane-width row
+    # stats assert on smaller D); other dims take the XLA reference —
+    # correct but O(T * total_page_rows), serving-shape models should use
+    # 128-dim heads
+    if jax.default_backend() == "tpu" and D == 128:
+        from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
+            kernel as rpa)
+
+        y = rpa.ragged_paged_attention(
+            qt, pages, kv_lens, jnp.maximum(page_indices, 0), cu_q_lens,
+            num_seqs, sm_scale=sm_scale)
+    else:
+        if jax.default_backend() == "tpu":
+            from deepspeed_tpu.utils.logging import logger
+
+            logger.warning(
+                f"paged attention: head_dim={D} != 128 — the Pallas "
+                "ragged kernel needs 128; using the dense XLA fallback")
+        y = ref_paged_attention(qt, pages, kv_lens, page_indices,
+                                cu_q_lens, num_seqs, sm_scale=sm_scale)
+    return y.transpose(1, 0, 2)[None]                  # [1, H, T, D]

@@ -1,30 +1,33 @@
 """FastGen-equivalent ragged / continuous-batching inference engine.
 
 TPU-native re-design of the reference InferenceEngineV2 stack
-(``inference/v2/engine_v2.py:30``, ragged batching
-``inference/v2/ragged/``, Dynamic SplitFuse scheduling from the FastGen
-blog): requests of different lengths share one running decode batch —
-sequences join the moment a slot frees, never waiting for the batch to
-drain.  Where the reference manages blocked KV memory with a C++
-allocator + custom ragged CUDA kernels, the TPU version keeps shapes
-STATIC for XLA:
+(``inference/v2/engine_v2.py:30``, ragged batching ``inference/v2/ragged/``,
+Dynamic SplitFuse scheduling from the FastGen blog): requests of different
+lengths share one running decode batch — sequences join the moment a slot
+frees, never waiting for the batch to drain.
 
-- the KV cache is ONE [max_seqs, ...] buffer set; every sequence owns a
-  slot row and its own length (per-row write offsets in
-  ``kv_cache.update_kv_cache``, positions-masked reads);
-- the decode step is a single compiled program over ALL slots every
-  iteration — empty/finished slots compute masked garbage (the price of
-  static shapes, bounded by max_seqs) and their cache rows are
-  overwritten by the next admission before anything reads them;
-- prompt prefill is CHUNKED (Dynamic SplitFuse): each ``step()`` runs at
-  most ``prefill_chunk`` prompt tokens of one admitted request alongside
-  the decode step, bounding per-step latency so decoding sequences never
-  stall behind a long prompt.
+Round-3 architecture (replacing the slot-row cache + split prefill/decode
+dispatches of round 2):
 
-Host-side scheduling (admission, chunk bookkeeping, finish detection) is
-plain Python — the reference's scheduler is host-side C++/Python too.
-Models: the Llama family (Llama, Mixtral — attention threads per-token
-positions, which the ragged path requires).
+- **Blocked KV** (reference ``ragged/blocked_allocator.py:1``,
+  ``ragged/kv_cache.py``): KV lives in fixed-size pages addressed by a
+  per-sequence page table; device memory scales with pages, not
+  ``max_seqs x max_seq_len``.  Allocation is host-side
+  (:class:`deepspeed_tpu.inference.paged.PageAllocator`), worst-case
+  reserved at admission.
+- **One fused compiled program per tick** (Dynamic SplitFuse,
+  ``engine_v2.py:107``): a single static ``[1, T]`` token batch carries one
+  decode token for EVERY ready sequence AND this tick's prefill chunk(s) —
+  multiple prefilling requests share the chunk budget.  Shapes never vary,
+  so exactly one XLA program is compiled; raggedness lives in int32
+  metadata (``cu_q_lens`` et al.).
+- **Attention** is the vLLM-TPU ragged paged Pallas kernel on TPU and an
+  XLA-compilable reference on CPU (``inference/paged.py``).
+
+Host-side scheduling (admission, chunk budgeting, sampling, finish
+detection) is plain Python — the reference's scheduler tier is host-side
+too.  Models: the Llama family (Llama, Mixtral — per-token positions
+thread through attention, which the ragged path requires).
 """
 from __future__ import annotations
 
@@ -37,7 +40,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.inference.kv_cache import init_cache
+from deepspeed_tpu.inference.paged import (PageAllocator,
+                                           pages_for)
 from deepspeed_tpu.inference.sampling import sample_logits
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -66,35 +70,46 @@ class Request:
 class RaggedInferenceEngineV2:
     """``put_request`` -> repeated ``step()`` -> ``get_outputs``.
 
-    One ``step()`` = (admit waiting requests into free slots) + (one
-    prefill chunk for the oldest admitted request that still has prompt
-    left) + (one decode token for every sequence whose prompt is fully
-    cached).
+    One ``step()`` = (admit waiting requests into free slots, reserving
+    KV pages) + ONE compiled forward over a fused token batch of
+    ``T = max_seqs + prefill_chunk`` slots: a decode token for every
+    ready sequence, the rest of the batch filled with prompt tokens
+    split across the prefilling sequences (so a tick with few decoders
+    prefills MORE than ``prefill_chunk`` — the bound is per-batch width,
+    sized so decoders never wait more than one tick).
     """
 
     def __init__(self, model, params: Any = None, max_seqs: int = 8,
                  max_seq_len: int = 512, prefill_chunk: int = 128,
-                 rng: Optional[jax.Array] = None):
+                 rng: Optional[jax.Array] = None, page_size: int = 64,
+                 num_pages: Optional[int] = None):
         mcfg = getattr(model, "config", None)
         assert dataclasses.is_dataclass(mcfg) and hasattr(mcfg, "decode"), \
             "ragged engine needs a model-zoo module with a decode config"
         assert hasattr(mcfg, "rope_theta"), (
             "ragged batching requires per-token positions through "
             "attention — supported by the Llama family models")
-        assert hasattr(mcfg, "ragged_decode"), (
-            "model config predates ragged decode support")
-        # unrolled layers: each layer's cache aliases independently (see
-        # inference/common.unroll_scan_params); stacked params convert
-        # in-jit inside the prefill/decode programs
+        assert hasattr(mcfg, "paged_decode"), (
+            "model config predates paged ragged decode support")
+        self.page_size = int(page_size)
+        self.pages_per_seq = pages_for(max_seq_len, self.page_size)
+        if num_pages is None:
+            # full provisioning: every slot can reach max_seq_len. Callers
+            # serving long-max_len traffic shrink this — memory then
+            # scales with tokens in flight (admission backpressure).
+            num_pages = 1 + max_seqs * self.pages_per_seq
+        self.num_pages = int(num_pages)
+
         self._unroll_params = bool(getattr(mcfg, "scan_layers", False))
-        self.cfg = dataclasses.replace(mcfg, decode=True,
-                                       ragged_decode=True,
-                                       max_cache_len=max_seq_len,
-                                       scan_layers=False)
+        self.cfg = dataclasses.replace(
+            mcfg, decode=True, ragged_decode=False, paged_decode=True,
+            max_cache_len=max_seq_len, scan_layers=False,
+            kv_page_size=self.page_size, kv_num_pages=self.num_pages)
         self.model = type(model)(self.cfg)
         self.max_seqs = max_seqs
         self.max_seq_len = max_seq_len
         self.prefill_chunk = prefill_chunk
+        self.T = max_seqs + prefill_chunk          # fused batch width
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         from deepspeed_tpu.inference.common import normalize_params
@@ -104,22 +119,22 @@ class RaggedInferenceEngineV2:
             plain_model=type(model)(dataclasses.replace(mcfg,
                                                         decode=False)))
 
-        # one global slot cache [max_seqs, ...]
-        self.cache = init_cache(self.model,
-                                np.zeros((max_seqs, 1), np.int32),
-                                positions=jnp.zeros((max_seqs, 1),
-                                                    jnp.int32))
+        self.allocator = PageAllocator(self.num_pages, self.page_size)
+        self.page_table = np.full((max_seqs, self.pages_per_seq), -1,
+                                  np.int32)
+        self.cache = self._init_cache()
         self._uid = itertools.count()
         self.waiting: Deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * max_seqs
         self.finished: List[Request] = []
         self._unclaimed: Dict[int, np.ndarray] = {}
-        self._decode_fn = None
-        self._prefill_fns: Dict[int, Any] = {}
+        self._step_fn = None
         self._last_tokens = np.zeros((max_seqs,), np.int32)
-        log_dist(f"RaggedInferenceEngineV2: max_seqs={max_seqs} "
-                 f"max_seq_len={max_seq_len} "
-                 f"prefill_chunk={prefill_chunk}", ranks=[0])
+        log_dist(
+            f"RaggedInferenceEngineV2: max_seqs={max_seqs} "
+            f"max_seq_len={max_seq_len} prefill_chunk={prefill_chunk} "
+            f"pages={self.num_pages}x{self.page_size} "
+            f"(paged KV, fused SplitFuse step)", ranks=[0])
 
     # -- request API ----------------------------------------------------
 
@@ -128,8 +143,12 @@ class RaggedInferenceEngineV2:
         assert prompt.size > 0
         assert kw.get("max_new_tokens", 64) >= 1, (
             "max_new_tokens must be >= 1 (prefill seeds the first token)")
-        assert prompt.size + kw.get("max_new_tokens", 64) <= \
-            self.max_seq_len, "prompt + max_new_tokens exceeds max_seq_len"
+        total = prompt.size + kw.get("max_new_tokens", 64)
+        assert total <= self.max_seq_len, \
+            "prompt + max_new_tokens exceeds max_seq_len"
+        assert self.allocator.pages_for(total) <= self.num_pages - 1, (
+            "request needs more KV pages than the engine owns — raise "
+            "num_pages")
         req = Request(uid=next(self._uid), prompt=prompt, **kw)
         self.waiting.append(req)
         return req.uid
@@ -146,159 +165,211 @@ class RaggedInferenceEngineV2:
     def has_work(self) -> bool:
         return bool(self.waiting) or any(s is not None for s in self.slots)
 
-    # -- compiled pieces -------------------------------------------------
+    # -- compiled fused step ---------------------------------------------
 
-    def _prefill_fn(self, chunk: int):
-        """Jitted prefill of one [1, chunk] slice against one slot row."""
-        if chunk in self._prefill_fns:
-            return self._prefill_fns[chunk]
+    def _init_cache(self):
+        """Zeroed page buffers for every layer (eval_shape, no params)."""
+        dummy_meta = self._device_meta(
+            np.zeros((self.max_seqs,), np.int32),
+            np.full((self.max_seqs, self.pages_per_seq), -1, np.int32),
+            np.zeros((self.max_seqs + 1,), np.int32),
+            np.zeros((1,), np.int32),
+            np.zeros((self.T,), np.int32))
+        ids = jnp.zeros((1, self.T), jnp.int32)
+        pos = jnp.zeros((1, self.T), jnp.int32)
+
+        def _init():
+            return self.model.init(jax.random.PRNGKey(0), ids,
+                                   positions=pos, ragged_meta=dummy_meta)
+
+        shapes = jax.eval_shape(_init)
+        assert "cache" in shapes
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+    @staticmethod
+    def _device_meta(kv_lens, page_indices, cu_q_lens, num_seqs,
+                     new_kv_dest):
+        return {"kv_lens": jnp.asarray(kv_lens),
+                "page_indices": jnp.asarray(page_indices),
+                "cu_q_lens": jnp.asarray(cu_q_lens),
+                "num_seqs": jnp.asarray(num_seqs),
+                "new_kv_dest": jnp.asarray(new_kv_dest)}
+
+    def _fused_step_fn(self):
+        """ONE jitted program for every tick: fused decode + prefill
+        chunk(s) forward, paged-KV update, and logits row selection."""
+        if self._step_fn is not None:
+            return self._step_fn
         from deepspeed_tpu.inference.common import (logits_of,
                                                     unroll_scan_params)
 
         model = self.model
         unroll = self._unroll_params
 
-        # time-major KV buffers end with [..., max_len, B, Hkv, D]: the
-        # slot (batch) axis is ndim-3.  Smaller leaves (cache_index) are
-        # slot-independent bookkeeping.
-        def slot_axis(b):
-            return b.ndim - 3 if getattr(b, "ndim", 0) >= 4 else None
-
-        def run(params, cache, slot, ids, start):
+        def run(params, cache, token_ids, positions, kv_lens, page_indices,
+                cu_q_lens, num_seqs, new_kv_dest, sample_rows):
             if unroll:
                 params = unroll_scan_params(params)
-            row = jax.tree_util.tree_map(
-                lambda b: (jax.lax.dynamic_slice_in_dim(
-                    b, slot, 1, slot_axis(b))
-                    if slot_axis(b) is not None else b), cache)
-            positions = (start + jnp.arange(chunk))[None]     # [1, chunk]
+            meta = {"kv_lens": kv_lens, "page_indices": page_indices,
+                    "cu_q_lens": cu_q_lens, "num_seqs": num_seqs,
+                    "new_kv_dest": new_kv_dest}
             out, vars_ = model.apply(
-                {"params": params, "cache": row}, ids,
-                positions=positions, mutable=["cache"])
-            new_cache = jax.tree_util.tree_map(
-                lambda g, l: (jax.lax.dynamic_update_slice_in_dim(
-                    g, l, slot, slot_axis(g))
-                    if slot_axis(g) is not None else l),
-                cache, vars_["cache"])
-            return logits_of(out)[0], new_cache       # [chunk, V]
+                {"params": params, "cache": cache}, token_ids,
+                positions=positions, mutable=["cache"], ragged_meta=meta)
+            logits = logits_of(out)[0]                      # [T, V]
+            sel = jnp.take(logits, sample_rows, axis=0)     # [max_seqs, V]
+            return sel, vars_["cache"]
 
-        fn = jax.jit(run, donate_argnums=(1,))
-        self._prefill_fns[chunk] = fn
-        return fn
-
-    def _decode_step_fn(self):
-        """Jitted one-token step over ALL slots."""
-        if self._decode_fn is not None:
-            return self._decode_fn
-        from deepspeed_tpu.inference.common import (logits_of,
-                                                    unroll_scan_params)
-
-        model = self.model
-        unroll = self._unroll_params
-
-        def run(params, cache, tokens, positions):
-            if unroll:
-                params = unroll_scan_params(params)
-            out, vars_ = model.apply(
-                {"params": params, "cache": cache}, tokens[:, None],
-                positions=positions[:, None], mutable=["cache"])
-            return logits_of(out)[:, -1], vars_["cache"]
-
-        self._decode_fn = jax.jit(run, donate_argnums=(1,))
-        return self._decode_fn
+        self._step_fn = jax.jit(run, donate_argnums=(1,))
+        return self._step_fn
 
     # -- the scheduler tick ----------------------------------------------
 
     def step(self) -> int:
         """One engine iteration; returns the number of tokens produced."""
         self._admit()
-        self._prefill_tick()
-        return self._decode_tick()
+        plan = self._plan_tick()
+        if plan is None:
+            self._reap()
+            return 0
+        (token_ids, positions, kv_lens, page_indices, cu_q_lens, num_seqs,
+         new_kv_dest, sample_rows, samplers) = plan
+        sel_logits, self.cache = self._fused_step_fn()(
+            self.params, self.cache,
+            jnp.asarray(token_ids[None]), jnp.asarray(positions[None]),
+            jnp.asarray(kv_lens), jnp.asarray(page_indices),
+            jnp.asarray(cu_q_lens), jnp.asarray(num_seqs),
+            jnp.asarray(new_kv_dest), jnp.asarray(sample_rows))
+        produced = self._sample(sel_logits, samplers)
+        self._reap()
+        return produced
 
     def _admit(self) -> None:
         for i in range(self.max_seqs):
-            if self.slots[i] is None and self.waiting:
-                req = self.waiting.popleft()
-                req.slot = i
-                self.slots[i] = req
-
-    def _prefill_tick(self) -> None:
-        # oldest admitted request (by uid, NOT slot index — index order
-        # could starve a high slot under churn) with prompt remaining;
-        # SplitFuse: one bounded chunk per step
-        pending = [r for r in self.slots
-                   if r is not None and r.prefill_done < r.prompt.size]
-        if not pending:
-            return
-        req = min(pending, key=lambda r: r.uid)
-        chunk = min(self.prefill_chunk,
-                    self.max_seq_len - req.prefill_done)
-        ids = np.zeros((1, chunk), np.int32)
-        real = min(chunk, req.prompt.size - req.prefill_done)
-        ids[0, :real] = req.prompt[req.prefill_done:
-                                   req.prefill_done + real]
-        fn = self._prefill_fn(chunk)
-        logits, self.cache = fn(self.params, self.cache,
-                                jnp.int32(req.slot), jnp.asarray(ids),
-                                jnp.int32(req.prefill_done))
-        req.prefill_done += real
-        if req.prefill_done >= req.prompt.size:
-            # last real token's logits seed the first generated token
-            self.rng, sub = jax.random.split(self.rng)
-            tok = int(np.asarray(sample_logits(
-                logits[None, real - 1], sub, do_sample=req.do_sample,
-                temperature=req.temperature, top_k=req.top_k,
-                top_p=req.top_p))[0])
-            req.generated.append(tok)
-            self._last_tokens[req.slot] = tok
-            self._maybe_finish(req)
-
-    def _decode_tick(self) -> int:
-        active = [r for r in self.slots
-                  if r is not None and not r.done
-                  and r.prefill_done >= r.prompt.size]
-        if not active:
-            self._reap()
-            return 0
-        tokens = np.asarray(self._last_tokens)
-        positions = np.zeros((self.max_seqs,), np.int32)
-        for r in self.slots:
-            if r is None:
+            if not self.waiting:
+                break
+            if self.slots[i] is not None:
                 continue
-            if r.prefill_done < r.prompt.size:
-                # mid-prefill slot: this step's write is garbage — park it
-                # at prefill_done, where the next prompt chunk overwrites
-                positions[r.slot] = min(r.prefill_done,
-                                        self.max_seq_len - 1)
-            else:
-                # the fed token is the LAST generated one: its absolute
-                # position (and cache write offset) is length - 1
-                positions[r.slot] = int(np.clip(r.length - 1, 0,
-                                                self.max_seq_len - 1))
-        logits, self.cache = self._decode_step_fn()(
-            self.params, self.cache, jnp.asarray(tokens),
-            jnp.asarray(positions))
+            req = self.waiting[0]
+            total = req.prompt.size + req.max_new_tokens
+            if not self.allocator.can_allocate(total):
+                break                      # FIFO: wait for pages to free
+            self.waiting.popleft()
+            req.slot = i
+            self.slots[i] = req
+            pages = self.allocator.allocate(i, total)
+            self.page_table[i, :] = -1
+            self.page_table[i, :len(pages)] = pages
+
+    def _flat_dest(self, slot: int, pos: int) -> int:
+        page = self.page_table[slot, pos // self.page_size]
+        assert page > 0, "write into unallocated page"
+        return int(page) * self.page_size + pos % self.page_size
+
+    def _plan_tick(self):
+        """Host-side SplitFuse plan: one decode token per ready sequence
+        plus prompt chunks for prefilling sequences, all in ONE batch."""
+        decode_rs = [r for r in self.slots
+                     if r is not None and not r.done
+                     and r.prefill_done >= r.prompt.size]
+        prefill_rs = sorted(
+            (r for r in self.slots
+             if r is not None and r.prefill_done < r.prompt.size),
+            key=lambda r: r.uid)
+        if not decode_rs and not prefill_rs:
+            return None
+
+        token_ids = np.zeros((self.T,), np.int32)
+        positions = np.zeros((self.T,), np.int32)
+        new_kv_dest = np.full((self.T,), 0, np.int32)   # trash page row 0
+        kv_lens = np.zeros((self.max_seqs,), np.int32)
+        # metadata rows are indexed by PACKED sequence number j, not slot:
+        # pack each active slot's page-table row as it is assigned a j
+        page_indices = np.full((self.max_seqs, self.pages_per_seq), -1,
+                               np.int32)
+        cu_q_lens = np.zeros((self.max_seqs + 1,), np.int32)
+        sample_rows = np.zeros((self.max_seqs,), np.int32)
+        samplers: List[Tuple[Request, int, bool]] = []  # (req, seq_j, sample?)
+
+        budget = self.T - len(decode_rs)
+        takes: Dict[int, int] = {}
+        for r in prefill_rs:
+            take = min(budget, r.prompt.size - r.prefill_done)
+            if take <= 0:
+                continue
+            takes[r.uid] = take
+            budget -= take
+
+        # pack sequences in slot order (any fixed order works; the kernel
+        # sees sequences via cu_q_lens row j)
+        t = 0
+        j = 0
+        for r in [s for s in self.slots if s is not None]:
+            if r.done:
+                continue
+            if r.prefill_done >= r.prompt.size:             # decode: 1 tok
+                p = min(r.length - 1, self.max_seq_len - 1)
+                token_ids[t] = self._last_tokens[r.slot]
+                positions[t] = p
+                new_kv_dest[t] = self._flat_dest(r.slot, p)
+                page_indices[j] = self.page_table[r.slot]
+                kv_lens[j] = p + 1
+                cu_q_lens[j + 1] = cu_q_lens[j] + 1
+                sample_rows[j] = t
+                samplers.append((r, j, True))
+                t += 1
+                j += 1
+            else:                                           # prefill chunk
+                take = takes.get(r.uid, 0)
+                if take <= 0:
+                    continue
+                lo = r.prefill_done
+                token_ids[t:t + take] = r.prompt[lo:lo + take]
+                pos = np.arange(lo, lo + take)
+                positions[t:t + take] = pos
+                pg = self.page_table[r.slot, pos // self.page_size]
+                assert (pg > 0).all(), "write into unallocated page"
+                new_kv_dest[t:t + take] = (pg * self.page_size +
+                                           pos % self.page_size)
+                r.prefill_done += take
+                page_indices[j] = self.page_table[r.slot]
+                kv_lens[j] = r.prefill_done
+                cu_q_lens[j + 1] = cu_q_lens[j] + take
+                finishes = r.prefill_done >= r.prompt.size
+                sample_rows[j] = t + take - 1
+                samplers.append((r, j, finishes))
+                t += take
+                j += 1
+        cu_q_lens[j + 1:] = cu_q_lens[j]
+        if j == 0:
+            return None
+        return (token_ids, positions, kv_lens, page_indices, cu_q_lens,
+                np.asarray([j], np.int32), new_kv_dest, sample_rows,
+                samplers)
+
+    def _sample(self, sel_logits, samplers) -> int:
+        """One host sync per tick; one sampling call per distinct config."""
         produced = 0
-        # one device call per distinct sampling config (typically one),
-        # one host sync per step — not per request
-        groups: Dict[Tuple, List[Request]] = {}
-        for r in active:
+        groups: Dict[Tuple, List[Tuple[Request, int]]] = {}
+        for r, seq_j, wants in samplers:
+            if not wants:
+                continue
             key = (r.do_sample, r.temperature, r.top_k, r.top_p)
-            groups.setdefault(key, []).append(r)
-        for (do_sample, temp, top_k, top_p), reqs in groups.items():
-            slots = [r.slot for r in reqs]
+            groups.setdefault(key, []).append((r, seq_j))
+        for (do_sample, temp, top_k, top_p), pairs in groups.items():
+            rows = np.asarray([j for _, j in pairs])
             sub = None
             if do_sample:
                 self.rng, sub = jax.random.split(self.rng)
             toks = np.asarray(sample_logits(
-                logits[np.asarray(slots)], sub, do_sample=do_sample,
+                sel_logits[rows], sub, do_sample=do_sample,
                 temperature=temp, top_k=top_k, top_p=top_p))
-            for r, tok in zip(reqs, toks):
+            for (r, _), tok in zip(pairs, toks):
                 r.generated.append(int(tok))
                 self._last_tokens[r.slot] = int(tok)
                 produced += 1
                 self._maybe_finish(r)
-        self._reap()
         return produced
 
     def _maybe_finish(self, req: Request) -> None:
@@ -313,6 +384,17 @@ class RaggedInferenceEngineV2:
             if r is not None and r.done:
                 self.finished.append(r)
                 self.slots[i] = None
+                self.allocator.free(i)
+                self.page_table[i, :] = -1
+
+    # -- introspection ----------------------------------------------------
+
+    def cache_bytes(self) -> int:
+        """Device bytes held by the paged KV cache (scales with
+        ``num_pages``, the blocked-KV contract the reference's allocator
+        provides — NOT with ``max_seqs * max_seq_len``)."""
+        return sum(leaf.size * leaf.dtype.itemsize
+                   for leaf in jax.tree_util.tree_leaves(self.cache))
 
     # -- convenience ------------------------------------------------------
 

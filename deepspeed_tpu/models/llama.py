@@ -55,6 +55,15 @@ class LlamaConfig:
     # at different lengths make position-derived writes load-bearing
     ragged_decode: bool = False
     max_cache_len: int = 0
+    # paged/blocked KV (FastGen v2 blocked_allocator + ragged kernels):
+    # the KV cache is [num_pages, page_size, 2*Hkv, Dh] pages addressed by
+    # a per-sequence page table; attention is the vLLM-TPU ragged paged
+    # kernel over ONE fused token batch mixing decode tokens and prefill
+    # chunks.  Requires scan_layers=False (the fused step threads dynamic
+    # metadata the scan carry cannot) and a `ragged_meta` call kwarg.
+    paged_decode: bool = False
+    kv_page_size: int = 64
+    kv_num_pages: int = 0                  # 0 -> engine must set it
 
     def __post_init__(self):
         assert self.sequence_parallel in ("none", "ulysses", "ring"), (
@@ -142,7 +151,8 @@ class LlamaAttention(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, deterministic: bool = True):
+    def __call__(self, x, positions, deterministic: bool = True,
+                 ragged_meta=None):
         cfg = self.config
         B, S, E = x.shape
         H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
@@ -161,6 +171,20 @@ class LlamaAttention(nn.Module):
         v = v.reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
         q = rotary_embedding(q, positions, cfg.rope_theta)
         k = rotary_embedding(k, positions, cfg.rope_theta)
+
+        if cfg.paged_decode:
+            # blocked-KV continuous batching: one fused token batch over
+            # the paged cache (reference ragged_ops kernels + blocked
+            # allocator) — see inference/paged.py
+            from deepspeed_tpu.inference.paged import paged_update_and_attend
+
+            assert ragged_meta is not None, (
+                "paged_decode models require the engine's ragged_meta")
+            assert B == 1, "paged token batches are [1, T]"
+            y = paged_update_and_attend(self, q, k, v, ragged_meta, cfg)
+            y = y.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
+            return nn.Dense(E, name="o_proj", **dense,
+                            **_tp_kwargs(cfg, "row"))(y)
 
         if cfg.decode:
             from deepspeed_tpu.inference.kv_cache import (cached_attention,
@@ -227,11 +251,13 @@ class LlamaBlock(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, x, positions, deterministic: bool = True):
+    def __call__(self, x, positions, deterministic: bool = True,
+                 ragged_meta=None):
         cfg = self.config
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x)
         x = x + LlamaAttention(cfg, name="self_attn")(h, positions,
-                                                      deterministic)
+                                                      deterministic,
+                                                      ragged_meta)
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
                     name="post_attention_layernorm")(x)
         return x + LlamaMLP(cfg, name="mlp")(h)
@@ -265,11 +291,16 @@ class LlamaModel(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, deterministic: bool = True):
+    def __call__(self, input_ids, positions=None, deterministic: bool = True,
+                 ragged_meta=None):
         cfg = self.config
         B, S = input_ids.shape
         if positions is None:
             positions = jnp.arange(S)
+        if cfg.paged_decode:
+            assert not cfg.scan_layers and cfg.pipeline_stages == 1, (
+                "paged_decode requires unrolled layers (the fused step "
+                "threads dynamic ragged metadata the scan carry cannot)")
         from deepspeed_tpu.parallel.tensor_parallel import tp_embed_kwargs
 
         embed_kwargs = tp_embed_kwargs(cfg.tensor_parallel)
@@ -305,7 +336,8 @@ class LlamaModel(nn.Module):
             block_cls = _maybe_remat(LlamaBlock, cfg)
             for i in range(cfg.num_hidden_layers):
                 x = block_cls(cfg, name=f"layers_{i}")(x, positions,
-                                                       deterministic)
+                                                       deterministic,
+                                                       ragged_meta)
         return RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
 
 
@@ -313,9 +345,11 @@ class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, deterministic: bool = True):
+    def __call__(self, input_ids, positions=None, deterministic: bool = True,
+                 ragged_meta=None):
         cfg = self.config
-        x = LlamaModel(cfg, name="model")(input_ids, positions, deterministic)
+        x = LlamaModel(cfg, name="model")(input_ids, positions, deterministic,
+                                          ragged_meta)
         return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype, name="lm_head",
                         **_tp_kwargs(cfg, "col"))(x)

@@ -77,11 +77,13 @@ class MixtralBlock(nn.Module):
     config: MixtralConfig
 
     @nn.compact
-    def __call__(self, x, positions, deterministic: bool = True):
+    def __call__(self, x, positions, deterministic: bool = True,
+                 ragged_meta=None):
         cfg = self.config
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x)
         x = x + LlamaAttention(cfg, name="self_attn")(h, positions,
-                                                      deterministic)
+                                                      deterministic,
+                                                      ragged_meta)
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
                     name="post_attention_layernorm")(x)
         y, l_aux = _moe(cfg, "block_sparse_moe")(h)
@@ -106,7 +108,8 @@ class MixtralModel(nn.Module):
     config: MixtralConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, deterministic: bool = True):
+    def __call__(self, input_ids, positions=None, deterministic: bool = True,
+                 ragged_meta=None):
         from deepspeed_tpu.models.gpt2 import _maybe_remat
         from deepspeed_tpu.parallel.tensor_parallel import tp_embed_kwargs
 
@@ -135,7 +138,8 @@ class MixtralModel(nn.Module):
             aux = aux0
             for i in range(cfg.num_hidden_layers):
                 x, l_aux = _maybe_remat(MixtralBlock, cfg)(
-                    cfg, name=f"layers_{i}")(x, positions, deterministic)
+                    cfg, name=f"layers_{i}")(x, positions, deterministic,
+                                             ragged_meta)
                 aux = aux + l_aux
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
         return x, aux / cfg.num_hidden_layers
@@ -145,10 +149,11 @@ class MixtralForCausalLM(nn.Module):
     config: MixtralConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, deterministic: bool = True):
+    def __call__(self, input_ids, positions=None, deterministic: bool = True,
+                 ragged_meta=None):
         cfg = self.config
         x, aux = MixtralModel(cfg, name="model")(input_ids, positions,
-                                                 deterministic)
+                                                 deterministic, ragged_meta)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           param_dtype=cfg.param_dtype, name="lm_head",
                           **_tp_kwargs(cfg, "col"))(x)
