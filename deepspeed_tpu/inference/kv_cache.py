@@ -90,12 +90,14 @@ def update_kv_cache(mdl, k: jax.Array, v: jax.Array, max_len: int,
 
 
 def cached_attention(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
-                     q_positions: jax.Array) -> jax.Array:
+                     q_positions: jax.Array, window=None) -> jax.Array:
     """Attention of ``q`` [B, H, S, Dh] against the TIME-MAJOR cache
     buffers [L, B, Hkv, Dh], masking key slots beyond each query's
     absolute position.  ``q_positions``: [S] or [B, S] absolute
-    positions.  Used for decode steps (S=1) and ragged chunked prefill;
-    full prefill attends within its chunk via the normal causal kernels.
+    positions.  ``window``: Mistral-style sliding window — key slots
+    more than ``window-1`` behind the query are masked too.  Used for
+    decode steps (S=1) and ragged chunked prefill; full prefill attends
+    within its chunk via the normal causal kernels.
     """
     B, H, S, Dh = q.shape
     L, Hkv = k_full.shape[0], k_full.shape[2]
@@ -105,7 +107,10 @@ def cached_attention(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
         v_full = jnp.repeat(v_full, rep, axis=2)
     att = jnp.einsum("bhsd,lbhd->bhsl", q, k_full) / np.sqrt(Dh)
     qpos = q_positions if q_positions.ndim == 2 else q_positions[None]
-    mask = jnp.arange(L)[None, None, None, :] <= qpos[:, None, :, None]
+    kpos = jnp.arange(L)[None, None, None, :]
+    mask = kpos <= qpos[:, None, :, None]
+    if window is not None:
+        mask = mask & (kpos > qpos[:, None, :, None] - window)
     att = jnp.where(mask, att.astype(jnp.float32), jnp.float32(-1e30))
     p = jax.nn.softmax(att, axis=-1).astype(v_full.dtype)
     return jnp.einsum("bhsl,lbhd->bhsd", p, v_full)

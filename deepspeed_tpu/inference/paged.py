@@ -90,7 +90,8 @@ class PageAllocator:
 
 def ref_paged_attention(q: jax.Array, pages: jax.Array, kv_lens: jax.Array,
                         page_indices: jax.Array, cu_q_lens: jax.Array,
-                        num_seqs: jax.Array, *, sm_scale: float) -> jax.Array:
+                        num_seqs: jax.Array, *, sm_scale: float,
+                        sliding_window=None) -> jax.Array:
     """Same math as the kernel's ``ref_ragged_paged_attention`` but with
     static control flow (where-masks over the flat page buffer), so it
     jits on any backend.  ``page_indices`` may pad unused entries with -1
@@ -128,6 +129,9 @@ def ref_paged_attention(q: jax.Array, pages: jax.Array, kv_lens: jax.Array,
     mask = (jnp.take(owned, seq_of_t, axis=0) &
             (jnp.take(kvpos, seq_of_t, axis=0) <= q_pos[:, None]) &
             token_valid[:, None])                                 # [T, R]
+    if sliding_window is not None:
+        mask = mask & (jnp.take(kvpos, seq_of_t, axis=0) >
+                       q_pos[:, None] - sliding_window)
 
     groups = H // Hkv
     k_r = jnp.repeat(k_flat, groups, axis=1)
@@ -190,7 +194,8 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
 
         y = rpa.ragged_paged_attention(
             qt, pages, kv_lens, jnp.maximum(page_indices, 0), cu_q_lens,
-            num_seqs, sm_scale=sm_scale)
+            num_seqs, sm_scale=sm_scale,
+            sliding_window=getattr(cfg, "sliding_window", None))
     else:
         if jax.default_backend() == "tpu":
             from deepspeed_tpu.utils.logging import logger
@@ -198,6 +203,8 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
             logger.warning(
                 f"paged attention: head_dim={D} != 128 — the Pallas "
                 "ragged kernel needs 128; using the dense XLA fallback")
-        y = ref_paged_attention(qt, pages, kv_lens, page_indices,
-                                cu_q_lens, num_seqs, sm_scale=sm_scale)
+        y = ref_paged_attention(
+            qt, pages, kv_lens, page_indices, cu_q_lens, num_seqs,
+            sm_scale=sm_scale,
+            sliding_window=getattr(cfg, "sliding_window", None))
     return y.transpose(1, 0, 2)[None]                  # [1, H, T, D]

@@ -1,0 +1,5 @@
+from deepspeed_tpu.launcher.elastic_agent import (DSElasticAgent,
+                                                  PreemptionError,
+                                                  elastic_batch_config)
+
+__all__ = ["DSElasticAgent", "PreemptionError", "elastic_batch_config"]

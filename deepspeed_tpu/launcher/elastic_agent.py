@@ -1,0 +1,187 @@
+"""Elastic runtime agent: resume-on-reslice supervision.
+
+TPU-native re-design of the reference's ``DSElasticAgent``
+(``elasticity/elastic_agent.py:32``, ``_invoke_run:127``): where the
+reference subclasses torch-elastic's ``LocalElasticAgent`` to monitor
+worker processes and re-rendezvous on membership change, the
+single-controller JAX runtime supervises the TRAINING LOOP itself —
+preemptible TPU pods lose/regain chips, and the agent:
+
+1. polls device membership (``device_provider``) and catches runtime
+   device failures (the XLA error a dead chip raises),
+2. re-solves the elastic batch config for the new world size
+   (:func:`deepspeed_tpu.elasticity.compute_elastic_config` — global
+   batch stays constant, micro-batch x GAS reshuffle, so convergence is
+   undisturbed: the reference contract),
+3. rebuilds the mesh over the surviving devices and a fresh engine,
+4. resumes from the newest complete sharded checkpoint (the store
+   reshards across topologies on load — ``checkpoint/sharded.py``).
+
+Graceful membership changes (scheduler notice) checkpoint first and lose
+no steps; hard failures resume from the last periodic save, exactly the
+reference's checkpoint-based recovery story.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class PreemptionError(RuntimeError):
+    """Raised (by harnesses or infrastructure hooks) to signal that the
+    current slice is going away."""
+
+
+def elastic_batch_config(ds_config: Dict, world_size: int) -> Dict:
+    """Return a copy of ``ds_config`` with the batch triple re-solved for
+    ``world_size`` by the elasticity solver (no-op when elasticity is
+    absent/disabled)."""
+    ecfg = ds_config.get("elasticity", {})
+    if not ecfg.get("enabled", False):
+        return dict(ds_config)
+    from deepspeed_tpu.elasticity import compute_elastic_config
+
+    batch, _menu, micro = compute_elastic_config(
+        ds_config, world_size=world_size, return_microbatch=True)
+    # the batch triple is expressed in DATA-PARALLEL ranks: model
+    # parallelism divides the world without multiplying the batch
+    dp = world_size // max(int(ecfg.get("model_parallel_size", 1)), 1)
+    out = dict(ds_config)
+    out["train_batch_size"] = int(batch)
+    out["train_micro_batch_size_per_gpu"] = int(micro)
+    out["gradient_accumulation_steps"] = int(batch // (micro * dp))
+    return out
+
+
+class DSElasticAgent:
+    """Supervise an elastic training run across device-membership changes.
+
+    Parameters
+    ----------
+    build_engine:
+        ``(topology, ds_config) -> DeepSpeedEngine`` — rebuilt after every
+        membership change (the mesh is baked into compiled programs).
+    ds_config:
+        DeepSpeed-style config dict; its ``elasticity`` section drives the
+        batch re-solve.
+    ckpt_dir:
+        Sharded-checkpoint directory used for both periodic saves and
+        resume.
+    device_provider:
+        ``() -> Sequence[jax.Device]`` — current healthy devices.  Default
+        ``jax.devices()``.  Tests (and schedulers with advance notice)
+        swap this to shrink/grow the slice.
+    save_interval:
+        Steps between periodic checkpoints (the hard-failure recovery
+        granularity).
+    max_restarts:
+        Supervision budget; exceeded -> the last error re-raises.
+    """
+
+    def __init__(self, build_engine: Callable[[Any, Dict], Any],
+                 ds_config: Dict, ckpt_dir: str,
+                 device_provider: Optional[
+                     Callable[[], Sequence[jax.Device]]] = None,
+                 save_interval: int = 10, max_restarts: int = 10):
+        self.build_engine = build_engine
+        self.ds_config = dict(ds_config)
+        self.ckpt_dir = ckpt_dir
+        self.device_provider = device_provider or jax.devices
+        self.save_interval = int(save_interval)
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+
+    # -- helpers ----------------------------------------------------------
+
+    def _make_engine(self, devices: Sequence[jax.Device]):
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.comm import comm as _comm
+
+        world = len(devices)
+        # the config system re-solves the elastic batch triple itself for
+        # the topology's dp world size (config.py _apply_elasticity) — the
+        # agent only rebuilds the mesh and hands the config through
+        cfg = dict(self.ds_config)
+        _comm._state.topology = None          # the old mesh is dead
+        topo = dist.initialize_mesh(dp=world, devices=list(devices))
+        engine = self.build_engine(topo, cfg)
+        tag, _ = engine.load_checkpoint(self.ckpt_dir)
+        if tag:
+            log_dist(f"elastic agent: resumed {tag} at step "
+                     f"{engine.global_steps} on {world} devices", ranks=[0])
+        else:
+            log_dist(f"elastic agent: fresh start on {world} devices",
+                     ranks=[0])
+        return engine, cfg
+
+    # -- the supervision loop ---------------------------------------------
+
+    def run(self, data_fn: Callable[[int, int], Any], num_steps: int):
+        """Train to ``num_steps`` across membership changes.
+
+        ``data_fn(step, global_batch_size) -> batch`` must be
+        deterministic in ``step`` so a resumed run replays the same data
+        stream regardless of the device count (the elastic solver keeps
+        the global batch size constant across the menu).
+
+        Returns the final engine (for evaluation / state extraction).
+        """
+        last_err: Optional[BaseException] = None
+        while self.restarts <= self.max_restarts:
+            devices = list(self.device_provider())
+            if not devices:
+                raise RuntimeError("elastic agent: no healthy devices")
+            try:
+                engine, cfg = self._make_engine(devices)
+            except (PreemptionError, jax.errors.JaxRuntimeError) as e:
+                # losing the slice DURING rebuild/resume is the likeliest
+                # failure on a degraded pod — it must consume a restart,
+                # not crash the supervisor
+                last_err = e
+                self.restarts += 1
+                logger.warning(
+                    f"elastic agent: engine rebuild failed, restart "
+                    f"{self.restarts}/{self.max_restarts} ({e})")
+                continue
+            step = int(engine.global_steps)
+            # read the SOLVED batch size off the engine (elastic mode
+            # overrides whatever the dict said)
+            gbs = int(engine.config.train_batch_size)
+            try:
+                while step < num_steps:
+                    if list(self.device_provider()) != devices:
+                        # scheduler notice: save, then re-slice losing
+                        # nothing (reference _invoke_run membership check)
+                        log_dist("elastic agent: membership change "
+                                 "detected; checkpointing for re-slice",
+                                 ranks=[0])
+                        engine.save_checkpoint(self.ckpt_dir)
+                        engine.wait_checkpoint()
+                        raise PreemptionError("membership changed")
+                    engine.train_batch(batch=data_fn(step, gbs))
+                    step = int(engine.global_steps)
+                    if step % self.save_interval == 0 or step == num_steps:
+                        engine.save_checkpoint(self.ckpt_dir)
+                engine.wait_checkpoint()
+                return engine
+            except PreemptionError as e:
+                last_err = e
+                self.restarts += 1
+                logger.warning(
+                    f"elastic agent: restart {self.restarts}/"
+                    f"{self.max_restarts} ({e})")
+            except jax.errors.JaxRuntimeError as e:
+                # hard device failure: resume from the last periodic save
+                last_err = e
+                self.restarts += 1
+                logger.warning(
+                    f"elastic agent: device failure, restart "
+                    f"{self.restarts}/{self.max_restarts} ({e})")
+                time.sleep(0)                  # yield; real pods backoff
+        raise RuntimeError(
+            f"elastic agent: exceeded {self.max_restarts} restarts"
+        ) from last_err

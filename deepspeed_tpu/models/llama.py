@@ -64,6 +64,11 @@ class LlamaConfig:
     paged_decode: bool = False
     kv_page_size: int = 64
     kv_num_pages: int = 0                  # 0 -> engine must set it
+    # family knobs shared with Mistral/Qwen2 (both are Llama-shaped):
+    # qkv-projection biases (Qwen2) and sliding-window attention
+    # (Mistral) — None disables the window
+    attention_bias: bool = False
+    sliding_window: Optional[int] = None
 
     def __post_init__(self):
         assert self.sequence_parallel in ("none", "ulysses", "ring"), (
@@ -159,11 +164,13 @@ class LlamaAttention(nn.Module):
                       cfg.head_dim)
         dense = dict(use_bias=False, dtype=cfg.dtype,
                      param_dtype=cfg.param_dtype)
-        q = nn.Dense(H * Dh, name="q_proj", **dense,
+        # Qwen2: biases on q/k/v only, never on o_proj
+        qkv = dict(dense, use_bias=cfg.attention_bias)
+        q = nn.Dense(H * Dh, name="q_proj", **qkv,
                      **_tp_kwargs(cfg, "col"))(x)
-        k = nn.Dense(Hkv * Dh, name="k_proj", **dense,
+        k = nn.Dense(Hkv * Dh, name="k_proj", **qkv,
                      **_tp_kwargs(cfg, "col"))(x)
-        v = nn.Dense(Hkv * Dh, name="v_proj", **dense,
+        v = nn.Dense(Hkv * Dh, name="v_proj", **qkv,
                      **_tp_kwargs(cfg, "col"))(x)
 
         q = q.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
@@ -204,13 +211,34 @@ class LlamaAttention(nn.Module):
             k_full, v_full, _ = update_kv_cache(self, k, v, max_len,
                                                 write_positions=wp)
             if S == 1 or ragged:
-                y = cached_attention(q, k_full, v_full, positions)
+                y = cached_attention(q, k_full, v_full, positions,
+                                     window=cfg.sliding_window)
                 y = y.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
                 return nn.Dense(E, name="o_proj", **dense,
                                 **_tp_kwargs(cfg, "row"))(y)
             # full-prefill: cache written above; attend within the chunk
 
-        if cfg.sequence_parallel == "ulysses":
+        window = cfg.sliding_window
+        if window is not None and S > window and \
+                cfg.sequence_parallel != "none":
+            # the SP paths all-to-all/ring over the FULL sequence; the
+            # local-window mask below would silently attend within shards
+            raise NotImplementedError(
+                "sliding-window attention does not compose with sequence "
+                "parallelism yet — raise sliding_window above the "
+                "sequence length or disable sequence_parallel")
+        if window is not None and S > window:
+            # Mistral sliding window binds: causal AND within-window mask
+            # via the reference kernel (the flash kernel has no window
+            # support; window-bound shapes are rare in training)
+            from deepspeed_tpu.ops.flash_attention import mha_reference
+
+            pos = jnp.arange(S)
+            keep = (pos[None, :] <= pos[:, None]) & \
+                   (pos[None, :] > pos[:, None] - window)
+            bias = jnp.where(keep, 0.0, -1e30)[None, None]
+            y = mha_reference(q, k, v, causal=False, bias=bias)
+        elif cfg.sequence_parallel == "ulysses":
             from deepspeed_tpu.sequence import ulysses_attention
 
             y = ulysses_attention(q, k, v, causal=True)

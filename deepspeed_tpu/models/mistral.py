@@ -1,0 +1,57 @@
+"""Mistral model family.
+
+Llama-shaped (same module graph the reference's
+``inference/v2/model_implementations/mistral`` serves: RMSNorm, RoPE,
+GQA, SwiGLU, untied head) plus **sliding-window attention** — keys more
+than ``sliding_window - 1`` positions behind a query are masked.  The
+window threads through every attention path: full prefill (reference
+kernel mask when the window binds; the causal flash kernel when it
+doesn't), v1 cached decode, and the ragged paged kernel (its native
+``sliding_window`` argument).
+
+HF checkpoint conversion reuses the Llama converter verbatim
+(``module_inject/hf_loader.py`` — identical tensor names/layout).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from deepspeed_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                        LlamaModel, count_params,
+                                        flops_per_token)
+
+__all__ = ["MistralConfig", "MistralModel", "MistralForCausalLM",
+           "get_config", "count_params", "flops_per_token"]
+
+
+@dataclasses.dataclass(frozen=True)
+class MistralConfig(LlamaConfig):
+    sliding_window: Optional[int] = 4096
+
+
+PRESETS = {
+    "mistral-7b": dict(vocab_size=32000, hidden_size=4096,
+                       intermediate_size=14336, num_hidden_layers=32,
+                       num_attention_heads=32, num_key_value_heads=8,
+                       rope_theta=10000.0, sliding_window=4096,
+                       max_position_embeddings=32768),
+    "tinymistral": dict(vocab_size=256, hidden_size=64,
+                        intermediate_size=128, num_hidden_layers=2,
+                        num_attention_heads=4, num_key_value_heads=2,
+                        sliding_window=16, max_position_embeddings=64),
+}
+
+
+def get_config(preset: str, **overrides) -> MistralConfig:
+    kw = dict(PRESETS[preset])
+    kw.update(overrides)
+    return MistralConfig(**kw)
+
+
+class MistralModel(LlamaModel):
+    config: MistralConfig
+
+
+class MistralForCausalLM(LlamaForCausalLM):
+    config: MistralConfig

@@ -1,0 +1,56 @@
+"""Qwen2 model family.
+
+Llama-shaped (the reference serves it through
+``inference/v2/model_implementations/qwen_v2``) with two deltas:
+**biases on the q/k/v projections** (``attention_bias=True``; o_proj
+stays bias-free) and tied word embeddings on the small checkpoints
+(the HF converter falls back to ``embed_tokens`` for ``lm_head``
+automatically).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from deepspeed_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                        LlamaModel, count_params,
+                                        flops_per_token)
+
+__all__ = ["Qwen2Config", "Qwen2Model", "Qwen2ForCausalLM",
+           "get_config", "count_params", "flops_per_token"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Qwen2Config(LlamaConfig):
+    attention_bias: bool = True
+
+
+PRESETS = {
+    "qwen2-7b": dict(vocab_size=152064, hidden_size=3584,
+                     intermediate_size=18944, num_hidden_layers=28,
+                     num_attention_heads=28, num_key_value_heads=4,
+                     rope_theta=1e6, max_position_embeddings=32768,
+                     rms_norm_eps=1e-6),
+    "qwen2-0.5b": dict(vocab_size=151936, hidden_size=896,
+                       intermediate_size=4864, num_hidden_layers=24,
+                       num_attention_heads=14, num_key_value_heads=2,
+                       rope_theta=1e6, max_position_embeddings=32768,
+                       rms_norm_eps=1e-6),
+    "tinyqwen2": dict(vocab_size=256, hidden_size=64,
+                      intermediate_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64),
+}
+
+
+def get_config(preset: str, **overrides) -> Qwen2Config:
+    kw = dict(PRESETS[preset])
+    kw.update(overrides)
+    return Qwen2Config(**kw)
+
+
+class Qwen2Model(LlamaModel):
+    config: Qwen2Config
+
+
+class Qwen2ForCausalLM(LlamaForCausalLM):
+    config: Qwen2Config

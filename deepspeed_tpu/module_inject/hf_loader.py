@@ -12,8 +12,8 @@ GPT-2's Conv1D stays untransposed — after which the inference engine's
 AutoTP sharding places them across the mesh (the TP half of the
 reference's injection policies).
 
-Supported families: GPT-2, Llama, Mixtral (matching
-``models/gpt2|llama|mixtral.py``).  Sources: a dict of tensors, an HF
+Supported families: GPT-2, Llama, Mistral, Qwen2, Mixtral (matching
+``models/gpt2|llama|mistral|qwen2|mixtral.py``).  Sources: a dict of tensors, an HF
 ``transformers`` model object, or a directory holding
 ``pytorch_model.bin`` / sharded ``pytorch_model-*.bin`` /
 ``model.safetensors``.
@@ -146,8 +146,9 @@ def _convert_gpt2(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     return _nest(flat)
 
 
-def _llama_layer(sd, p: str) -> Dict[str, np.ndarray]:
-    return {
+def _llama_layer(sd, p: str, qkv_bias: bool = False
+                 ) -> Dict[str, np.ndarray]:
+    out = {
         "input_layernorm/scale": sd[p + "input_layernorm.weight"],
         "post_attention_layernorm/scale":
             sd[p + "post_attention_layernorm.weight"],
@@ -156,14 +157,19 @@ def _llama_layer(sd, p: str) -> Dict[str, np.ndarray]:
         "self_attn/v_proj/kernel": sd[p + "self_attn.v_proj.weight"].T,
         "self_attn/o_proj/kernel": sd[p + "self_attn.o_proj.weight"].T,
     }
+    if qkv_bias:                      # Qwen2: biases on q/k/v only
+        for w in ("q_proj", "k_proj", "v_proj"):
+            out[f"self_attn/{w}/bias"] = sd[f"{p}self_attn.{w}.bias"]
+    return out
 
 
 def _convert_llama(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     L = cfg.num_hidden_layers
+    qkv_bias = bool(getattr(cfg, "attention_bias", False))
     layers = []
     for i in range(L):
         p = f"model.layers.{i}."
-        layer = _llama_layer(sd, p)
+        layer = _llama_layer(sd, p, qkv_bias)
         layer.update({
             "mlp/gate_proj/kernel": sd[p + "mlp.gate_proj.weight"].T,
             "mlp/up_proj/kernel": sd[p + "mlp.up_proj.weight"].T,
@@ -217,6 +223,12 @@ def _place_layers(flat, layers, cfg, prefix: str) -> None:
 _CONVERTERS = {
     "GPT2Config": _convert_gpt2,
     "LlamaConfig": _convert_llama,
+    # Mistral (sliding window) and Qwen2 (qkv biases, via the config's
+    # attention_bias flag) share the Llama tensor layout — reference
+    # model_implementations/{mistral,qwen_v2} are Llama-container reuses
+    # the same way
+    "MistralConfig": _convert_llama,
+    "Qwen2Config": _convert_llama,
     "MixtralConfig": _convert_mixtral,
 }
 

@@ -263,20 +263,19 @@ class DeepSpeedEngine:
         # NVMe tier (ZeRO-Infinity, swap_tensor/partitioned_optimizer_
         # swapper.py): moments on local SSD, streamed through the device
         # per step by the native AIO engine.  Adam-family only (the
-        # reference swapper equally assumes two-moment CPU-Adam state)
-        # and single-controller (each extra process would need its own
-        # shard files — multi-host swap is a later round).
+        # reference swapper equally assumes two-moment CPU-Adam state).
+        # Multi-process capable: each process swaps only its addressable
+        # ZeRO shards into per-shard files (reference rank-local
+        # partition semantics).
         self.nvme_swapper = None
         want_opt_nvme = bool(offl_o and offl_o.device == "nvme")
         if want_opt_nvme:
             adam_family = (self.optimizer_name or "adamw").lower() in (
                 "adam", "adamw", "fusedadam")
-            if not adam_family or self._onebit_axes is not None or \
-                    jax.process_count() > 1:
+            if not adam_family or self._onebit_axes is not None:
                 logger.warning(
-                    "offload_optimizer.device=nvme needs a single-"
-                    "controller Adam-family optimizer; keeping optimizer "
-                    "state in device memory")
+                    "offload_optimizer.device=nvme needs an Adam-family "
+                    "optimizer; keeping optimizer state in device memory")
                 want_opt_nvme = False
             elif not offl_o.nvme_path:
                 # a shared default path would let concurrent jobs clobber

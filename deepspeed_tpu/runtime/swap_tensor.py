@@ -87,6 +87,34 @@ def _close_weak(ref) -> None:
         swapper.close()
 
 
+def _norm_index(index, shape) -> tuple:
+    """Normalize a shard's ``.index`` (tuple of slices) to a hashable
+    ((start, stop), ...) key."""
+    out = []
+    for s, dim in zip(index, shape):
+        if isinstance(s, slice):
+            out.append((int(s.start or 0),
+                        int(dim if s.stop is None else s.stop)))
+        else:
+            out.append((int(s), int(s) + 1))
+    return tuple(out)
+
+
+def _idx_tag(idx_norm: tuple) -> str:
+    return hashlib.sha1(repr(idx_norm).encode()).hexdigest()[:8]
+
+
+def _unique_shards(leaf) -> dict:
+    """{normalized index -> one representative shard} over this process's
+    addressable shards (replicated leaves repeat the same index on every
+    local device — IO happens once per distinct slice)."""
+    seen = {}
+    for sh in leaf.addressable_shards:
+        key = _norm_index(sh.index, leaf.shape)
+        seen.setdefault(key, sh)
+    return seen
+
+
 def _float_leaf(x) -> bool:
     return jnp.issubdtype(np.asarray(x).dtype if not hasattr(x, "dtype")
                           else x.dtype, jnp.floating)
@@ -144,6 +172,8 @@ class NvmeOptimizerSwapper:
         self._atexit = partial(_close_weak, weakref.ref(self))
         atexit.register(self._atexit)
         self._pending: list = []
+        self._restored = False              # a load_from() succeeded
+        self._reshard_warned = False
         self.handle = aio_handle(block_size=aio_block_size,
                                  thread_count=aio_thread_count)
         self.b1, self.b2 = float(betas[0]), float(betas[1])
@@ -151,12 +181,15 @@ class NvmeOptimizerSwapper:
         self.wd = float(weight_decay)
         self.adam_w_mode = bool(adam_w_mode)
         self.count = 0                      # successful (non-overflow) steps
-        self._initialized: set = set()      # leaf keys with moments on disk
-        # leaf registry: key -> (file path, shape, np dtype, nbytes)
-        self._meta: Dict[str, Tuple[str, tuple, np.dtype, int]] = {}
+        # (leaf key, shard index tag) pairs with moments on disk — THIS
+        # process's shards only; other processes track their own
+        self._initialized: set = set()
+        # leaf registry: key -> (file basename, full shape, np dtype)
+        self._meta: Dict[str, Tuple[str, tuple, np.dtype]] = {}
         flat = jax.tree_util.tree_flatten_with_path(params)[0]
         from deepspeed_tpu.checkpoint.sharded import path_str
 
+        total = 0
         for kp, leaf in flat:
             if not _float_leaf(leaf):
                 continue
@@ -166,54 +199,110 @@ class NvmeOptimizerSwapper:
             # sizing the layout by a bf16 param dtype would interleave
             # the m/v byte ranges
             dt = np.dtype(np.float32)
-            nbytes = int(np.prod(leaf.shape)) * dt.itemsize
             # hash suffix keeps the name→file map injective ("/"→"__" alone
             # would collide for module names containing literal "__")
             digest = hashlib.sha1(key.encode()).hexdigest()[:8]
-            fname = os.path.join(
-                self.swap_dir,
-                f"{key.replace('/', '__')}-{digest}.bin")
-            self._meta[key] = (fname, tuple(leaf.shape), dt, nbytes)
-        total = sum(2 * nb for _, _, _, nb in self._meta.values())
+            base = os.path.join(
+                self.swap_dir, f"{key.replace('/', '__')}-{digest}")
+            self._meta[key] = (base, tuple(leaf.shape), dt)
+            total += 2 * int(np.prod(leaf.shape)) * dt.itemsize
         log_dist(f"NVMe optimizer swap: {len(self._meta)} leaves, "
-                 f"{total / 1e9:.2f} GB of moments at {self.swap_dir}",
-                 ranks=[0])
+                 f"{total / 1e9:.2f} GB of moments (full tree) at "
+                 f"{self.swap_dir}; this process swaps its addressable "
+                 "shards", ranks=[0])
 
     # -- per-step IO ----------------------------------------------------
 
-    def start_read(self, key: str) -> Optional[Tuple[int, int, np.ndarray,
-                                                     np.ndarray]]:
-        """Begin the async moment read for ``key``; None if zero-init."""
-        fname, shape, dt, nbytes = self._meta[key]
-        if key not in self._initialized:
-            return None
-        m = np.empty(shape, dt)
-        v = np.empty(shape, dt)
-        op_m = self.handle.async_pread(m, fname, 0)
-        op_v = self.handle.async_pread(v, fname, nbytes)
-        return op_m, op_v, m, v
+    # Moment files are PER ADDRESSABLE SHARD: ``<leaf>.<index-tag>.bin``.
+    # Each process reads/writes only the slices its devices own, which is
+    # what lifts the old single-controller restriction — a multi-host job
+    # swaps its local ZeRO shards and never materializes a full leaf
+    # (reference partitioned_optimizer_swapper semantics: every rank swaps
+    # its own partition).
 
-    def finish_read(self, key: str, started) -> Tuple[np.ndarray, np.ndarray]:
-        _, shape, dt, _ = self._meta[key]
-        if started is None:
-            z = np.zeros(shape, dt)
-            return z, z.copy()
-        op_m, op_v, m, v = started
-        self.handle.wait(op_m)
-        self.handle.wait(op_v)
-        return m, v
+    def _shard_fname(self, key: str, tag: str) -> str:
+        return f"{self._meta[key][0]}.{tag}.bin"
 
-    def write(self, key: str, m: np.ndarray, v: np.ndarray) -> None:
-        fname, _, dt, nbytes = self._meta[key]
+    def start_read(self, key: str, leaf) -> Dict[tuple, Optional[tuple]]:
+        """Begin async moment reads for every distinct local shard of
+        ``leaf``; entries are None where moments are zero-init."""
+        dt = self._meta[key][2]
+        out: Dict[tuple, Optional[tuple]] = {}
+        for idx, sh in _unique_shards(leaf).items():
+            tag = _idx_tag(idx)
+            if (key, tag) not in self._initialized:
+                if self._restored and not self._reshard_warned:
+                    # shard tags are topology-keyed: a resumed run on a
+                    # DIFFERENT process/device layout cannot match the
+                    # saved moment files — moments restart zero.  (The
+                    # params themselves reshard fine via the checkpoint
+                    # store; only NVMe-swapped moments are layout-bound —
+                    # resuming an NVMe-swap run on a new topology should
+                    # go through a device-resident optimizer checkpoint.)
+                    self._reshard_warned = True
+                    logger.warning(
+                        f"NVMe swap: restored moment set has no shard "
+                        f"for {key!r} under the CURRENT sharding — the "
+                        "topology changed since save; affected moments "
+                        "restart from zero")
+                out[idx] = None
+                continue
+            shp = tuple(sh.data.shape)
+            nbytes = int(np.prod(shp)) * dt.itemsize
+            m = np.empty(shp, dt)
+            v = np.empty(shp, dt)
+            fname = self._shard_fname(key, tag)
+            out[idx] = (self.handle.async_pread(m, fname, 0),
+                        self.handle.async_pread(v, fname, nbytes), m, v)
+        return out
+
+    def finish_read(self, key: str, leaf, started) -> Tuple[Any, Any]:
+        """Join the shard reads and assemble GLOBAL moment arrays with the
+        param leaf's sharding (each process contributes its local
+        shards)."""
+        dt = self._meta[key][2]
+        vals: Dict[tuple, Tuple[np.ndarray, np.ndarray]] = {}
+        for idx, st in started.items():
+            if st is None:
+                shp = tuple(b - a for a, b in idx)
+                vals[idx] = (np.zeros(shp, dt), np.zeros(shp, dt))
+            else:
+                op_m, op_v, m, v = st
+                self.handle.wait(op_m)
+                self.handle.wait(op_v)
+                vals[idx] = (m, v)
+        shards = leaf.addressable_shards
+        m_parts = [jax.device_put(vals[_norm_index(s.index, leaf.shape)][0],
+                                  s.device) for s in shards]
+        v_parts = [jax.device_put(vals[_norm_index(s.index, leaf.shape)][1],
+                                  s.device) for s in shards]
+        spec = jax.sharding.NamedSharding(
+            leaf.sharding.mesh, leaf.sharding.spec) \
+            if hasattr(leaf.sharding, "spec") else leaf.sharding
+        m_dev = jax.make_array_from_single_device_arrays(
+            leaf.shape, spec, m_parts)
+        v_dev = jax.make_array_from_single_device_arrays(
+            leaf.shape, spec, v_parts)
+        return m_dev, v_dev
+
+    def write(self, key: str, m_new, v_new) -> None:
+        """Write this process's shards of the updated moments."""
+        dt = self._meta[key][2]
         from deepspeed_tpu.io.aio import _pretruncate
 
-        _pretruncate(fname, 2 * nbytes, exact=False)
-        self._pending.append(self.handle.async_pwrite(
-            np.ascontiguousarray(m, dtype=dt), fname, 0, _truncate=False))
-        self._pending.append(self.handle.async_pwrite(
-            np.ascontiguousarray(v, dtype=dt), fname, nbytes,
-            _truncate=False))
-        self._initialized.add(key)
+        v_shards = _unique_shards(v_new)
+        for idx, m_sh in _unique_shards(m_new).items():
+            tag = _idx_tag(idx)
+            fname = self._shard_fname(key, tag)
+            m_np = np.ascontiguousarray(np.asarray(m_sh.data), dtype=dt)
+            v_np = np.ascontiguousarray(np.asarray(v_shards[idx].data),
+                                        dtype=dt)
+            _pretruncate(fname, 2 * m_np.nbytes, exact=False)
+            self._pending.append(self.handle.async_pwrite(
+                m_np, fname, 0, _truncate=False))
+            self._pending.append(self.handle.async_pwrite(
+                v_np, fname, m_np.nbytes, _truncate=False))
+            self._initialized.add((key, tag))
 
     def drain(self) -> None:
         """Wait EVERY pending write (even after one fails — a raised
@@ -277,18 +366,15 @@ class NvmeOptimizerSwapper:
         try:
             if todo:
                 i0 = todo[0]
-                started[i0] = self.start_read(keys[i0])
+                started[i0] = self.start_read(keys[i0], leaves[i0])
             new_leaves = list(leaves)
             for pos, i in enumerate(todo):
                 if pos + 1 < len(todo):                 # prefetch next leaf
                     nxt = todo[pos + 1]
-                    started[nxt] = self.start_read(keys[nxt])
-                m, v = self.finish_read(keys[i], started.pop(i))
+                    started[nxt] = self.start_read(keys[nxt], leaves[nxt])
                 p, g = leaves[i], flat_g[i]
-                m_dev = jax.device_put(m, p.sharding if hasattr(p, "sharding")
-                                       else None)
-                v_dev = jax.device_put(v, p.sharding if hasattr(p, "sharding")
-                                       else None)
+                m_dev, v_dev = self.finish_read(keys[i], p,
+                                                started.pop(i))
                 p_new, m_new, v_new = _adam_update(
                     p, g, m_dev, v_dev, count, lr, gscale,
                     self.b1, self.b2, self.eps, self.wd, self.adam_w_mode)
@@ -298,8 +384,7 @@ class NvmeOptimizerSwapper:
                     # output lands in default device memory otherwise
                     p_new = jax.device_put(p_new, p.sharding)
                 new_leaves[i] = p_new
-                self.write(keys[i], np.asarray(jax.device_get(m_new)),
-                           np.asarray(jax.device_get(v_new)))
+                self.write(keys[i], m_new, v_new)
             ok = True
         finally:
             # drain whatever was issued — leaked in-flight ops would race a
@@ -307,8 +392,10 @@ class NvmeOptimizerSwapper:
             # themselves can raise (that IS the failure mode being handled),
             # so every step is individually guarded: the `if not ok`
             # invalidation must run no matter what.
-            for st in started.values():
-                if st is not None:
+            for per_shard in started.values():
+                for st in per_shard.values():
+                    if st is None:
+                        continue
                     for op in (st[0], st[1]):
                         try:
                             self.handle.wait(op)
@@ -342,14 +429,18 @@ class NvmeOptimizerSwapper:
         out = os.path.join(ckpt_dir, "nvme_optimizer")
         os.makedirs(out, exist_ok=True)
         self.drain()
-        for key in self._initialized:
-            fname = self._meta[key][0]
+        for key, tag in self._initialized:
+            fname = self._shard_fname(key, tag)
             shutil.copy2(fname, os.path.join(out, os.path.basename(fname)))
-        with open(os.path.join(out, "swap_meta.json"), "w") as f:
+        # one meta file per process: each process's shard set is disjoint
+        # (multi-host swap — reference rank-local partition semantics)
+        meta_name = f"swap_meta.p{jax.process_index()}.json"
+        with open(os.path.join(out, meta_name), "w") as f:
             import json
 
             json.dump({"count": self.count,
-                       "initialized": sorted(self._initialized),
+                       "initialized": sorted(list(t)
+                                             for t in self._initialized),
                        "adam_w_mode": self.adam_w_mode,
                        "betas": [self.b1, self.b2], "eps": self.eps,
                        "weight_decay": self.wd}, f)
@@ -360,7 +451,8 @@ class NvmeOptimizerSwapper:
         import json
 
         src = os.path.join(ckpt_dir, "nvme_optimizer")
-        meta_f = os.path.join(src, "swap_meta.json")
+        meta_f = os.path.join(
+            src, f"swap_meta.p{jax.process_index()}.json")
         if not os.path.exists(meta_f):
             logger.warning("checkpoint has no NVMe-swapped optimizer state; "
                            "moments start fresh")
@@ -379,12 +471,14 @@ class NvmeOptimizerSwapper:
                 "resuming applies the NEW coefficients to the old moments")
         self.count = int(meta["count"])
         self._initialized = set()
-        for key in meta["initialized"]:
+        for entry in meta["initialized"]:
+            key, tag = entry
             if key not in self._meta:
                 logger.warning(f"swapped state for unknown param {key!r} "
                                "ignored")
                 continue
-            fname = self._meta[key][0]
+            fname = self._shard_fname(key, tag)
             shutil.copy2(os.path.join(src, os.path.basename(fname)), fname)
-            self._initialized.add(key)
+            self._initialized.add((key, tag))
+        self._restored = True
         return True

@@ -1,0 +1,92 @@
+"""Two-process jax.distributed coverage (reference
+tests/unit/common.py:129 DistributedExec: every distributed test spawns
+real worker processes; here two 4-device CPU processes form one 8-device
+mesh).  Exercises: multi-process train step over a ZeRO-3 mesh, the
+cross-process sharded checkpoint (per-process shard files + completeness
+meta), and the NVMe optimizer swapper's per-process shard swap."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "worker_train.py")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch(mode: str, scratch: str, nproc: int = 2, timeout: int = 480):
+    port = _free_port()
+    procs = []
+    for pid in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "DSTPU_COORD": f"127.0.0.1:{port}",
+            "DSTPU_NPROC": str(nproc),
+            "DSTPU_PID": str(pid),
+            "DSTPU_MODE": mode,
+            "DSTPU_DIR": scratch,
+            "JAX_PLATFORMS": "cpu",
+            # the workers size their own 4-device backend; scrub any
+            # inherited forcing from the test session
+            "XLA_FLAGS": "",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                rec = json.loads(line[len("RESULT "):])
+                results[rec["pid"]] = rec
+    assert len(results) == nproc, f"missing RESULT lines:\n{outs}"
+    return results
+
+
+@pytest.mark.parametrize("mode", ["train", "nvme"])
+def test_two_process_zero3_train_checkpoint(tmp_path, mode):
+    results = _launch(mode, str(tmp_path))
+    r0, r1 = results[0], results[1]
+    # SPMD: both controllers observe identical global losses
+    np.testing.assert_allclose(r0["losses"], r1["losses"], rtol=1e-6)
+    assert r0["losses"][-1] < r0["losses"][0], "no learning"
+    # the resumed engine continues exactly like the original
+    np.testing.assert_allclose(r0["l_resume"], r0["l_orig"], rtol=1e-5)
+    # checkpoint holds per-process shard blobs + indices + done markers
+    # from BOTH processes, and the meta records the process count
+    ckpt = tmp_path / "ckpt" / "t"
+    names = {p.name for p in ckpt.iterdir()}
+    for pid in (0, 1):
+        assert {f"shards_p{pid}.bin", f"index_p{pid}.json",
+                f"done_p{pid}"} <= names, names
+    import json as _json
+
+    meta = _json.loads((ckpt / "ds_meta.json").read_text())
+    assert meta.get("process_count") == 2
+    if mode == "nvme":
+        # per-process swapper meta saved alongside
+        nv = ckpt / "nvme_optimizer"
+        assert (nv / "swap_meta.p0.json").exists()
+        assert (nv / "swap_meta.p1.json").exists()

@@ -154,10 +154,16 @@ def test_nvme_optimizer_parity(tmp_path, devices):
             np.asarray(jax.device_get(a)), np.asarray(jax.device_get(b)),
             atol=2e-3, rtol=0, err_msg=str(kp))
     # and the swapped moments themselves match the optax state tightly
+    from deepspeed_tpu.checkpoint.sharded import path_str
+
     adam_state = jax.device_get(ref.state.opt_state)[0]
     key = "params/transformer/h/block/attn/c_attn/bias"
-    m_disk, v_disk = nvme.nvme_swapper.finish_read(
-        key, nvme.nvme_swapper.start_read(key))
+    leaf = next(lf for kp, lf in jax.tree_util.tree_flatten_with_path(
+        nvme.state.params)[0] if path_str(kp) == key)
+    m_dev, v_dev = nvme.nvme_swapper.finish_read(
+        key, leaf, nvme.nvme_swapper.start_read(key, leaf))
+    m_disk = np.asarray(jax.device_get(m_dev))
+    v_disk = np.asarray(jax.device_get(v_dev))
     mu = np.asarray(adam_state.mu["params"]["transformer"]["h"]["block"]
                     ["attn"]["c_attn"]["bias"])
     nu = np.asarray(adam_state.nu["params"]["transformer"]["h"]["block"]
@@ -165,10 +171,10 @@ def test_nvme_optimizer_parity(tmp_path, devices):
     np.testing.assert_allclose(mu, m_disk, atol=1e-6)
     np.testing.assert_allclose(nu, v_disk, atol=1e-8)
     assert int(adam_state.count) == nvme.nvme_swapper.count == 3
-    # moments really live on disk
+    # moments really live on disk, one file per addressable shard
     assert nvme.nvme_swapper._initialized
-    f = nvme.nvme_swapper._meta[sorted(nvme.nvme_swapper._initialized)[0]][0]
-    assert os.path.getsize(f) > 0
+    k0, tag0 = sorted(nvme.nvme_swapper._initialized)[0]
+    assert os.path.getsize(nvme.nvme_swapper._shard_fname(k0, tag0)) > 0
 
 
 def test_nvme_checkpoint_roundtrip(tmp_path, devices):
@@ -219,11 +225,24 @@ def test_nvme_bf16_moments_stay_fp32(tmp_path, devices):
         batch=random_tokens(8, seed=s)))) for s in range(4)]
     assert all(np.isfinite(x) for x in losses)
     assert losses[-1] < losses[0]
-    key = sorted(eng.nvme_swapper._initialized)[0]
-    fname, shape, dt, nbytes = eng.nvme_swapper._meta[key]
+    from deepspeed_tpu.checkpoint.sharded import path_str
+
+    key, tag = sorted(eng.nvme_swapper._initialized)[0]
+    _, shape, dt = eng.nvme_swapper._meta[key]
     assert dt == np.float32
-    assert os.path.getsize(fname) == 2 * int(np.prod(shape)) * 4
-    m, v = eng.nvme_swapper.finish_read(key, eng.nvme_swapper.start_read(key))
+    leaf = next(lf for kp, lf in jax.tree_util.tree_flatten_with_path(
+        eng.state.params)[0] if path_str(kp) == key)
+    # the leaf's unique shard files together hold 2x fp32 of the leaf
+    # (replicated leaves have one full-extent shard file)
+    shard_bytes = sum(
+        os.path.getsize(eng.nvme_swapper._shard_fname(k, t))
+        for k, t in eng.nvme_swapper._initialized if k == key)
+    assert shard_bytes == 2 * 4 * int(np.prod(shape))
+    m_dev, v_dev = eng.nvme_swapper.finish_read(
+        key, leaf, eng.nvme_swapper.start_read(key, leaf))
+    m = np.asarray(jax.device_get(m_dev))
+    v = np.asarray(jax.device_get(v_dev))
+    assert m.shape == tuple(shape)
     assert np.isfinite(m).all() and np.isfinite(v).all() and (v >= 0).all()
 
 
