@@ -650,11 +650,16 @@ def bench_all(args) -> None:
         else:
             records[name] = {"metric": f"config_{name}", "value": None,
                              "unit": "FAILED", "vs_baseline": 0.0}
+    # device info comes from the children's records — the parent must
+    # never touch jax: on standard TPU VMs libtpu is exclusive per
+    # process and a parent hold would fail every child's init
+    dev_info = next((r.get("detail", {}) for r in records.values()
+                     if r.get("detail", {}).get("device")), {})
     out = {
         "generated": datetime.datetime.now(
             datetime.timezone.utc).isoformat(timespec="seconds"),
-        "device": jax.devices()[0].device_kind,
-        "n_chips": len(jax.devices()),
+        "device": dev_info.get("device", "unknown"),
+        "n_chips": dev_info.get("n_chips", 1),
         "smoke": bool(args.smoke),
         "configs": records,
     }
@@ -678,12 +683,14 @@ def main() -> None:
     p.add_argument("--smoke", action="store_true",
                    help="tiny shapes (auto on CPU)")
     args = p.parse_args()
+    if args.all:
+        # children probe their own backend (and set smoke on CPU); the
+        # parent stays jax-free so it never locks an exclusive libtpu
+        bench_all(args)
+        return
     if jax.devices()[0].platform == "cpu":
         args.smoke = True
-    if args.all:
-        bench_all(args)
-    else:
-        CONFIGS[args.config](args)
+    CONFIGS[args.config](args)
 
 
 if __name__ == "__main__":

@@ -37,9 +37,16 @@ class PreemptionError(RuntimeError):
 
 
 def elastic_batch_config(ds_config: Dict, world_size: int) -> Dict:
-    """Return a copy of ``ds_config`` with the batch triple re-solved for
-    ``world_size`` by the elasticity solver (no-op when elasticity is
-    absent/disabled)."""
+    """Scheduler-side PREVIEW of the batch triple for ``world_size``
+    (no-op when elasticity is absent/disabled).
+
+    Job controllers call this out-of-band to size placements — the
+    reference contract where the scheduler and runtime independently
+    compute the same deterministic solve.  The AUTHORITATIVE solve is
+    the engine's (config.py ``_apply_elasticity``), which additionally
+    enforces the user-batch-key conflict check and the immutability
+    contract; this helper intentionally skips those runtime-only
+    validations."""
     ecfg = ds_config.get("elasticity", {})
     if not ecfg.get("enabled", False):
         return dict(ds_config)
@@ -99,14 +106,12 @@ class DSElasticAgent:
 
     def _make_engine(self, devices: Sequence[jax.Device]):
         import deepspeed_tpu.comm as dist
-        from deepspeed_tpu.comm import comm as _comm
 
         world = len(devices)
         # the config system re-solves the elastic batch triple itself for
         # the topology's dp world size (config.py _apply_elasticity) — the
         # agent only rebuilds the mesh and hands the config through
         cfg = dict(self.ds_config)
-        _comm._state.topology = None          # the old mesh is dead
         topo = dist.initialize_mesh(dp=world, devices=list(devices))
         engine = self.build_engine(topo, cfg)
         tag, _ = engine.load_checkpoint(self.ckpt_dir)

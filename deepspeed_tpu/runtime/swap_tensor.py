@@ -445,6 +445,38 @@ class NvmeOptimizerSwapper:
                        "betas": [self.b1, self.b2], "eps": self.eps,
                        "weight_decay": self.wd}, f)
 
+    def _load_legacy(self, src: str, meta_f: str) -> bool:
+        """Restore a pre-shard-format checkpoint (``swap_meta.json`` with
+        whole-leaf entries and whole-leaf moment files).  The old writer
+        was single-controller and always dumped FULL arrays, so each old
+        file maps onto the full-extent shard tag; layouts that shard a
+        leaf won't match and fall back to zero-init with the reshard
+        warning."""
+        import json
+
+        with open(meta_f) as f:
+            meta = json.load(f)
+        self.count = int(meta["count"])
+        self._initialized = set()
+        for key in meta["initialized"]:
+            if key not in self._meta:
+                logger.warning(f"swapped state for unknown param {key!r} "
+                               "ignored")
+                continue
+            base, shape, _ = self._meta[key]
+            tag = _idx_tag(tuple((0, d) for d in shape))
+            old_name = os.path.basename(base) + ".bin"
+            old_path = os.path.join(src, old_name)
+            if not os.path.exists(old_path):
+                logger.warning(f"legacy moment file {old_name} missing")
+                continue
+            shutil.copy2(old_path, self._shard_fname(key, tag))
+            self._initialized.add((key, tag))
+        self._restored = True
+        logger.info(f"migrated legacy NVMe swap meta ({len(self._initialized)} "
+                    "whole-leaf moment files)")
+        return True
+
     def load_from(self, ckpt_dir: str) -> bool:
         """Restore moment files saved by :meth:`save_to`; False when the
         checkpoint holds no swapped state (fresh moments)."""
@@ -454,6 +486,9 @@ class NvmeOptimizerSwapper:
         meta_f = os.path.join(
             src, f"swap_meta.p{jax.process_index()}.json")
         if not os.path.exists(meta_f):
+            legacy = os.path.join(src, "swap_meta.json")
+            if os.path.exists(legacy) and jax.process_index() == 0:
+                return self._load_legacy(src, legacy)
             logger.warning("checkpoint has no NVMe-swapped optimizer state; "
                            "moments start fresh")
             return False

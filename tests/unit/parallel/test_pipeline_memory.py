@@ -1,0 +1,84 @@
+"""Pipeline activation-memory watermark (VERDICT r2 weak #5; reference
+1F1B comparison point: ``runtime/pipe/schedule.py:189 TrainSchedule``).
+
+The GPipe-over-scan design stashes ONE stage-input buffer per tick for
+the backward — O(M + S - 1) ticks x [S, mb, ...] rows — where eager 1F1B
+bounds the per-stage stash at O(S) in-flight microbatches.  This is a
+DOCUMENTED divergence (see parallel/pipeline.py and README divergences):
+the stash is linear in microbatch count, contained by (a) remat over the
+stage body (only stage INPUTS are stashed, never intra-stage
+activations) and (b) the stash living in the compute dtype (bf16 in real
+configs).
+
+These tests pin that contract with compiled-memory analysis so a
+regression — e.g. a change that makes the stash quadratic, or starts
+saving intra-stage activations — fails CI:
+
+1. temp memory grows ~linearly in M (never quadratically);
+2. the M=32, S=4 watermark stays within a constant factor of the
+   modeled stash  T * S * mb * width * 4 bytes.
+"""
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel.pipeline import GPipe
+
+
+class Block(nn.Module):
+    width: int
+
+    @nn.compact
+    def __call__(self, x):
+        return x + nn.Dense(self.width)(nn.gelu(nn.Dense(self.width)(x)))
+
+
+def _train_temp_bytes(n_micro: int, n_stages: int = 4, width: int = 64,
+                      rows: int = 4, remat: str = "full") -> int:
+    """temp_size_in_bytes of a jitted fwd+bwd GPipe step at batch
+    B = n_micro * rows (mb rows per microbatch stays constant as M
+    scales — the honest apples-to-apples sweep)."""
+    B = n_micro * rows
+    x = jnp.ones((B, width), jnp.float32)
+    pipe = GPipe(Block, (width,), n_layer=n_stages * 2,
+                 n_stages=n_stages, n_micro=n_micro, remat_policy=remat)
+    params = pipe.init(jax.random.PRNGKey(0), x)
+
+    def loss(p, x):
+        return jnp.sum(pipe.apply(p, x) ** 2)
+
+    c = jax.jit(jax.value_and_grad(loss)).lower(params, x).compile()
+    return int(c.memory_analysis().temp_size_in_bytes)
+
+
+def test_stash_grows_linearly_not_quadratically(devices):
+    t8 = _train_temp_bytes(8)
+    t32 = _train_temp_bytes(32)
+    # 4x microbatches (4x batch rows): temp may grow ~4x, never ~16x.
+    # Allow 1.6x headroom over linear for allocator slack.
+    assert t32 <= t8 * 4 * 1.6, (t8, t32)
+    # and it DOES grow (the stash is real — if this starts failing, the
+    # schedule changed and the documented divergence should be revisited)
+    assert t32 >= t8, (t8, t32)
+
+
+def test_watermark_within_modeled_bound(devices):
+    M, S, width, rows = 32, 4, 64, 4
+    temp = _train_temp_bytes(M, n_stages=S, width=width, rows=rows)
+    T = M + S - 1
+    # modeled stash: per tick, the [S, mb, width] stage input (fwd stash)
+    # + the same again as bwd gradient flow, fp32; everything else is
+    # remat'd.  8x headroom covers XLA temporaries and fusion buffers.
+    stash = T * S * rows * width * 4
+    assert temp <= 8 * 2 * stash, (temp, stash)
+
+
+def test_remat_contains_intra_stage_activations(devices):
+    """Without remat the stash includes intra-stage activations (2 Dense
+    + gelu per block, 2 blocks per stage) — remat must keep the
+    watermark strictly below the no-remat compile."""
+    t_remat = _train_temp_bytes(16, remat="full")
+    t_none = _train_temp_bytes(16, remat="none")
+    assert t_remat < t_none, (t_remat, t_none)
