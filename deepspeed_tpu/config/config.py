@@ -230,10 +230,26 @@ class CSVConfig(ConfigModel):
     job_name: str = "DeepSpeedTPUJobName"
 
 
+class CometConfig(ConfigModel):
+    """``comet`` subtree (reference ``deepspeed/monitor/config.py``
+    CometConfig / ``monitor/comet.py:23``): metrics stream to a Comet
+    experiment, throttled to every ``samples_log_interval`` samples."""
+    enabled: bool = False
+    samples_log_interval: int = 100
+    project: Optional[str] = None
+    workspace: Optional[str] = None
+    api_key: Optional[str] = None
+    experiment_name: Optional[str] = None
+    experiment_key: Optional[str] = None
+    mode: Optional[str] = None
+    online: Optional[bool] = None
+
+
 class MonitorConfig(ConfigModel):
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = Field(default_factory=WandbConfig)
     csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+    comet: CometConfig = Field(default_factory=CometConfig)
 
 
 class CheckpointConfig(ConfigModel):

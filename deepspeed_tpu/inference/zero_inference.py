@@ -1,0 +1,241 @@
+"""ZeRO-Inference NVMe tier: stream layer weights from SSD per forward.
+
+TPU-native re-design of the reference's NVMe weight path
+(``runtime/swap_tensor/partitioned_param_swapper.py:37``
+``AsyncPartitionedParameterSwapper`` feeding stage-3 inference —
+"ZeRO-Inference": models whose weights exceed HBM+RAM generate by
+streaming each layer's parameters from NVMe through the device).
+
+Design: the transformer's repeated block makes every layer an identical
+compiled program, so the engine
+
+1. writes each layer's param subtree to one NVMe file at init (native
+   AIO engine, ``io/csrc/aio.cpp``);
+2. keeps only the small resident tree (embeddings, final norm, LM head)
+   in device memory;
+3. drives ONE jitted block function layer-by-layer per forward, with the
+   AIO pool prefetching layer ``i+1`` from NVMe while the device runs
+   layer ``i`` — the same host-side double buffering the optimizer
+   swapper uses.  Device residency: resident tree + two layers.
+
+Throughput is bounded by SSD bandwidth x model size per token batch —
+the reference's economics (their Llama-70B numbers run batch 96 to
+amortize each weight sweep); amortize with large batches.
+
+Llama-family models (Llama / Mistral / Qwen2; per-token positions and a
+uniform block) are supported.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class NvmeWeightStore:
+    """Per-layer param subtrees on NVMe, async-read with prefetch."""
+
+    def __init__(self, nvme_path: str, layers: List[Any],
+                 aio_block_size: int = 1 << 20, aio_thread_count: int = 8):
+        from deepspeed_tpu.io.aio import aio_handle
+
+        os.makedirs(nvme_path, exist_ok=True)
+        self.dir = nvme_path
+        self.handle = aio_handle(block_size=aio_block_size,
+                                 thread_count=aio_thread_count)
+        self._layout = None            # [(path_key, shape, dtype, offset)]
+        self.n_layers = len(layers)
+        total = 0
+        for i, tree in enumerate(layers):
+            total += self._write_layer(i, tree)
+        log_dist(f"ZeRO-Inference weight store: {self.n_layers} layers, "
+                 f"{total / 1e9:.2f} GB at {nvme_path}", ranks=[0])
+
+    def _fname(self, i: int) -> str:
+        return os.path.join(self.dir, f"layer_{i:04d}.bin")
+
+    def _write_layer(self, i: int, tree) -> int:
+        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+        layout, off = [], 0
+        bufs = []
+        for kp, leaf in flat:
+            arr = np.asarray(jax.device_get(leaf))
+            layout.append((jax.tree_util.keystr(kp), arr.shape,
+                           arr.dtype, off))
+            bufs.append(arr)
+            off += arr.nbytes
+        if self._layout is None:
+            self._layout = layout
+            self._treedef = jax.tree_util.tree_structure(tree)
+        else:
+            assert [(k, s, d) for k, s, d, _ in layout] == \
+                [(k, s, d) for k, s, d, _ in self._layout], (
+                    f"layer {i} param layout differs — streaming needs a "
+                    "uniform block")
+        from deepspeed_tpu.io.aio import _pretruncate
+
+        fname = self._fname(i)
+        _pretruncate(fname, off, exact=False)
+        ops = [self.handle.async_pwrite(
+            np.ascontiguousarray(b), fname, lay[3], _truncate=False)
+            for b, lay in zip(bufs, layout)]
+        for op in ops:
+            self.handle.wait(op)
+        return off
+
+    def start_read(self, i: int):
+        """Begin the async read of layer ``i``; returns (ops, buffers)."""
+        bufs = [np.empty(shape, dt) for _, shape, dt, _ in self._layout]
+        ops = [self.handle.async_pread(b, self._fname(i), off)
+               for b, (_, _, _, off) in zip(bufs, self._layout)]
+        return ops, bufs
+
+    def finish_read(self, started) -> Any:
+        ops, bufs = started
+        for op in ops:
+            self.handle.wait(op)
+        return jax.tree_util.tree_unflatten(self._treedef, bufs)
+
+
+class NvmeWeightStreamingEngine:
+    """Generate with layer weights resident on NVMe, not in HBM.
+
+    ``model``: a Llama-family ``*ForCausalLM`` module (unrolled twin is
+    built internally); ``params``: the full tree (host or device) —
+    consumed into the store at init.
+    """
+
+    def __init__(self, model, params: Any, nvme_path: str,
+                 max_batch_size: int = 8, max_out_tokens: int = 256,
+                 aio_block_size: int = 1 << 20, aio_thread_count: int = 8):
+        import dataclasses
+
+        from deepspeed_tpu.inference.common import (normalize_params,
+                                                    unroll_scan_params)
+
+        mcfg = getattr(model, "config", None)
+        assert mcfg is not None and hasattr(mcfg, "rope_theta"), (
+            "NVMe weight streaming supports the Llama family")
+        self.cfg = dataclasses.replace(
+            mcfg, decode=True, scan_layers=False,
+            max_cache_len=max_out_tokens)
+        from deepspeed_tpu.models import llama as _llama
+
+        self._block_cls = _llama.LlamaBlock
+        self._norm_cls = _llama.RMSNorm
+        params = normalize_params(model, params,
+                                  plain_model=type(model)(mcfg))
+        if getattr(mcfg, "scan_layers", False):
+            params = unroll_scan_params(params)
+        L = self.cfg.num_hidden_layers
+        layers = [params["model"][f"layers_{i}"] for i in range(L)]
+        # resident tree: embeddings + final norm + head (the persistent
+        # small params — reference persistence-threshold analogue)
+        self.resident = {
+            "embed": jnp.asarray(params["model"]["embed_tokens"]
+                                 ["embedding"]),
+            "norm": jax.tree_util.tree_map(jnp.asarray,
+                                           params["model"]["norm"]),
+            "head": jnp.asarray(params["lm_head"]["kernel"]),
+        }
+        self.store = NvmeWeightStore(nvme_path, layers,
+                                     aio_block_size=aio_block_size,
+                                     aio_thread_count=aio_thread_count)
+        self.max_batch_size = max_batch_size
+        self.max_out_tokens = max_out_tokens
+        self._block_fn = None
+        self._cache_shapes = None
+        log_dist(
+            "ZeRO-Inference: resident "
+            f"{sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(self.resident)) / 1e6:.1f}"
+            " MB on device; block weights stream from NVMe", ranks=[0])
+
+    # -- compiled pieces --------------------------------------------------
+
+    def _block(self):
+        if self._block_fn is not None:
+            return self._block_fn
+        block = self._block_cls(self.cfg)
+
+        def run(layer_params, cache, x, positions):
+            out, vars_ = block.apply(
+                {"params": layer_params, "cache": cache}, x, positions,
+                mutable=["cache"])
+            return out, vars_["cache"]
+
+        self._block_fn = jax.jit(run, donate_argnums=(1,))
+        return self._block_fn
+
+    def _init_layer_cache(self, batch: int):
+        block = self._block_cls(self.cfg)
+        x = jnp.zeros((batch, 1, self.cfg.hidden_size), self.cfg.dtype)
+        shapes = jax.eval_shape(
+            lambda: block.init(jax.random.PRNGKey(0), x,
+                               jnp.zeros((batch, 1), jnp.int32)))
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+    # -- forward over streamed layers ------------------------------------
+
+    def _embed(self, ids):
+        return jnp.take(self.resident["embed"], ids, axis=0).astype(
+            self.cfg.dtype)
+
+    def _head(self, x):
+        norm = self._norm_cls(self.cfg.rms_norm_eps, self.cfg.dtype)
+        x = norm.apply({"params": self.resident["norm"]}, x)
+        return x @ self.resident["head"].astype(self.cfg.dtype)
+
+    def _forward(self, ids, positions, caches) -> Tuple[jax.Array, list]:
+        """One streamed pass: embed -> L x (prefetch next; run block) ->
+        head.  ``caches``: per-layer KV trees, threaded through."""
+        L = self.store.n_layers
+        x = self._embed(ids)
+        block_fn = self._block()
+        started = self.store.start_read(0)
+        new_caches = list(caches)
+        for i in range(L):
+            layer_host = self.store.finish_read(started)
+            if i + 1 < L:
+                started = self.store.start_read(i + 1)   # overlap
+            layer_dev = jax.tree_util.tree_map(jnp.asarray, layer_host)
+            x, new_caches[i] = block_fn(layer_dev, new_caches[i], x,
+                                        positions)
+        return self._head(x), new_caches
+
+    # -- public API -------------------------------------------------------
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None) -> np.ndarray:
+        """Greedy generation with per-step weight streaming (the
+        reference ZeRO-Inference loop: every token batch pays one full
+        weight sweep — batch wide to amortize)."""
+        ids = np.asarray(input_ids, np.int32)
+        B, P = ids.shape
+        assert B <= self.max_batch_size
+        assert P + max_new_tokens <= self.max_out_tokens
+        caches = [self._init_layer_cache(B)
+                  for _ in range(self.store.n_layers)]
+        positions = jnp.broadcast_to(jnp.arange(P), (B, P))
+        logits, caches = self._forward(jnp.asarray(ids), positions, caches)
+        out = [ids]
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        for t in range(max_new_tokens - 1):
+            out.append(np.asarray(tok)[:, None])
+            pos = jnp.full((B, 1), P + t, jnp.int32)
+            logits, caches = self._forward(tok[:, None], pos, caches)
+            tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            if eos_token_id is not None and bool(
+                    np.all(np.asarray(tok) == eos_token_id)):
+                break
+        out.append(np.asarray(tok)[:, None])
+        return np.concatenate(out, axis=1)
+
+    def resident_bytes(self) -> int:
+        return sum(x.size * x.dtype.itemsize
+                   for x in jax.tree_util.tree_leaves(self.resident))

@@ -206,10 +206,44 @@ class MVAPICHRunner(MultiNodeRunner):
         return cmd + self._program()
 
 
+class IMPIRunner(MultiNodeRunner):
+    """Reference ``IMPIRunner:272`` (Intel MPI).
+
+    Intel MPI takes per-rank env through colon-separated ``-n 1 -env``
+    argument sets rather than a hostfile env broadcast; the TPU build
+    keeps the reference's structure at one process per host (our
+    process model) and disables IMPI's core pinning the way the
+    reference does (``I_MPI_PIN 0``)."""
+
+    def backend_exists(self) -> bool:
+        return shutil.which("mpirun") is not None
+
+    def validate_args(self) -> None:
+        if self.args.include or self.args.exclude:
+            raise ValueError(
+                "impi backend does not support include/exclude (filter "
+                "the hostfile instead)")
+
+    def get_cmd(self, environment: Dict[str, str]) -> List[str]:
+        cmd = ["mpirun", "-ppn", "1"] + shlex.split(self.args.launcher_args)
+        for k, v in {**self._coordinator_env(), **self.exports}.items():
+            cmd += ["-genv", k, str(v)]
+        cmd += ["-genv", "I_MPI_PIN", "0"]
+        cmd += ["-hosts", ",".join(self.resource_pool)]
+        per_rank: List[str] = []
+        for i in range(self.process_count):
+            if per_rank:
+                per_rank.append(":")
+            per_rank += (["-n", "1", "-env", "DSTPU_PROCESS_ID", str(i)]
+                         + self._program())
+        return cmd + per_rank
+
+
 RUNNERS = {
     "pdsh": PDSHRunner,
     "openmpi": OpenMPIRunner,
     "mpich": MPICHRunner,
+    "impi": IMPIRunner,
     "slurm": SlurmRunner,
     "mvapich": MVAPICHRunner,
 }

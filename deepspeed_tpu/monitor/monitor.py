@@ -94,6 +94,52 @@ class WandbMonitor(Monitor):
             self._wandb.log({name: value}, step=step)
 
 
+class CometMonitor(Monitor):
+    """``comet`` config subtree (reference ``monitor/comet.py:23``
+    CometMonitor): stream to a comet_ml experiment, throttling each
+    metric name to every ``samples_log_interval`` samples."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.samples_log_interval = int(
+            getattr(config, "samples_log_interval", 100) or 100)
+        self._last_logged = {}
+        self.experiment = None
+        if self.enabled:
+            try:
+                import comet_ml
+
+                self.experiment = comet_ml.start(
+                    api_key=getattr(config, "api_key", None),
+                    project=getattr(config, "project", None),
+                    workspace=getattr(config, "workspace", None),
+                    experiment_key=getattr(config, "experiment_key", None),
+                    mode=getattr(config, "mode", None),
+                    online=getattr(config, "online", None))
+                name = getattr(config, "experiment_name", None)
+                if name:
+                    self.experiment.set_name(name)
+            except Exception as e:
+                logger.warning(f"comet_ml unavailable ({e}); disabled")
+                self.enabled = False
+
+    def _needs_logging(self, name: str, step: int) -> bool:
+        last = self._last_logged.get(name)
+        if last is not None and step - last < self.samples_log_interval \
+                and step != last:
+            return False
+        self._last_logged[name] = step
+        return True
+
+    def write_events(self, events: List[Event]) -> None:
+        if not self.enabled or self.experiment is None:
+            return
+        for name, value, step in events:
+            if self._needs_logging(name, step):
+                self.experiment.log_metric(name=name, value=value,
+                                           step=step)
+
+
 class MonitorMaster(Monitor):
     """Fan-out to every enabled writer; only process 0 writes."""
 
@@ -101,13 +147,15 @@ class MonitorMaster(Monitor):
         self.tb = TensorBoardMonitor(monitor_config.tensorboard)
         self.csv = CSVMonitor(monitor_config.csv_monitor)
         self.wandb = WandbMonitor(monitor_config.wandb)
-        self.enabled = self.tb.enabled or self.csv.enabled or self.wandb.enabled
+        self.comet = CometMonitor(getattr(monitor_config, "comet", None))
+        self.enabled = (self.tb.enabled or self.csv.enabled or
+                        self.wandb.enabled or self.comet.enabled)
 
     def write_events(self, events: List[Event]) -> None:
         import jax
 
         if jax.process_index() != 0:
             return
-        for m in (self.tb, self.csv, self.wandb):
+        for m in (self.tb, self.csv, self.wandb, self.comet):
             if m.enabled:
                 m.write_events(events)

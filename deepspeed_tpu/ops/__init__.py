@@ -1,3 +1,5 @@
+from deepspeed_tpu.ops.evoformer import (DS4Sci_EvoformerAttention,
+                                         evoformer_attention)
 from deepspeed_tpu.ops.flash_attention import flash_attention
 from deepspeed_tpu.ops.fused_adam import (scale_by_fused_adam,
                                           scale_by_fused_lion)
@@ -12,7 +14,7 @@ from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
                                                 block_sparse_attention)
 
 __all__ = [
-    "flash_attention", "scale_by_fused_adam", "scale_by_fused_lion",
+    "flash_attention", "evoformer_attention", "DS4Sci_EvoformerAttention", "scale_by_fused_adam", "scale_by_fused_lion",
     "quantize", "dequantize", "quantize_fp8", "dequantize_fp8",
     "quantize_fp6", "dequantize_fp6", "block_sparse_attention",
     "SparseSelfAttention", "FixedSparsityConfig", "BigBirdSparsityConfig",

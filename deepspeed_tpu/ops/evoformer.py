@@ -1,0 +1,113 @@
+"""Evoformer attention (DeepSpeed4Science parity).
+
+TPU-native equivalent of the reference's CUTLASS Evoformer kernel
+(``csrc/deepspeed4science/evoformer_attn/``, Python surface
+``deepspeed/ops/deepspeed4science/evoformer_attn.py``
+``DS4Sci_EvoformerAttention``): attention over AlphaFold-family
+activations ``[batch, n_seq, seq_len, heads, dim]`` with up to two
+additive biases — the MSA mask bias ``[B, N, 1, 1, S]`` and the pair
+bias ``[B, 1, H, S, S]`` — broadcast onto the logits.
+
+Where the reference hand-fuses a CUTLASS kernel for memory efficiency,
+this is a blockwise online-softmax ``lax.scan`` over key blocks: O(S)
+live memory per query row, fp32 accumulation, differentiable through
+JAX AD (wrap in ``jax.checkpoint`` for long-sequence training).  The
+MXU sees plain batched matmuls, which is exactly what XLA tiles best —
+no custom kernel is load-bearing here, so none is written.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG = -0.7 * float(np.finfo(np.float32).max)
+
+
+def evoformer_attention_reference(q, k, v, biases: Sequence = (),
+                                  sm_scale: Optional[float] = None):
+    """Naive O(S^2)-memory oracle (the reference's torch fallback)."""
+    B, N, S, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
+    logits = jnp.einsum("bnqhd,bnkhd->bnhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    for b in biases:
+        logits = logits + b.astype(jnp.float32)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bnhqk,bnkhd->bnqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def evoformer_attention(q, k, v, biases: Sequence = (),
+                        sm_scale: Optional[float] = None,
+                        block_k: int = 512):
+    """Memory-efficient Evoformer attention.
+
+    q, k, v: ``[B, N, S, H, D]``; ``biases``: up to two arrays
+    broadcastable to ``[B, N, H, S, S]`` (reference contract: the mask
+    bias ``[B, N, 1, 1, S]`` and the pair bias ``[B, 1, H, S, S]``).
+    Returns ``[B, N, S, H, D]`` in ``q.dtype``.
+    """
+    B, N, S, H, D = q.shape
+    assert k.shape == v.shape == q.shape, (q.shape, k.shape, v.shape)
+    assert len(biases) <= 2, "reference API accepts at most two biases"
+    scale = sm_scale if sm_scale is not None else 1.0 / np.sqrt(D)
+    block_k = min(block_k, S)
+    nk = -(-S // block_k)
+    pad = nk * block_k - S
+
+    # head-major layout for the scan: [B, N, H, S, D]
+    qt = (q.astype(jnp.float32) * scale).transpose(0, 1, 3, 2, 4)
+    kt = k.astype(jnp.float32).transpose(0, 1, 3, 2, 4)
+    vt = v.astype(jnp.float32).transpose(0, 1, 3, 2, 4)
+    if pad:
+        kt = jnp.pad(kt, ((0, 0),) * 3 + ((0, pad), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0),) * 3 + ((0, pad), (0, 0)))
+    # biases are SLICED per key block inside the scan — never broadcast
+    # to the full [B, N, H, S, S] (an N*H*S*S blow-up for the typical
+    # [B,N,1,1,S] mask + [B,1,H,S,S] pair pair); only each bias's last
+    # (key) dim is padded to the block grid
+    biases_p = []
+    for b in biases:
+        b = b.astype(jnp.float32)
+        assert b.shape[-1] == S, (
+            f"bias key dim {b.shape[-1]} != seq len {S}")
+        if pad:
+            b = jnp.pad(b, ((0, 0),) * (b.ndim - 1) + ((0, pad),),
+                        constant_values=_NEG)
+        biases_p.append(b)
+    key_valid = (jnp.arange(nk * block_k) < S)
+
+    kb = kt.reshape(B, N, H, nk, block_k, D).transpose(3, 0, 1, 2, 4, 5)
+    vb = vt.reshape(B, N, H, nk, block_k, D).transpose(3, 0, 1, 2, 4, 5)
+    validb = key_valid.reshape(nk, block_k)
+
+    def step(carry, blk):
+        acc, m, l = carry
+        j, kblk, vblk, vmask = blk
+        s = jnp.einsum("bnhqd,bnhkd->bnhqk", qt, kblk)
+        for b in biases_p:
+            s = s + jax.lax.dynamic_slice_in_dim(
+                b, j * block_k, block_k, axis=b.ndim - 1)
+        s = jnp.where(vmask[None, None, None, None, :], s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bnhqk,bnhkd->bnhqd", p, vblk)
+        return (acc_new, m_new, l_new), None
+
+    acc0 = jnp.zeros((B, N, H, S, D), jnp.float32)
+    m0 = jnp.full((B, N, H, S), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, N, H, S), jnp.float32)
+    xs = (jnp.arange(nk), kb, vb, validb)
+    (acc, m, l), _ = jax.lax.scan(step, (acc0, m0, l0), xs)
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return out.transpose(0, 1, 3, 2, 4).astype(q.dtype)
+
+
+# reference-named alias (deepspeed/ops/deepspeed4science/evoformer_attn.py)
+DS4Sci_EvoformerAttention = evoformer_attention

@@ -138,3 +138,35 @@ class TestRunnerCommands:
         exports = [c for c in r.get_cmd({})
                    if c.startswith("--export=ALL")][0]
         assert "DSTPU_COORDINATOR=10.0.0.9:12345" in exports
+
+
+class TestIMPIRunner:
+    def test_impi_cmd(self):
+        from deepspeed_tpu.launcher.multinode_runner import IMPIRunner
+
+        r = IMPIRunner(args(), POOL)
+        r.add_export("I_MPI_DEBUG", "5")
+        cmd = r.get_cmd({})
+        assert cmd[:3] == ["mpirun", "-ppn", "1"]
+        # env broadcast incl. coordinator + pin-off, reference I_MPI_PIN 0
+        assert "DSTPU_COORDINATOR" in cmd and "I_MPI_PIN" in cmd
+        assert "I_MPI_DEBUG" in cmd
+        i = cmd.index("-hosts")
+        assert cmd[i + 1] == "worker-0,worker-1,worker-2"
+        # per-rank colon-separated -n 1 sets with explicit process ids
+        assert cmd.count(":") == 2
+        assert cmd.count("DSTPU_PROCESS_ID") == 3
+        assert "train.py" in cmd
+
+    def test_impi_rejects_include(self):
+        from deepspeed_tpu.launcher.multinode_runner import IMPIRunner
+
+        with pytest.raises(ValueError):
+            IMPIRunner(args(include="worker-0"), POOL)
+
+    def test_impi_registered(self):
+        from deepspeed_tpu.launcher.multinode_runner import (RUNNERS,
+                                                             get_runner)
+
+        assert "impi" in RUNNERS
+        assert get_runner("impi", args(), POOL).name == "impi"

@@ -1,0 +1,76 @@
+"""Monitor writer tests (reference tests/unit/monitor/test_monitor.py):
+CSV output shape, master fan-out, and the Comet writer's sample-interval
+throttling (against a fake comet_ml — the real SDK isn't in the image,
+mirroring how the reference skips without comet installed)."""
+import csv
+import sys
+import types
+
+import pytest
+
+from deepspeed_tpu.config.config import CometConfig, CSVConfig
+from deepspeed_tpu.monitor.monitor import CometMonitor, CSVMonitor
+
+
+def test_csv_monitor_writes_rows(tmp_path):
+    cfg = CSVConfig(enabled=True, output_path=str(tmp_path), job_name="j")
+    m = CSVMonitor(cfg)
+    m.write_events([("Train/loss", 1.5, 1), ("Train/loss", 1.2, 2)])
+    with open(tmp_path / "j" / "Train_loss.csv") as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["step", "Train/loss"]
+    assert [r[1] for r in rows[1:]] == ["1.5", "1.2"]
+
+
+class _FakeExperiment:
+    def __init__(self):
+        self.logged = []
+        self.name = None
+
+    def log_metric(self, name, value, step):
+        self.logged.append((name, value, step))
+
+    def set_name(self, name):
+        self.name = name
+
+
+@pytest.fixture()
+def fake_comet(monkeypatch):
+    exp = _FakeExperiment()
+    mod = types.ModuleType("comet_ml")
+    mod.start = lambda **kw: exp
+    monkeypatch.setitem(sys.modules, "comet_ml", mod)
+    return exp
+
+
+def test_comet_monitor_throttles_by_sample_interval(fake_comet):
+    cfg = CometConfig(enabled=True, samples_log_interval=10,
+                      experiment_name="run-1")
+    m = CometMonitor(cfg)
+    assert m.enabled and fake_comet.name == "run-1"
+    for step in (0, 5, 9, 10, 15, 20):
+        m.write_events([("Train/loss", float(step), step)])
+    # logged at 0, then next at >= 10, then >= 20
+    assert [s for _, _, s in fake_comet.logged] == [0, 10, 20]
+    # a different metric name throttles independently
+    m.write_events([("Train/lr", 0.1, 20)])
+    assert ("Train/lr", 0.1, 20) in fake_comet.logged
+
+
+def test_comet_monitor_disabled_without_sdk(monkeypatch):
+    monkeypatch.setitem(sys.modules, "comet_ml", None)
+    m = CometMonitor(CometConfig(enabled=True))
+    assert not m.enabled                 # degraded gracefully, no raise
+    m.write_events([("x", 1.0, 1)])      # no-op
+
+
+def test_master_includes_comet(fake_comet):
+    from deepspeed_tpu.config.config import MonitorConfig
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+    mc = MonitorConfig(comet=CometConfig(enabled=True,
+                                         samples_log_interval=1))
+    master = MonitorMaster(mc)
+    assert master.enabled
+    master.write_events([("Train/loss", 2.0, 1)])
+    assert fake_comet.logged == [("Train/loss", 2.0, 1)]
