@@ -183,28 +183,67 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
     cu_q_lens = ragged_meta["cu_q_lens"]
     num_seqs = ragged_meta["num_seqs"]
     page_indices = ragged_meta["page_indices"]
+    window = getattr(cfg, "sliding_window", None)
 
-    # the vLLM-TPU kernel is built for head_dim 128 (its lane-width row
-    # stats assert on smaller D); other dims take the XLA reference —
-    # correct but O(T * total_page_rows), serving-shape models should use
-    # 128-dim heads
-    if jax.default_backend() == "tpu" and D == 128:
-        from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
-            kernel as rpa)
+    def attend(qt, pages, kv_lens, page_indices, cu_q_lens, num_seqs):
+        # the vLLM-TPU kernel is built for head_dim 128 (its lane-width
+        # row stats assert on smaller D); other dims take the XLA
+        # reference — correct but O(T * total_page_rows), serving-shape
+        # models should use 128-dim heads
+        if jax.default_backend() == "tpu" and D == 128:
+            from jax.experimental.pallas.ops.tpu.ragged_paged_attention \
+                import kernel as rpa
 
-        y = rpa.ragged_paged_attention(
-            qt, pages, kv_lens, jnp.maximum(page_indices, 0), cu_q_lens,
-            num_seqs, sm_scale=sm_scale,
-            sliding_window=getattr(cfg, "sliding_window", None))
-    else:
+            return rpa.ragged_paged_attention(
+                qt, pages, kv_lens, jnp.maximum(page_indices, 0),
+                cu_q_lens, num_seqs, sm_scale=sm_scale,
+                sliding_window=window)
         if jax.default_backend() == "tpu":
             from deepspeed_tpu.utils.logging import logger
 
             logger.warning(
                 f"paged attention: head_dim={D} != 128 — the Pallas "
                 "ragged kernel needs 128; using the dense XLA fallback")
-        y = ref_paged_attention(
+        return ref_paged_attention(
             qt, pages, kv_lens, page_indices, cu_q_lens, num_seqs,
-            sm_scale=sm_scale,
-            sliding_window=getattr(cfg, "sliding_window", None))
+            sm_scale=sm_scale, sliding_window=window)
+
+    # TP serving (reference v2 sharding/attn.py: heads split over the TP
+    # group): attention is embarrassingly parallel over heads, so under a
+    # >1 `tensor` mesh axis run it shard_map-manual over `tensor` with q
+    # and the KV pages head-sharded and the ragged metadata replicated —
+    # required for the Pallas kernel, which composes with shard_map, not
+    # with GSPMD auto-sharding
+    tp = _serving_tp(cfg)
+    if tp > 1:
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.sequence.layer import resolve_mesh
+
+        assert H % tp == 0 and Hkv % tp == 0, (
+            f"TP serving requires heads divisible by tp={tp} "
+            f"(H={H}, Hkv={Hkv})")
+        mesh = resolve_mesh(None, "tensor")
+        y = jax.shard_map(
+            attend, mesh=mesh,
+            in_specs=(P(None, "tensor", None),
+                      P(None, None, "tensor", None), P(), P(), P(), P()),
+            out_specs=P(None, "tensor", None),
+            axis_names={"tensor"}, check_vma=False)(
+                qt, pages, kv_lens, page_indices, cu_q_lens, num_seqs)
+    else:
+        y = attend(qt, pages, kv_lens, page_indices, cu_q_lens, num_seqs)
     return y.transpose(1, 0, 2)[None]                  # [1, H, T, D]
+
+
+def _serving_tp(cfg) -> int:
+    """Tensor-parallel degree for the paged path: the model must be
+    TP-annotated AND a multi-device `tensor` mesh axis installed."""
+    if not getattr(cfg, "tensor_parallel", False):
+        return 1
+    import deepspeed_tpu.comm as dist
+
+    topo = dist.peek_topology()
+    if topo is None:
+        return 1
+    return int(topo.mesh.shape.get("tensor", 1))

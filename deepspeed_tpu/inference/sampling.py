@@ -42,3 +42,41 @@ def sample_logits(logits: jax.Array, rng: Optional[jax.Array], *,
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     assert rng is not None, "sampling needs an rng"
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_logits_batched(logits: jax.Array, rng: Optional[jax.Array],
+                          do_sample: jax.Array, temperature: jax.Array,
+                          top_k: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Per-ROW sampling configs, fully on device: next token ids [S] from
+    logits [S, V] with ``do_sample``/``temperature``/``top_k``/``top_p``
+    as [S] arrays (so one compiled program serves a continuous batch of
+    requests with heterogeneous sampling settings — the v2 engine's
+    on-device multi-tick decode needs this; the reference samples host-side
+    per request).
+
+    ``rng=None`` compiles the pure-greedy program (no sort).  ``top_k <= 0``
+    and ``top_p >= 1`` disable their filters per row.
+    """
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if rng is None:
+        return greedy
+    S, V = logits.shape
+    lg = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    # top-k: threshold at the k-th largest value (k<=0 -> keep all)
+    k = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)
+    sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(sorted_lg, (k - 1)[:, None], axis=-1)
+    lg = jnp.where(lg < kth, -jnp.inf, lg)
+    # top-p on the top-k-filtered distribution (matches sample_logits'
+    # sequential filter semantics) — re-sort so masked rows drop out
+    sorted_lg = jnp.sort(lg, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_lg, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = (cum - probs) < jnp.clip(top_p, 0.0, 1.0)[:, None]
+    kth_idx = jnp.maximum(jnp.sum(keep_sorted, axis=-1, keepdims=True) - 1,
+                          0)
+    cutoff = jnp.take_along_axis(sorted_lg, kth_idx, axis=-1)
+    lg = jnp.where(lg < cutoff, -jnp.inf, lg)
+    sampled = jax.random.categorical(rng, lg, axis=-1).astype(jnp.int32)
+    return jnp.where(do_sample, sampled, greedy)

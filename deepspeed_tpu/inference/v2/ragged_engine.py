@@ -24,10 +24,27 @@ dispatches of round 2):
 - **Attention** is the vLLM-TPU ragged paged Pallas kernel on TPU and an
   XLA-compilable reference on CPU (``inference/paged.py``).
 
-Host-side scheduling (admission, chunk budgeting, sampling, finish
-detection) is plain Python — the reference's scheduler tier is host-side
-too.  Models: the Llama family (Llama, Mixtral — per-token positions
-thread through attention, which the ragged path requires).
+Round-4 additions:
+
+- **Tensor-parallel serving** (reference v2 TP sharding,
+  ``inference/v2/model_implementations/sharding/attn.py`` + engine TP
+  groups ``inference/engine.py:247``): pass a ``topology`` with a >1
+  ``tensor`` axis — weights shard by AutoTP name rules, the paged KV pool
+  shards over its head dim, and the fused tick runs under GSPMD with the
+  paged attention shard_map-manual over ``tensor``.
+- **On-device multi-tick decode**: when every active sequence is past
+  prefill, ``step()`` dispatches ONE compiled program that runs
+  ``decode_block_size`` decode ticks in a ``lax.scan`` with on-device
+  per-sequence sampling (``sampling.sample_logits_batched``) — amortizing
+  the host round trip the reference's FastGen scheduler pays per tick to
+  1/K.  Finished sequences park on the trash page mid-block; the host
+  reconstructs outputs from the per-tick produced mask.
+
+Host-side scheduling (admission, chunk budgeting, finish detection) is
+plain Python — the reference's scheduler tier is host-side too.  Models:
+anything llama-shaped in the zoo (Llama, Mistral, Qwen2, Mixtral, ... —
+per-token positions thread through attention, which the ragged path
+requires).
 """
 from __future__ import annotations
 
@@ -42,7 +59,8 @@ import numpy as np
 
 from deepspeed_tpu.inference.paged import (PageAllocator,
                                            pages_for)
-from deepspeed_tpu.inference.sampling import sample_logits
+from deepspeed_tpu.inference.sampling import (sample_logits,
+                                              sample_logits_batched)
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -71,18 +89,18 @@ class RaggedInferenceEngineV2:
     """``put_request`` -> repeated ``step()`` -> ``get_outputs``.
 
     One ``step()`` = (admit waiting requests into free slots, reserving
-    KV pages) + ONE compiled forward over a fused token batch of
-    ``T = max_seqs + prefill_chunk`` slots: a decode token for every
-    ready sequence, the rest of the batch filled with prompt tokens
-    split across the prefilling sequences (so a tick with few decoders
-    prefills MORE than ``prefill_chunk`` — the bound is per-batch width,
-    sized so decoders never wait more than one tick).
+    KV pages) + EITHER one fused SplitFuse tick (any sequence still
+    prefilling: a decode token for every ready sequence plus prompt
+    chunks, in one ``T = max_seqs + prefill_chunk`` batch) OR one
+    ``decode_block_size``-tick on-device decode block (everyone
+    decoding).
     """
 
     def __init__(self, model, params: Any = None, max_seqs: int = 8,
                  max_seq_len: int = 512, prefill_chunk: int = 128,
                  rng: Optional[jax.Array] = None, page_size: int = 64,
-                 num_pages: Optional[int] = None):
+                 num_pages: Optional[int] = None, topology=None,
+                 decode_block_size: int = 8):
         mcfg = getattr(model, "config", None)
         assert dataclasses.is_dataclass(mcfg) and hasattr(mcfg, "decode"), \
             "ragged engine needs a model-zoo module with a decode config"
@@ -91,6 +109,18 @@ class RaggedInferenceEngineV2:
             "attention — supported by the Llama family models")
         assert hasattr(mcfg, "paged_decode"), (
             "model config predates paged ragged decode support")
+
+        import deepspeed_tpu.comm as dist
+
+        if topology is not None:
+            dist.set_topology(topology)
+        else:
+            topology = dist.peek_topology()
+        self.topology = topology
+        self.mesh = topology.mesh if topology is not None else None
+        self.tp = (topology.tensor_parallel_size
+                   if topology is not None else 1)
+
         self.page_size = int(page_size)
         self.pages_per_seq = pages_for(max_seq_len, self.page_size)
         if num_pages is None:
@@ -104,20 +134,23 @@ class RaggedInferenceEngineV2:
         self.cfg = dataclasses.replace(
             mcfg, decode=True, ragged_decode=False, paged_decode=True,
             max_cache_len=max_seq_len, scan_layers=False,
-            kv_page_size=self.page_size, kv_num_pages=self.num_pages)
+            kv_page_size=self.page_size, kv_num_pages=self.num_pages,
+            tensor_parallel=self.tp > 1)
         self.model = type(model)(self.cfg)
         self.max_seqs = max_seqs
         self.max_seq_len = max_seq_len
         self.prefill_chunk = prefill_chunk
         self.T = max_seqs + prefill_chunk          # fused batch width
+        self.decode_block_size = max(int(decode_block_size), 1)
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         from deepspeed_tpu.inference.common import normalize_params
 
-        self.params = normalize_params(
+        params = normalize_params(
             model, params,
             plain_model=type(model)(dataclasses.replace(mcfg,
                                                         decode=False)))
+        self.params = self._place_params(params)
 
         self.allocator = PageAllocator(self.num_pages, self.page_size)
         self.page_table = np.full((max_seqs, self.pages_per_seq), -1,
@@ -129,12 +162,51 @@ class RaggedInferenceEngineV2:
         self.finished: List[Request] = []
         self._unclaimed: Dict[int, np.ndarray] = {}
         self._step_fn = None
+        self._decode_block_cache: Dict[bool, Any] = {}
         self._last_tokens = np.zeros((max_seqs,), np.int32)
         log_dist(
             f"RaggedInferenceEngineV2: max_seqs={max_seqs} "
             f"max_seq_len={max_seq_len} prefill_chunk={prefill_chunk} "
-            f"pages={self.num_pages}x{self.page_size} "
+            f"pages={self.num_pages}x{self.page_size} tp={self.tp} "
+            f"decode_block={self.decode_block_size} "
             f"(paged KV, fused SplitFuse step)", ranks=[0])
+
+    # -- parameter / cache placement (TP) --------------------------------
+
+    def _place_params(self, params):
+        """TP-shard (AutoTP name rules / flax metadata) over the `tensor`
+        mesh axis, mirroring the v1 engine; replicate otherwise."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deepspeed_tpu.parallel import tensor_parallel as tp_lib
+
+        if self.tp <= 1:
+            if tp_lib.has_partitioning(params):
+                params = tp_lib.unbox_params(params)
+            return params
+        if tp_lib.has_partitioning(params):
+            specs = tp_lib.extract_partition_specs(
+                {"params": params}, self.mesh.axis_names)["params"]
+            params = tp_lib.unbox_params(params)
+        else:
+            specs = tp_lib.auto_tp_specs(params, self.tp)
+            log_dist("ragged engine AutoTP: inferred tensor-parallel "
+                     "sharding from parameter names", ranks=[0])
+        self._param_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.tree_util.tree_map(
+            lambda x, sh: jax.device_put(jnp.asarray(x), sh), params,
+            self._param_shardings)
+
+    def _cache_sharding(self, leaf_shape):
+        """KV page pools shard their combined-head dim over `tensor`
+        (reference v2 KV sharding: heads split over the TP group)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if self.tp <= 1 or len(leaf_shape) != 4:
+            return None
+        return NamedSharding(self.mesh, P(None, None, "tensor", None))
 
     # -- request API ----------------------------------------------------
 
@@ -184,8 +256,13 @@ class RaggedInferenceEngineV2:
 
         shapes = jax.eval_shape(_init)
         assert "cache" in shapes
-        return jax.tree_util.tree_map(
-            lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+        def make(s):
+            z = jnp.zeros(s.shape, s.dtype)
+            sh = self._cache_sharding(s.shape)
+            return jax.device_put(z, sh) if sh is not None else z
+
+        return jax.tree_util.tree_map(make, shapes["cache"])
 
     @staticmethod
     def _device_meta(kv_lens, page_indices, cu_q_lens, num_seqs,
@@ -224,11 +301,134 @@ class RaggedInferenceEngineV2:
         self._step_fn = jax.jit(run, donate_argnums=(1,))
         return self._step_fn
 
+    # -- the on-device decode block --------------------------------------
+
+    def _decode_block_fn(self, sampled: bool):
+        """K decode ticks per dispatch: ``lax.scan`` over fused
+        [1, max_seqs] decode forwards with on-device sampling.  The host
+        round trip the reference pays per generated token
+        (``engine_v2.py:107`` put -> schedule -> logits) amortizes to 1/K.
+        Two variants compile: pure-greedy (no sort) and per-seq sampled."""
+        if sampled in self._decode_block_cache:
+            return self._decode_block_cache[sampled]
+        from deepspeed_tpu.inference.common import (logits_of,
+                                                    unroll_scan_params)
+
+        model = self.model
+        unroll = self._unroll_params
+        S = self.max_seqs
+        K = self.decode_block_size
+        page = self.page_size
+        max_len = self.max_seq_len
+
+        def run(params, cache, last_tok, pos, active, remaining,
+                page_table, eos_ids, do_sample, temperature, top_k, top_p,
+                rng):
+            if unroll:
+                params = unroll_scan_params(params)
+
+            def tick(carry, _):
+                cache, last_tok, pos, active, remaining, rng = carry
+                dest_page = jnp.take_along_axis(
+                    jnp.maximum(page_table, 0),
+                    (pos // page)[:, None], axis=1)[:, 0]
+                dest = jnp.where(active, dest_page * page + pos % page, 0)
+                kv_lens = jnp.where(active, pos + 1, 1)
+                meta = {"kv_lens": kv_lens,
+                        "page_indices": page_table,
+                        "cu_q_lens": jnp.arange(S + 1, dtype=jnp.int32),
+                        "num_seqs": jnp.asarray([S], jnp.int32),
+                        "new_kv_dest": dest}
+                out, vars_ = model.apply(
+                    {"params": params, "cache": cache}, last_tok[None],
+                    positions=jnp.where(active, pos, 0)[None],
+                    mutable=["cache"], ragged_meta=meta)
+                logits = logits_of(out)[0]              # [S, V]
+                rng, sub = jax.random.split(rng)
+                nxt = sample_logits_batched(
+                    logits, sub if sampled else None, do_sample,
+                    temperature, top_k, top_p)
+                produced = active
+                nxt = jnp.where(active, nxt, last_tok)
+                hit_eos = active & (nxt == eos_ids)
+                remaining = remaining - produced.astype(jnp.int32)
+                pos = jnp.where(active, pos + 1, pos)
+                active = (active & ~hit_eos & (remaining > 0) &
+                          (pos + 1 < max_len))
+                return (vars_["cache"], nxt, pos, active, remaining,
+                        rng), (nxt, produced)
+
+            carry, (toks, mask) = jax.lax.scan(
+                tick, (cache, last_tok, pos, active, remaining, rng),
+                length=K)
+            cache, last_tok, pos, active, remaining, rng = carry
+            return cache, last_tok, toks, mask, rng
+
+        fn = jax.jit(run, donate_argnums=(1,))
+        self._decode_block_cache[sampled] = fn
+        return fn
+
+    def _step_decode_block(self, reqs: List[Request]) -> int:
+        """Run one on-device decode block and fold results back into the
+        host request state."""
+        S = self.max_seqs
+        last_tok = np.asarray(self._last_tokens, np.int32)
+        pos = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        remaining = np.zeros((S,), np.int32)
+        eos_ids = np.full((S,), -1, np.int32)
+        do_sample = np.zeros((S,), bool)
+        temperature = np.ones((S,), np.float32)
+        top_k = np.zeros((S,), np.int32)
+        top_p = np.ones((S,), np.float32)
+        for r in reqs:
+            s = r.slot
+            pos[s] = min(r.length - 1, self.max_seq_len - 1)
+            active[s] = True
+            remaining[s] = r.max_new_tokens - len(r.generated)
+            if r.eos_token_id is not None:
+                eos_ids[s] = r.eos_token_id
+            do_sample[s] = r.do_sample
+            temperature[s] = r.temperature
+            top_k[s] = r.top_k
+            top_p[s] = r.top_p
+        sampled = bool(do_sample.any())
+        self.rng, sub = jax.random.split(self.rng)
+        cache, new_last, toks, mask, _ = self._decode_block_fn(sampled)(
+            self.params, self.cache, jnp.asarray(last_tok),
+            jnp.asarray(pos), jnp.asarray(active), jnp.asarray(remaining),
+            jnp.asarray(self.page_table), jnp.asarray(eos_ids),
+            jnp.asarray(do_sample), jnp.asarray(temperature),
+            jnp.asarray(top_k), jnp.asarray(top_p), sub)
+        self.cache = cache
+        toks = np.asarray(jax.device_get(toks))         # [K, S]
+        mask = np.asarray(jax.device_get(mask))         # [K, S]
+        # np.array: device_get returns a READ-ONLY view; the SplitFuse
+        # tick assigns into _last_tokens per sampled token
+        self._last_tokens = np.array(jax.device_get(new_last))
+        produced = 0
+        for r in reqs:
+            s = r.slot
+            new = toks[mask[:, s], s]
+            r.generated.extend(int(t) for t in new)
+            produced += int(new.size)
+            self._maybe_finish(r)
+        self._reap()
+        return produced
+
     # -- the scheduler tick ----------------------------------------------
 
     def step(self) -> int:
-        """One engine iteration; returns the number of tokens produced."""
+        """One engine iteration; returns the number of tokens produced.
+
+        All-decoding batches take the multi-tick on-device block (K
+        tokens per sequence per host dispatch); any prefilling sequence
+        falls back to the fused SplitFuse tick."""
         self._admit()
+        live = [r for r in self.slots if r is not None and not r.done]
+        if (self.decode_block_size > 1 and live and
+                all(r.prefill_done >= r.prompt.size for r in live)):
+            return self._step_decode_block(live)
         plan = self._plan_tick()
         if plan is None:
             self._reap()

@@ -56,7 +56,13 @@ def elastic_batch_config(ds_config: Dict, world_size: int) -> Dict:
         ds_config, world_size=world_size, return_microbatch=True)
     # the batch triple is expressed in DATA-PARALLEL ranks: model
     # parallelism divides the world without multiplying the batch
-    dp = world_size // max(int(ecfg.get("model_parallel_size", 1)), 1)
+    mp = max(int(ecfg.get("model_parallel_size", 1)), 1)
+    assert world_size % mp == 0, (
+        f"world_size {world_size} not divisible by model_parallel_size {mp}")
+    dp = world_size // mp
+    assert batch % (micro * dp) == 0, (
+        f"elastic solve produced batch {batch} not divisible by "
+        f"micro*dp = {micro}*{dp} — inconsistent triple")
     out = dict(ds_config)
     out["train_batch_size"] = int(batch)
     out["train_micro_batch_size_per_gpu"] = int(micro)

@@ -5,10 +5,20 @@ MoE``, ``sharded_moe.py:533 MOELayer``, ``TopKGate:449``): the reference
 builds per-rank expert modules and issues explicit all-to-alls
 (``_AllToAll:96``) between gate, experts, and combine; here the experts are
 ONE stacked parameter tensor ``[E, ...]`` whose leading axis is annotated
-onto the ``expert`` mesh axis, dispatch/combine are einsums against the
-gating tensors, and XLA/GSPMD inserts the all-to-alls when the ``[E, C, M]``
-dispatched activations are sharding-constrained onto the expert axis — the
-same wire traffic, riding ICI, without hand-rolled comm.
+onto the ``expert`` mesh axis.  Two multi-chip dispatch formulations exist:
+
+- ``alltoall`` (the default on any multi-device mesh): the reference's own
+  architecture — per-shard linear (sorted, gather-only) dispatch into
+  ``[E, C_local, M]`` buffers, an explicit ``lax.all_to_all`` over the
+  ``expert`` mesh axis (``_AllToAll:96``), local expert FFNs, and the
+  inverse all-to-all — expressed as a ``jax.shard_map`` manual over the
+  token-sharding axes while the ``tensor`` axis stays under automatic
+  GSPMD (Megatron TP of the expert FFN still works).  Cost is LINEAR in
+  tokens; capacity is per shard, matching the reference's per-rank counts.
+- ``einsum``: dense one-hot dispatch/combine einsums sharding-constrained
+  onto the expert axis so GSPMD inserts the all-to-alls.  Quadratic in
+  token count (C ~ kG/E) — kept as the parity oracle and for meshes whose
+  token sharding the alltoall path cannot express.
 
 Expert-parallel composition mirrors ``groups.py:236
 _create_expert_and_data_parallel``: the ``expert`` mesh axis carries both
@@ -25,6 +35,7 @@ from typing import Any, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.moe.sharded_moe import (moe_combine, moe_combine_gather,
                                            moe_dispatch, moe_dispatch_gather,
@@ -33,6 +44,9 @@ from deepspeed_tpu.moe.sharded_moe import (moe_combine, moe_combine_gather,
 from deepspeed_tpu.utils.sharding import maybe_constrain as _maybe_constrain
 
 EXPERT_AXIS = "expert"
+# every mesh axis the flattened token dim may be sharded over (the engine's
+# batch spec: data x data_sub x expert, plus seq under sequence parallelism)
+TOKEN_AXES = ("data", "data_sub", "expert", "seq")
 
 
 class MoE(nn.Module):
@@ -59,54 +73,56 @@ class MoE(nn.Module):
     # "sorted": expert-sorted row gathers feeding the dense batched FFN —
     # linear in token count, no [G, E, C] one-hots, no scatter anywhere
     # (fwd or bwd); the TPU equivalent of the reference's grouped MoE
-    # GEMM (cutlass_ops/moe_gemm).  "einsum" is the reference's dense
-    # one-hot dispatch: G*E*C*M MACs each way (QUADRATIC in G since
-    # C ~ kG/E) but expressed purely as einsums, which GSPMD knows how
-    # to shard over the expert axis — required for expert-parallel
-    # meshes, and the parity oracle.  "gather" is the row-scatter path:
-    # measured ~20x slower on v5e (TPU scatter lowering), CPU/debug only.
-    # "auto" (default) resolves to "sorted" only when the installed
-    # topology is single-device (or absent): the plan's global argsort and
-    # data-dependent gathers defeat GSPMD partitioning of ANY sharded
-    # token or expert axis, forcing per-layer all-gathers on multi-chip
-    # meshes — dp-only meshes included, not just expert-parallel ones.
+    # GEMM (cutlass_ops/moe_gemm).  Single-device only: the plan's global
+    # argsort defeats GSPMD partitioning of sharded token axes.
+    # "alltoall": the multi-chip linear path — per-shard sorted dispatch +
+    # explicit lax.all_to_all over the expert axis under shard_map (the
+    # reference MOELayer architecture, sharded_moe.py:533).
+    # "einsum" is the reference's dense one-hot dispatch: G*E*C*M MACs
+    # each way (QUADRATIC in G since C ~ kG/E) but expressed purely as
+    # einsums, which GSPMD shards over any mesh — the parity oracle.
+    # "gather" is the row-scatter path: measured ~20x slower on v5e (TPU
+    # scatter lowering), CPU/debug only.
+    # "auto" (default) resolves to "sorted" on single-device topologies
+    # and "alltoall" on multi-device meshes (falling back to "einsum"
+    # when the expert count does not divide over the expert axis).
     dispatch_impl: str = "auto"
 
-    def _resolve_dispatch(self) -> str:
+    def _can_alltoall(self, topo, n_tokens: int) -> bool:
+        ep = int(topo.mesh.shape.get(EXPERT_AXIS, 1))
+        if self.num_experts % max(ep, 1) != 0:
+            return False
+        tok = 1
+        for a in TOKEN_AXES:
+            tok *= int(topo.mesh.shape.get(a, 1))
+        # shard_map needs the flat token dim to divide over its axes
+        # (tiny decode batches under a big training mesh fall back)
+        return n_tokens % tok == 0
+
+    def _resolve_dispatch(self, n_tokens: int) -> str:
         if self.dispatch_impl != "auto":
             return self.dispatch_impl
         import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.utils.logging import log_dist
 
         topo = dist.peek_topology()
         if topo is not None and topo.mesh.size > 1:
-            return "einsum"
-        return "sorted"
+            impl = ("alltoall" if self._can_alltoall(topo, n_tokens)
+                    else "einsum")
+        else:
+            impl = "sorted"
+        # 'auto' binds at TRACE time: a model traced before the mesh is
+        # installed bakes in the single-device choice — make it visible
+        log_dist(f"MoE dispatch_impl=auto -> {impl!r} "
+                 f"(topology={'none' if topo is None else topo.mesh.shape})",
+                 ranks=[0])
+        return impl
 
-    @nn.compact
-    def __call__(self, x: jax.Array, is_training: bool = True
-                 ) -> Tuple[jax.Array, jax.Array]:
+    # -- expert FFN (shared by every dispatch impl) ----------------------
+
+    def _expert_params(self):
         cfg = self
-        orig_shape = x.shape
         M, E, I = cfg.hidden_size, cfg.num_experts, cfg.intermediate_size
-        x = x.reshape(-1, M)                                     # [G, M]
-
-        # router in fp32 (reference TopKGate keeps the gate fp32,
-        # sharded_moe.py:449) — routing decisions are precision-sensitive
-        wg = self.param("gate", nn.initializers.lecun_normal(), (M, E),
-                        jnp.float32)
-        logits = x.astype(jnp.float32) @ wg                      # [G, E]
-
-        noise_rng = None
-        if (cfg.noisy_gate_policy == "Jitter" and is_training
-                and self.has_rng("gating")):
-            noise_rng = self.make_rng("gating")
-        gr = topkgating(
-            logits, k=cfg.k,
-            capacity_factor=(cfg.capacity_factor if is_training
-                             else cfg.eval_capacity_factor),
-            min_capacity=cfg.min_capacity, drop_tokens=cfg.drop_tokens,
-            noise_rng=noise_rng)
-
         ep = EXPERT_AXIS if cfg.expert_parallel else None
         tp = "tensor" if cfg.tensor_parallel else None
 
@@ -118,10 +134,185 @@ class MoE(nn.Module):
                 init = nn.with_partitioning(init, spec)
             return self.param(name, init, shape, cfg.param_dtype)
 
+        if cfg.activation == "swiglu":                           # Mixtral
+            return {"w1": expert_param("w1", (E, M, I), (ep, None, tp)),
+                    "w3": expert_param("w3", (E, M, I), (ep, None, tp)),
+                    "w2": expert_param("w2", (E, I, M), (ep, tp, None))}
+        elif cfg.activation == "gelu":
+            return {"w1": expert_param("w1", (E, M, I), (ep, None, tp)),
+                    "b1": expert_param("b1", (E, I), (ep, tp), bias=True),
+                    "w2": expert_param("w2", (E, I, M), (ep, tp, None)),
+                    "b2": expert_param("b2", (E, M), (ep, None), bias=True)}
+        raise ValueError(f"unknown MoE activation {cfg.activation!r}")
+
+    def _expert_ffn(self, disp: jax.Array, w) -> jax.Array:
+        """[E?, C, M] dispatched tokens -> [E?, C, M] expert outputs (the
+        leading dim is global E on the einsum path, local E/ep under the
+        alltoall shard_map)."""
+        dt = self.dtype
+        if self.activation == "swiglu":
+            h = jnp.einsum("ecm,emi->eci", disp, w["w1"].astype(dt))
+            u = jnp.einsum("ecm,emi->eci", disp, w["w3"].astype(dt))
+            return jnp.einsum("eci,eim->ecm", nn.silu(h) * u,
+                              w["w2"].astype(dt))
+        h = jnp.einsum("ecm,emi->eci", disp, w["w1"].astype(dt))
+        h = jax.nn.gelu(h + w["b1"].astype(dt)[:, None])
+        out = jnp.einsum("eci,eim->ecm", h, w["w2"].astype(dt))
+        return out + w["b2"].astype(dt)[:, None]
+
+    # -- gating (shared) -------------------------------------------------
+
+    def _gate(self, x: jax.Array, wg: jax.Array,
+              noise_rng: Optional[jax.Array], is_training: bool):
+        """Returns ``(GatingResult, fp32 logits)`` — the alltoall path
+        needs the logits again for the global aux-loss pmean."""
+        logits = x.astype(jnp.float32) @ wg                      # [G, E]
+        return topkgating(
+            logits, k=self.k,
+            capacity_factor=(self.capacity_factor if is_training
+                             else self.eval_capacity_factor),
+            min_capacity=self.min_capacity, drop_tokens=self.drop_tokens,
+            noise_rng=noise_rng), logits
+
+    # -- the multi-chip linear path --------------------------------------
+
+    def _alltoall_moe(self, x: jax.Array, wg: jax.Array, w,
+                     noise_rng: Optional[jax.Array], is_training: bool
+                     ) -> Tuple[jax.Array, jax.Array]:
+        """Per-shard sorted dispatch + explicit all-to-all over ``expert``
+        (reference ``_AllToAll:96`` + per-rank capacity, MOELayer:533).
+
+        shard_map is manual over the token-sharding axes only; ``tensor``
+        stays automatic so GSPMD still partitions the expert FFN einsums
+        (Megatron TP) and inserts their psum.  Expert weights enter
+        expert-sharded (any ZeRO sharding is gathered at the constraint
+        below — the same per-layer gather ZeRO-3 implies)."""
+        from deepspeed_tpu.sequence.layer import resolve_mesh
+
+        cfg = self
+        E = cfg.num_experts
+        mesh = resolve_mesh(None, EXPERT_AXIS)
+        token_axes = tuple(a for a in TOKEN_AXES
+                           if a in mesh.axis_names and
+                           int(mesh.shape.get(a, 1)) > 1)
+        # replicated experts (expert_parallel=False) need no all-to-all:
+        # every shard holds all E experts and computes its own tokens
+        ep = (int(mesh.shape.get(EXPERT_AXIS, 1)) if cfg.expert_parallel
+              else 1)
+
+        # gather any ZeRO shard dims; keep expert (+ tensor, automatic)
+        ep_name = EXPERT_AXIS if cfg.expert_parallel else None
+        tp = "tensor" if cfg.tensor_parallel else None
+        w = dict(w)
+        for k_, v in w.items():
+            spec = [None] * v.ndim
+            spec[0] = ep_name
+            if tp is not None and v.ndim == 3:
+                spec[2 if k_ in ("w1", "w3") else 1] = tp
+            elif tp is not None and k_ == "b1":
+                spec[1] = tp
+            w[k_] = _maybe_constrain(v, tuple(spec))
+        w_keys = sorted(w)
+        w_vals = [w[k_] for k_ in w_keys]
+
+        def wspec(v):
+            s = [None] * v.ndim
+            s[0] = ep_name
+            return P(*s)
+
+        if not token_axes:
+            token_axes = None          # mesh.size>1 but batch unsharded
+        has_rng = noise_rng is not None
+
+        def body(x_l, wg_, *rest):
+            rng = rest[0] if has_rng else None
+            w_l = rest[1:] if has_rng else rest
+            wd = dict(zip(w_keys, w_l))
+            if rng is not None and token_axes:
+                rng = jax.random.fold_in(
+                    rng, jax.lax.axis_index(token_axes))
+            gr, logits = self._gate(x_l, wg_, rng, is_training)
+            plan = routing_plan(gr, E)
+            disp = sorted_dispatch(x_l.astype(cfg.dtype), plan.slot_token,
+                                   plan.slot_of_copy)        # [E, C_l, M]
+            if ep > 1:
+                # reference _AllToAll fwd: expert-major buffers scatter to
+                # their owning rank; each rank concatenates the C_l slices
+                # it receives from every peer -> [E_l, ep*C_l, M]
+                disp = jax.lax.all_to_all(disp, EXPERT_AXIS, split_axis=0,
+                                          concat_axis=1, tiled=True)
+            out = self._expert_ffn(disp, wd)
+            if ep > 1:
+                out = jax.lax.all_to_all(out, EXPERT_AXIS, split_axis=1,
+                                         concat_axis=0, tiled=True)
+            y = sorted_combine(out, gr.weights, plan.slot_token,
+                               plan.slot_of_copy)
+            l_aux = gr.l_aux
+            if token_axes:
+                # GLOBAL aux loss: average the per-expert token fraction
+                # and router-prob fraction over every token shard BEFORE
+                # the product, matching the global einsum formulation
+                # bit-for-bit (mean of per-shard products differs —
+                # product of means is nonlinear)
+                me = jax.lax.pmean(
+                    jnp.mean(jax.nn.softmax(logits, axis=-1), axis=0),
+                    token_axes)
+                ce = jax.lax.pmean(
+                    jnp.mean(jax.nn.one_hot(gr.experts[0], E,
+                                            dtype=jnp.float32), axis=0),
+                    token_axes)
+                l_aux = jnp.sum(me * ce) * E
+            return y, l_aux
+
+        manual = set(token_axes or ()) | {EXPERT_AXIS}
+        tok_spec = P(token_axes, None)
+        rng_args = (noise_rng,) if has_rng else ()
+        rng_specs = (P(),) if has_rng else ()
+        sm = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(tok_spec, P()) + rng_specs +
+                     tuple(wspec(v) for v in w_vals),
+            out_specs=(tok_spec, P()),
+            axis_names=manual, check_vma=False)
+        # jit so eager callers (flax init, unit tests) route through the
+        # jit lowering — jax's EAGER partial-manual shard_map impl trips
+        # over meshes with extra (non-manual) axes; under an outer jit
+        # this inlines
+        return jax.jit(sm)(x, wg, *rng_args, *w_vals)
+
+    # -- forward ---------------------------------------------------------
+
+    @nn.compact
+    def __call__(self, x: jax.Array, is_training: bool = True
+                 ) -> Tuple[jax.Array, jax.Array]:
+        cfg = self
+        orig_shape = x.shape
+        M, E = cfg.hidden_size, cfg.num_experts
+        x = x.reshape(-1, M)                                     # [G, M]
+
+        # router in fp32 (reference TopKGate keeps the gate fp32,
+        # sharded_moe.py:449) — routing decisions are precision-sensitive
+        wg = self.param("gate", nn.initializers.lecun_normal(), (M, E),
+                        jnp.float32)
+        w = self._expert_params()
+
+        noise_rng = None
+        if (cfg.noisy_gate_policy == "Jitter" and is_training
+                and self.has_rng("gating")):
+            noise_rng = self.make_rng("gating")
+
+        impl = cfg._resolve_dispatch(x.shape[0])
+        if impl == "alltoall":
+            y, l_aux = self._alltoall_moe(x, wg, w, noise_rng, is_training)
+            return y.reshape(orig_shape), l_aux.astype(jnp.float32)
+
+        gr, _ = self._gate(x, wg, noise_rng, is_training)
+
+        ep = EXPERT_AXIS if cfg.expert_parallel else None
+
         # dispatch: [G, M] -> [E, C, M]; the sharding constraint onto the
         # expert axis is the reference's first all-to-all (_AllToAll fwd)
         x_d = x.astype(cfg.dtype)      # one cast shared by all impls
-        impl = cfg._resolve_dispatch()
         plan = None
         if impl == "gather":
             disp = moe_dispatch_gather(x_d, gr, cfg.num_experts)
@@ -134,25 +325,7 @@ class MoE(nn.Module):
             raise ValueError(f"unknown dispatch_impl {impl!r}")
         disp = _maybe_constrain(disp, (ep, None, None))
 
-        if cfg.activation == "swiglu":                           # Mixtral
-            w1 = expert_param("w1", (E, M, I), (ep, None, tp))
-            w3 = expert_param("w3", (E, M, I), (ep, None, tp))
-            w2 = expert_param("w2", (E, I, M), (ep, tp, None))
-            h = jnp.einsum("ecm,emi->eci", disp, w1.astype(cfg.dtype))
-            u = jnp.einsum("ecm,emi->eci", disp, w3.astype(cfg.dtype))
-            out = jnp.einsum("eci,eim->ecm", nn.silu(h) * u,
-                             w2.astype(cfg.dtype))
-        elif cfg.activation == "gelu":
-            w1 = expert_param("w1", (E, M, I), (ep, None, tp))
-            b1 = expert_param("b1", (E, I), (ep, tp), bias=True)
-            w2 = expert_param("w2", (E, I, M), (ep, tp, None))
-            b2 = expert_param("b2", (E, M), (ep, None), bias=True)
-            h = jnp.einsum("ecm,emi->eci", disp, w1.astype(cfg.dtype))
-            h = jax.nn.gelu(h + b1.astype(cfg.dtype)[:, None])
-            out = jnp.einsum("eci,eim->ecm", h, w2.astype(cfg.dtype))
-            out = out + b2.astype(cfg.dtype)[:, None]
-        else:
-            raise ValueError(f"unknown MoE activation {cfg.activation!r}")
+        out = self._expert_ffn(disp, w)
 
         out = _maybe_constrain(out, (ep, None, None))
         # combine: [E, C, M] -> [G, M] (the second all-to-all)

@@ -125,8 +125,7 @@ class CometMonitor(Monitor):
 
     def _needs_logging(self, name: str, step: int) -> bool:
         last = self._last_logged.get(name)
-        if last is not None and step - last < self.samples_log_interval \
-                and step != last:
+        if last is not None and step - last < self.samples_log_interval:
             return False
         self._last_logged[name] = step
         return True

@@ -431,7 +431,14 @@ class NvmeOptimizerSwapper:
         self.drain()
         for key, tag in self._initialized:
             fname = self._shard_fname(key, tag)
-            shutil.copy2(fname, os.path.join(out, os.path.basename(fname)))
+            dst = os.path.join(out, os.path.basename(fname))
+            # replicated leaves carry the same full-extent tag in every
+            # process; copy via a per-process temp + atomic rename so
+            # concurrent multi-host saves never interleave writes to one
+            # destination path (fragile on e.g. NFS)
+            tmp = f"{dst}.tmp.p{jax.process_index()}"
+            shutil.copy2(fname, tmp)
+            os.replace(tmp, dst)
         # one meta file per process: each process's shard set is disjoint
         # (multi-host swap — reference rank-local partition semantics)
         meta_name = f"swap_meta.p{jax.process_index()}.json"

@@ -139,3 +139,123 @@ class TestScheduling:
         toks = next(iter(out.values()))
         assert toks.size == 8
         assert np.isfinite(toks).all()
+
+
+class TestDecodeBlock:
+    """On-device multi-tick decode: K tokens per host dispatch."""
+
+    def test_block_decode_matches_tickwise(self, params, v1):
+        prompts = _prompts([5, 9, 3], seed=7)
+        eng_blk = make_v2(params, decode_block_size=4)
+        eng_tick = make_v2(params, decode_block_size=1)
+        outs_b = eng_blk.generate_all(prompts, max_new_tokens=7)
+        outs_t = eng_tick.generate_all(prompts, max_new_tokens=7)
+        for ub, ut in zip(sorted(outs_b), sorted(outs_t)):
+            np.testing.assert_array_equal(outs_b[ub], outs_t[ut])
+        for uid, prompt in zip(sorted(outs_b), prompts):
+            np.testing.assert_array_equal(outs_b[uid], solo(v1, prompt, 7))
+
+    def test_block_amortizes_dispatches(self, params):
+        """>=4 tokens generated per compiled-program dispatch once
+        everyone is decoding (the VERDICT 'amortized host RT' contract)."""
+        (prompt,) = _prompts([4], seed=8)
+        eng = make_v2(params, decode_block_size=8)
+        eng.put_request(prompt, max_new_tokens=33)
+        dispatches = 0
+        produced = 0
+        while eng.has_work():
+            produced += eng.step()
+            dispatches += 1
+        assert produced == 33
+        # 1 prefill tick + ceil(32/8)+1ish decode blocks, not 34 ticks
+        assert dispatches <= 7
+        assert produced / dispatches >= 4
+
+    def test_block_eos_stops_early(self, params):
+        eng = make_v2(params, decode_block_size=8)
+        (prompt,) = _prompts([5], seed=9)
+        probe = eng.generate_all([prompt], max_new_tokens=2)
+        eos = int(next(iter(probe.values()))[-2])  # 1st generated token
+        eng2 = make_v2(params, decode_block_size=8)
+        eng2.put_request(prompt, max_new_tokens=50, eos_token_id=eos)
+        while eng2.has_work():
+            eng2.step()
+        (_, toks), = eng2.get_outputs()
+        assert toks[-1] == eos
+        assert toks.size < prompt.size + 50
+
+    def test_block_with_staggered_admission(self, params, v1):
+        """Mid-run admission interleaves decode blocks with SplitFuse
+        prefill ticks; all outputs must still match solo runs."""
+        p1, p2 = _prompts([6, 4], seed=10)
+        eng = make_v2(params, decode_block_size=4)
+        eng.put_request(p1, max_new_tokens=12)
+        for _ in range(3):
+            eng.step()
+        eng.put_request(p2, max_new_tokens=12)
+        while eng.has_work():
+            eng.step()
+        outs = dict(eng.get_outputs())
+        res = [outs[u] for u in sorted(outs)]
+        np.testing.assert_array_equal(res[0], solo(v1, p1, 12))
+        np.testing.assert_array_equal(res[1], solo(v1, p2, 12))
+
+    def test_block_sampling_path(self, params):
+        eng = make_v2(params, decode_block_size=4)
+        prompts = _prompts([4, 6], seed=11)
+        outs = eng.generate_all(prompts, max_new_tokens=6, do_sample=True,
+                                temperature=0.9, top_k=8, top_p=0.9)
+        for toks in outs.values():
+            assert np.isfinite(toks).all()
+
+
+class TestTensorParallelServing:
+    """Reference v2 TP serving (sharding/attn.py + engine_v2 TP groups):
+    the whole SplitFuse tick and decode block run under GSPMD with
+    weights AutoTP-sharded and the KV page pool head-sharded."""
+
+    def _tp_engine(self, params, tp, devices, **kw):
+        import deepspeed_tpu.comm as dist
+
+        topo = dist.initialize_mesh(dp=1, tp=tp,
+                                    devices=devices[:max(tp, 1)])
+        return make_v2(params, topology=topo, **kw)
+
+    def test_tp2_matches_single_device(self, params, v1, devices):
+        prompts = _prompts([5, 9, 3, 12], seed=12)
+        eng = self._tp_engine(params, 2, devices, decode_block_size=4)
+        assert eng.tp == 2
+        outs = eng.generate_all(prompts, max_new_tokens=6)
+        for uid, prompt in zip(sorted(outs), prompts):
+            np.testing.assert_array_equal(outs[uid], solo(v1, prompt, 6))
+
+    def test_tp2_params_and_cache_sharded(self, params, devices):
+        eng = self._tp_engine(params, 2, devices)
+        # q_proj kernel must be sharded over tensor on its output dim
+        # (params stay scan-stacked [L, in, out]; unrolled in-jit)
+        qk = eng.params["model"]["layers"]["block"]["self_attn"]["q_proj"][
+            "kernel"]
+        shard_shapes = {s.data.shape for s in qk.addressable_shards}
+        assert shard_shapes == {(2, 32, 16)}, shard_shapes
+        # KV page pools shard their combined-head dim (2*Hkv=4 -> 2 each)
+        leaf = jax.tree_util.tree_leaves(eng.cache)[0]
+        pages_shards = {s.data.shape for s in leaf.addressable_shards}
+        (shape,) = pages_shards
+        assert shape[2] == 2, pages_shards
+
+    def test_tp2_tick_and_block_parity(self, params, v1, devices):
+        """Chunked prefill + staggered admission + decode blocks, all
+        under tp=2."""
+        p1, p2 = _prompts([23, 4], seed=13)
+        eng = self._tp_engine(params, 2, devices, prefill_chunk=8,
+                              decode_block_size=4)
+        eng.put_request(p1, max_new_tokens=8)
+        for _ in range(4):
+            eng.step()
+        eng.put_request(p2, max_new_tokens=8)
+        while eng.has_work():
+            eng.step()
+        outs = dict(eng.get_outputs())
+        res = [outs[u] for u in sorted(outs)]
+        np.testing.assert_array_equal(res[0], solo(v1, p1, 8))
+        np.testing.assert_array_equal(res[1], solo(v1, p2, 8))

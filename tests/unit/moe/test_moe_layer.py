@@ -24,3 +24,84 @@ def test_gather_dispatch_matches_einsum(devices):
     np.testing.assert_allclose(outs["gather"][0], outs["einsum"][0],
                                rtol=1e-5, atol=1e-6)
     assert np.isclose(outs["gather"][1], outs["einsum"][1])
+
+
+def _run_moe_on_mesh(impl, devices, dp, ep, expert_parallel=True,
+                     grad=False):
+    """Apply (and optionally grad) one MoE layer under a dp x ep mesh with
+    tokens sharded over (data, expert)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.moe.layer import MoE
+
+    topo = dist.initialize_mesh(dp=dp, ep=ep, devices=devices)
+    moe = MoE(hidden_size=32, num_experts=4, intermediate_size=64,
+              k=2, capacity_factor=4.0, min_capacity=4,
+              dtype=jnp.float32, expert_parallel=expert_parallel,
+              dispatch_impl=impl)
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, 16, 32), jnp.float32)
+    params = moe.init(jax.random.PRNGKey(0), x)
+    xs = jax.device_put(x, NamedSharding(topo.mesh,
+                                         P(("data", "expert"), None, None)))
+
+    if grad:
+        def loss(p, xv):
+            y, l_aux = moe.apply(p, xv)
+            return jnp.sum(y ** 2) + l_aux
+
+        val, grads = jax.jit(jax.value_and_grad(loss))(params, xs)
+        return float(val), jax.tree_util.tree_map(np.asarray, grads)
+    y, l_aux = jax.jit(moe.apply)(params, xs)
+    return np.asarray(y), float(l_aux)
+
+
+def test_alltoall_matches_einsum_on_mesh(devices):
+    """The shard_map all-to-all dispatch (per-shard sorted + explicit
+    lax.all_to_all over the expert axis) matches the GSPMD einsum oracle
+    on a dp x ep mesh, at capacity where no tokens drop."""
+    import numpy as np
+
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.comm import comm as _comm
+
+    y_a2a, aux_a2a = _run_moe_on_mesh("alltoall", devices, dp=2, ep=4)
+    _comm._state.topology = None
+    y_ein, aux_ein = _run_moe_on_mesh("einsum", devices, dp=2, ep=4)
+    np.testing.assert_allclose(y_a2a, y_ein, rtol=1e-5, atol=1e-5)
+    assert np.isclose(aux_a2a, aux_ein, rtol=1e-5)
+
+
+def test_alltoall_grads_match_einsum_on_mesh(devices):
+    """Backward parity: the custom-VJP gathers + all_to_all transpose
+    produce the same parameter gradients as the einsum path."""
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.comm import comm as _comm
+
+    val_a, g_a = _run_moe_on_mesh("alltoall", devices, dp=2, ep=4,
+                                  grad=True)
+    _comm._state.topology = None
+    val_e, g_e = _run_moe_on_mesh("einsum", devices, dp=2, ep=4, grad=True)
+    assert np.isclose(val_a, val_e, rtol=1e-5)
+    for ka, kb in zip(jax.tree_util.tree_leaves(g_a),
+                      jax.tree_util.tree_leaves(g_e)):
+        np.testing.assert_allclose(ka, kb, rtol=1e-4, atol=1e-4)
+
+
+def test_alltoall_dp_only_mesh(devices):
+    """ep=1, dp=8: the alltoall impl degenerates to per-shard sorted
+    dispatch with no collective — and still matches the einsum oracle."""
+    import numpy as np
+
+    from deepspeed_tpu.comm import comm as _comm
+
+    y_a2a, aux_a2a = _run_moe_on_mesh("alltoall", devices, dp=8, ep=1)
+    _comm._state.topology = None
+    y_ein, aux_ein = _run_moe_on_mesh("einsum", devices, dp=8, ep=1)
+    np.testing.assert_allclose(y_a2a, y_ein, rtol=1e-5, atol=1e-5)
+    assert np.isclose(aux_a2a, aux_ein, rtol=1e-5)

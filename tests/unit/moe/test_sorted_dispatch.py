@@ -108,25 +108,32 @@ def test_sorted_layer_matches_einsum_layer(devices):
     assert np.isclose(outs["sorted"][1], outs["einsum"][1])
 
 
-def test_auto_resolves_einsum_on_multichip_mesh(devices):
-    """dispatch_impl='auto' must pick the GSPMD-shardable einsum path on
-    ANY multi-device mesh — the sorted plan's global gathers defeat GSPMD
-    partitioning of sharded token axes (dp-only meshes included)."""
+def test_auto_resolves_alltoall_on_multichip_mesh(devices):
+    """dispatch_impl='auto' must pick the shard_map all-to-all path on
+    multi-device meshes — linear in tokens (the sorted plan's global
+    gathers defeat GSPMD, and the einsum path is quadratic); einsum only
+    remains for expert counts that don't divide the expert axis."""
     import deepspeed_tpu.comm as dist
     from deepspeed_tpu.moe.layer import MoE
 
     dist.initialize_mesh(dp=2, ep=4)     # reset by the autouse fixture
     moe = MoE(hidden_size=32, num_experts=4, intermediate_size=64)
-    assert moe._resolve_dispatch() == "einsum"
-    # dp-only mesh: tokens are sharded over data — still einsum
+    assert moe._resolve_dispatch(64) == "alltoall"
+    # dp-only mesh: tokens sharded over data — alltoall degenerates to
+    # per-shard sorted dispatch (ep=1), still linear
     from deepspeed_tpu.comm import comm as _comm
     _comm._state.topology = None
     dist.initialize_mesh(dp=8)
-    assert moe._resolve_dispatch() == "einsum"
+    assert moe._resolve_dispatch(64) == "alltoall"
+    # expert count not divisible by the expert axis -> einsum fallback
+    _comm._state.topology = None
+    dist.initialize_mesh(dp=2, ep=4)
+    moe3 = MoE(hidden_size=32, num_experts=6, intermediate_size=64)
+    assert moe3._resolve_dispatch(64) == "einsum"
 
 
 def test_auto_resolves_sorted_without_topology():
     from deepspeed_tpu.moe.layer import MoE
 
     moe = MoE(hidden_size=32, num_experts=4, intermediate_size=64)
-    assert moe._resolve_dispatch() == "sorted"
+    assert moe._resolve_dispatch(64) == "sorted"
