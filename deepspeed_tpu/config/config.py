@@ -264,12 +264,14 @@ class AioConfig(ConfigModel):
     """``aio`` subtree (reference ``deepspeed/runtime/swap_tensor/
     aio_config.py``): tuning knobs for the native async-IO engine.
     ``python -m deepspeed_tpu.io.bench --tune`` reports the best values
-    for the target mount.  queue_depth/single_submit/overlap_events are
-    libaio-era knobs accepted for config compatibility; the thread-pooled
-    engine uses block_size and thread_count."""
+    for the target mount.  queue_depth is the per-worker io_uring ring
+    depth (the reference's libaio queue_depth); use_odirect bypasses the
+    page cache when alignment allows.  single_submit/overlap_events are
+    libaio-era knobs accepted for config compatibility."""
     block_size: int = 1 << 20
-    queue_depth: int = 128
+    queue_depth: int = 64
     thread_count: int = 8
+    use_odirect: bool = False
     single_submit: bool = False
     overlap_events: bool = True
 

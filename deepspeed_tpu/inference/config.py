@@ -30,7 +30,7 @@ class QuantConfig(ConfigModel):
     and dequantized on the fly in the matmul's prologue."""
 
     enabled: bool = False
-    qtype: str = "int8"          # "int8" | "fp8"
+    qtype: str = "int8"          # "int8" | "fp8" | "fp6"
     group_size: int = 128
 
 

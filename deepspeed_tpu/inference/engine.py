@@ -151,16 +151,33 @@ class InferenceEngine:
             log_dist("init_inference AutoTP: inferred tensor-parallel "
                      "sharding from parameter names", ranks=[0])
 
-        if config.quant.enabled:
-            logger.warning("inference weight quantization is not applied "
-                           "in-engine yet; serving in %s", config.dtype)
-
         def cast(x):
             x = jnp.asarray(x)
             return x.astype(self.dtype) if jnp.issubdtype(
                 x.dtype, jnp.floating) else x
 
         params = jax.tree_util.tree_map(cast, params)
+        self._wq = None
+        if config.quant.enabled:
+            # weight-only serving quantization (reference QuantizationConfig
+            # -> replace_with_quantized_linear / FP6-LLM cuda_linear):
+            # weights persist quantized, dequantize in-jit at use
+            assert tp_size <= 1, (
+                "quant.enabled does not compose with tensor-parallel "
+                "serving yet")
+            from deepspeed_tpu.inference.quantization import \
+                quantize_param_tree
+
+            self._wq = config.quant.qtype
+            params, b0, b1 = quantize_param_tree(
+                params, self._wq, group_size=config.quant.group_size)
+            # quantized leaves are QuantizedWeight subtrees — the per-leaf
+            # spec tree no longer lines up, and tp<=1 means replication
+            # was the only placement anyway
+            specs = None
+            log_dist(f"init_inference weights -> {self._wq}: "
+                     f"{b0 / 2**20:.1f} MiB -> {b1 / 2**20:.1f} MiB",
+                     ranks=[0])
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         if specs is not None:
@@ -199,8 +216,14 @@ class InferenceEngine:
         ``engine.py:554``) — no KV cache, one fused program."""
         if self._forward_fn is None:
             model = self._plain_model
+            wq = getattr(self, "_wq", None)
 
             def fwd(params, ids):
+                if wq:
+                    from deepspeed_tpu.inference.quantization import \
+                        dequantize_param_tree
+
+                    params = dequantize_param_tree(params)
                 return self._logits(model.apply({"params": params}, ids))
 
             self._forward_fn = jax.jit(fwd)
@@ -231,8 +254,14 @@ class InferenceEngine:
                                  top_p=top_p)
 
         unroll = self._unroll_params
+        wq = getattr(self, "_wq", None)
 
         def gen(params, prompt, rng):
+            if wq:
+                from deepspeed_tpu.inference.quantization import \
+                    dequantize_param_tree
+
+                params = dequantize_param_tree(params)
             if unroll:
                 from deepspeed_tpu.inference.common import \
                     unroll_scan_params

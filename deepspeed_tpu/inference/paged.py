@@ -163,8 +163,21 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
     P, page = cfg.kv_num_pages, cfg.kv_page_size
     assert P > 1, "paged_decode requires kv_num_pages (engine sets it)"
 
+    # KV-cache quantization (reference csrc/fp_quantizer selective_dequant
+    # + inference v2 KV configs): pages persist in fp8 e4m3 or int8 with a
+    # per-(row, head) fp32 scale; dequantized transiently at attention
+    kv_quant = getattr(cfg, "kv_cache_dtype", "none") or "none"
+    if kv_quant in ("fp8", "fp8_e4m3"):
+        store_dtype, qmax = jnp.float8_e4m3fn, float(
+            jnp.finfo(jnp.float8_e4m3fn).max)
+    elif kv_quant == "int8":
+        store_dtype, qmax = jnp.int8, 127.0
+    else:
+        assert kv_quant == "none", f"unknown kv_cache_dtype {kv_quant!r}"
+        store_dtype, qmax = k.dtype, None
+
     pages_var = mdl.variable(
-        "cache", "kv_pages", jnp.zeros, (P, page, 2 * Hkv, D), k.dtype)
+        "cache", "kv_pages", jnp.zeros, (P, page, 2 * Hkv, D), store_dtype)
 
     # interleave K/V onto combined heads: [T, 2Hkv, D], K even, V odd
     k_rows = k[0].transpose(1, 0, 2)                   # [T, Hkv, D]
@@ -172,10 +185,36 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
     combined = jnp.stack([k_rows, v_rows], axis=2).reshape(T, 2 * Hkv, D)
 
     flat = pages_var.value.reshape(P * page, 2 * Hkv, D)
-    flat = flat.at[ragged_meta["new_kv_dest"]].set(
-        combined.astype(flat.dtype), mode="drop")
-    pages = flat.reshape(P, page, 2 * Hkv, D)
-    pages_var.value = pages
+    if qmax is None:
+        flat = flat.at[ragged_meta["new_kv_dest"]].set(
+            combined.astype(flat.dtype), mode="drop")
+        pages = flat.reshape(P, page, 2 * Hkv, D)
+        pages_var.value = pages
+    else:
+        scales_var = mdl.variable(
+            "cache", "kv_scales", jnp.zeros, (P, page, 2 * Hkv),
+            jnp.float32)
+        cf = combined.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(cf), axis=-1)         # [T, 2Hkv]
+        scale = jnp.maximum(absmax, 1e-12) / qmax
+        qv = cf / scale[..., None]
+        if store_dtype == jnp.int8:
+            qv = jnp.clip(jnp.round(qv), -qmax, qmax)
+        flat = flat.at[ragged_meta["new_kv_dest"]].set(
+            qv.astype(store_dtype), mode="drop")
+        flat_s = scales_var.value.reshape(P * page, 2 * Hkv)
+        flat_s = flat_s.at[ragged_meta["new_kv_dest"]].set(scale,
+                                                           mode="drop")
+        scales_var.value = flat_s.reshape(P, page, 2 * Hkv)
+        pages_var.value = flat.reshape(P, page, 2 * Hkv, D)
+        # transient per-tick dequant.  The PERSISTENT pool (what bounds
+        # concurrent sequences) is 1-byte; the dequantized operand is
+        # temporary — XLA fuses it into the reference attention's reads,
+        # but the Pallas kernel path materializes it for the tick (a
+        # quantized-pages kernel variant would remove that; future work)
+        pages = (flat.astype(jnp.float32) *
+                 flat_s[..., None]).astype(k.dtype).reshape(
+                     P, page, 2 * Hkv, D)
 
     qt = q[0].transpose(1, 0, 2)                       # [T, H, D]
     sm_scale = float(1.0 / np.sqrt(D))

@@ -1,0 +1,133 @@
+"""Serving-side quantization: weight-only formats for the inference
+engines.
+
+TPU-native counterpart of the reference's inference quantization stack:
+``csrc/fp_quantizer/quantize.cu`` (fp8/fp6 ``selective_dequant``),
+``inference/v2/kernels/core_ops/cuda_linear/`` (FP6-LLM GEMM), and the
+int8 ``replace_with_quantized_linear`` path.  Weights live in HBM in the
+quantized format (int8 group-wise, fp8 e4m3, or packed fp6 e3m2 —
+``ops/quantization.py``) and dequantize IN-JIT at use, where XLA fuses
+the elementwise expansion into the consuming matmul's operand read — the
+TPU equivalent of the reference's dequant-in-GEMM-prologue kernels.
+
+KV-cache quantization (fp8/int8 paged pools with per-row-per-head
+scales) lives in ``inference/paged.py`` — it is a storage-layout concern
+of the blocked KV pool.
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.quantization import (FP6Tensor, FP8Tensor,
+                                            QuantizedTensor, dequantize,
+                                            dequantize_fp6, dequantize_fp8,
+                                            quantize, quantize_fp6,
+                                            quantize_fp8)
+
+WEIGHT_FORMATS = ("int8", "fp8", "fp6")
+
+# matmul-bearing leaf names — norms/biases/scales stay high precision
+# (the reference's policies quantize Linear/Embedding weights only)
+_QUANT_LEAVES = frozenset(
+    {"kernel", "embedding", "w1", "w2", "w3", "wi", "wo"})
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedWeight:
+    """Pytree wrapper for a quantized parameter: the payload/scale arrays
+    are children (device_put/jit/donation all work), the layout metadata
+    (format, original shape/dtype, group size) is STATIC aux data — the
+    ops-level NamedTuples carry shape/dtype as pytree children, which
+    breaks abstraction the moment they sit inside a params tree."""
+
+    def __init__(self, fmt: str, arrays: Tuple[jax.Array, ...],
+                 shape: Tuple[int, ...], dtype, group_size: int = 0):
+        self.fmt = fmt
+        self.arrays = tuple(arrays)
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+        self.group_size = int(group_size)
+
+    def tree_flatten(self):
+        return self.arrays, (self.fmt, self.shape, str(self.dtype),
+                             self.group_size)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        fmt, shape, dtype, group_size = aux
+        return cls(fmt, tuple(children), shape, dtype, group_size)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                   for a in self.arrays)
+
+
+def _is_q(leaf) -> bool:
+    return isinstance(leaf, QuantizedWeight)
+
+
+def quantize_param_tree(params: Any, fmt: str, min_size: int = 1024,
+                        group_size: int = 2048) -> Tuple[Any, int, int]:
+    """Quantize every matmul-bearing leaf of ``params`` to ``fmt``.
+
+    Returns ``(qtree, bytes_before, bytes_after)``; small leaves (<
+    ``min_size`` elements) and non-matmul leaves pass through unchanged.
+    ``group_size`` is the int8/fp6 blockwise-scale granularity
+    (reference ``QuantizationConfig.group_size``); fp8 scales per
+    tensor.
+    """
+    assert fmt in WEIGHT_FORMATS, \
+        f"quantize_weights={fmt!r}: expected one of {WEIGHT_FORMATS}"
+    before = after = 0
+
+    def q(path, leaf):
+        nonlocal before, after
+        before += leaf.size * leaf.dtype.itemsize
+        name = str(getattr(path[-1], "key", path[-1]))
+        if (leaf.ndim < 2 or leaf.size < min_size or
+                name not in _QUANT_LEAVES):
+            after += leaf.size * leaf.dtype.itemsize
+            return leaf
+        if fmt == "int8":
+            t = quantize(leaf, num_bits=8, group_size=group_size)
+            out = QuantizedWeight("int8", (t.values, t.scale, t.offset),
+                                  t.shape, t.dtype)
+        elif fmt == "fp8":
+            t = quantize_fp8(leaf)
+            out = QuantizedWeight("fp8", (t.values, t.scale), t.shape,
+                                  t.dtype)
+        else:
+            t = quantize_fp6(leaf, group_size=group_size)
+            out = QuantizedWeight("fp6", (t.values, t.scale), t.shape,
+                                  t.dtype, t.group_size)
+        after += out.nbytes
+        return out
+
+    return (jax.tree_util.tree_map_with_path(q, params), before, after)
+
+
+def dequantize_param_tree(qtree: Any) -> Any:
+    """In-jit inverse of :func:`quantize_param_tree` (XLA fuses the
+    expansion into consumers; quantized leaves never persist in HBM at
+    full precision)."""
+
+    def dq(leaf):
+        if not isinstance(leaf, QuantizedWeight):
+            return leaf
+        if leaf.fmt == "int8":
+            v, s, o = leaf.arrays
+            return dequantize(QuantizedTensor(v, s, o, leaf.shape,
+                                              leaf.dtype))
+        if leaf.fmt == "fp8":
+            v, s = leaf.arrays
+            return dequantize_fp8(FP8Tensor(v, s, leaf.shape, leaf.dtype))
+        v, s = leaf.arrays
+        return dequantize_fp6(FP6Tensor(v, s, leaf.shape, leaf.dtype,
+                                        leaf.group_size))
+
+    return jax.tree_util.tree_map(dq, qtree, is_leaf=_is_q)

@@ -100,7 +100,14 @@ class RaggedInferenceEngineV2:
                  max_seq_len: int = 512, prefill_chunk: int = 128,
                  rng: Optional[jax.Array] = None, page_size: int = 64,
                  num_pages: Optional[int] = None, topology=None,
-                 decode_block_size: int = 8):
+                 decode_block_size: int = 8,
+                 kv_cache_dtype: str = "none",
+                 quantize_weights: Optional[str] = None):
+        """``kv_cache_dtype``: "none" | "fp8" | "int8" — paged KV pool
+        storage format (reference fp_quantizer KV quantization).
+        ``quantize_weights``: None | "int8" | "fp8" | "fp6" — weights
+        persist quantized in HBM and dequantize in-jit at use (reference
+        FP6-LLM cuda_linear / int8 quantized inference)."""
         mcfg = getattr(model, "config", None)
         assert dataclasses.is_dataclass(mcfg) and hasattr(mcfg, "decode"), \
             "ragged engine needs a model-zoo module with a decode config"
@@ -135,7 +142,7 @@ class RaggedInferenceEngineV2:
             mcfg, decode=True, ragged_decode=False, paged_decode=True,
             max_cache_len=max_seq_len, scan_layers=False,
             kv_page_size=self.page_size, kv_num_pages=self.num_pages,
-            tensor_parallel=self.tp > 1)
+            tensor_parallel=self.tp > 1, kv_cache_dtype=kv_cache_dtype)
         self.model = type(model)(self.cfg)
         self.max_seqs = max_seqs
         self.max_seq_len = max_seq_len
@@ -150,6 +157,27 @@ class RaggedInferenceEngineV2:
             model, params,
             plain_model=type(model)(dataclasses.replace(mcfg,
                                                         decode=False)))
+        self._wq = quantize_weights
+        if quantize_weights is not None:
+            assert self.tp <= 1, (
+                "quantize_weights does not compose with tensor-parallel "
+                "serving yet — quantized leaves carry their own "
+                "group-scale layout")
+            from deepspeed_tpu.inference.quantization import \
+                quantize_param_tree
+            from deepspeed_tpu.parallel import tensor_parallel as tp_lib
+
+            # unbox flax Partitioned metadata FIRST: the quantizer's
+            # leaf-name check reads path tails, which inside a metadata
+            # box are the box's own keys — boxed trees would silently
+            # pass through unquantized
+            if tp_lib.has_partitioning(params):
+                params = tp_lib.unbox_params(params)
+            params, b0, b1 = quantize_param_tree(params, quantize_weights)
+            params = jax.device_put(params)
+            log_dist(f"ragged engine weights -> {quantize_weights}: "
+                     f"{b0 / 2**20:.1f} MiB -> {b1 / 2**20:.1f} MiB "
+                     f"({b0 / max(b1, 1):.2f}x)", ranks=[0])
         self.params = self._place_params(params)
 
         self.allocator = PageAllocator(self.num_pages, self.page_size)
@@ -188,7 +216,16 @@ class RaggedInferenceEngineV2:
             specs = tp_lib.extract_partition_specs(
                 {"params": params}, self.mesh.axis_names)["params"]
             params = tp_lib.unbox_params(params)
+            # training-oriented metadata (e.g. a MoE bank's `expert` axis
+            # with no `tensor` entries) doesn't shard a TP serving mesh —
+            # fall back to AutoTP name rules
+            if not any("tensor" in tuple(s)
+                       for s in jax.tree_util.tree_leaves(
+                           specs, is_leaf=lambda x: isinstance(x, P))):
+                specs = None
         else:
+            specs = None
+        if specs is None:
             specs = tp_lib.auto_tp_specs(params, self.tp)
             log_dist("ragged engine AutoTP: inferred tensor-parallel "
                      "sharding from parameter names", ranks=[0])
@@ -201,12 +238,18 @@ class RaggedInferenceEngineV2:
 
     def _cache_sharding(self, leaf_shape):
         """KV page pools shard their combined-head dim over `tensor`
-        (reference v2 KV sharding: heads split over the TP group)."""
+        (reference v2 KV sharding: heads split over the TP group); the
+        quantized pools' [P, page, 2Hkv] scale buffers shard the same
+        head dim."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        if self.tp <= 1 or len(leaf_shape) != 4:
+        if self.tp <= 1:
             return None
-        return NamedSharding(self.mesh, P(None, None, "tensor", None))
+        if len(leaf_shape) == 4:
+            return NamedSharding(self.mesh, P(None, None, "tensor", None))
+        if len(leaf_shape) == 3:
+            return NamedSharding(self.mesh, P(None, None, "tensor"))
+        return None
 
     # -- request API ----------------------------------------------------
 
@@ -283,9 +326,15 @@ class RaggedInferenceEngineV2:
 
         model = self.model
         unroll = self._unroll_params
+        wq = self._wq
 
         def run(params, cache, token_ids, positions, kv_lens, page_indices,
                 cu_q_lens, num_seqs, new_kv_dest, sample_rows):
+            if wq:
+                from deepspeed_tpu.inference.quantization import \
+                    dequantize_param_tree
+
+                params = dequantize_param_tree(params)
             if unroll:
                 params = unroll_scan_params(params)
             meta = {"kv_lens": kv_lens, "page_indices": page_indices,
@@ -321,9 +370,16 @@ class RaggedInferenceEngineV2:
         page = self.page_size
         max_len = self.max_seq_len
 
+        wq = self._wq
+
         def run(params, cache, last_tok, pos, active, remaining,
                 page_table, eos_ids, do_sample, temperature, top_k, top_p,
                 rng):
+            if wq:
+                from deepspeed_tpu.inference.quantization import \
+                    dequantize_param_tree
+
+                params = dequantize_param_tree(params)
             if unroll:
                 params = unroll_scan_params(params)
 

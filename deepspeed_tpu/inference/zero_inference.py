@@ -41,13 +41,16 @@ class NvmeWeightStore:
     """Per-layer param subtrees on NVMe, async-read with prefetch."""
 
     def __init__(self, nvme_path: str, layers: List[Any],
-                 aio_block_size: int = 1 << 20, aio_thread_count: int = 8):
+                 aio_block_size: int = 1 << 20, aio_thread_count: int = 8,
+                 aio_queue_depth: int = 64, aio_use_odirect: bool = False):
         from deepspeed_tpu.io.aio import aio_handle
 
         os.makedirs(nvme_path, exist_ok=True)
         self.dir = nvme_path
         self.handle = aio_handle(block_size=aio_block_size,
-                                 thread_count=aio_thread_count)
+                                 thread_count=aio_thread_count,
+                                 queue_depth=aio_queue_depth,
+                                 use_odirect=aio_use_odirect)
         self._layout = None            # [(path_key, shape, dtype, offset)]
         self.n_layers = len(layers)
         total = 0
@@ -112,7 +115,8 @@ class NvmeWeightStreamingEngine:
 
     def __init__(self, model, params: Any, nvme_path: str,
                  max_batch_size: int = 8, max_out_tokens: int = 256,
-                 aio_block_size: int = 1 << 20, aio_thread_count: int = 8):
+                 aio_block_size: int = 1 << 20, aio_thread_count: int = 8,
+                 aio_queue_depth: int = 64, aio_use_odirect: bool = False):
         import dataclasses
 
         from deepspeed_tpu.inference.common import (normalize_params,
@@ -145,7 +149,9 @@ class NvmeWeightStreamingEngine:
         }
         self.store = NvmeWeightStore(nvme_path, layers,
                                      aio_block_size=aio_block_size,
-                                     aio_thread_count=aio_thread_count)
+                                     aio_thread_count=aio_thread_count,
+                                     aio_queue_depth=aio_queue_depth,
+                                     aio_use_odirect=aio_use_odirect)
         self.max_batch_size = max_batch_size
         self.max_out_tokens = max_out_tokens
         self._block_fn = None

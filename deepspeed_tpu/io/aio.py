@@ -73,6 +73,12 @@ def _ensure_built() -> ctypes.CDLL:
         lib.aio_create.restype = ctypes.c_void_p
         lib.aio_create.argtypes = [ctypes.c_int, ctypes.c_int64,
                                    ctypes.c_int]
+        lib.aio_create2.restype = ctypes.c_void_p
+        lib.aio_create2.argtypes = [ctypes.c_int, ctypes.c_int64,
+                                    ctypes.c_int, ctypes.c_int,
+                                    ctypes.c_int]
+        lib.aio_backend.restype = ctypes.c_int
+        lib.aio_backend.argtypes = [ctypes.c_void_p]
         lib.aio_destroy.argtypes = [ctypes.c_void_p]
         for fn in (lib.aio_submit_read, lib.aio_submit_write):
             fn.restype = ctypes.c_int64
@@ -104,15 +110,25 @@ class aio_handle:
     """Reference ``aio_handle`` surface (``deepspeed_py_io_handle.cpp``):
     thread-pooled, chunk-parallel file I/O with sync and async calls."""
 
-    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 128,
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 64,
                  single_submit: bool = False, overlap_events: bool = True,
-                 thread_count: int = 8, use_odirect: bool = False):
-        del queue_depth, single_submit, overlap_events  # libaio-era knobs
+                 thread_count: int = 8, use_odirect: bool = False,
+                 backend: str = "auto"):
+        """``queue_depth``: io_uring ops in flight per worker (the
+        reference's libaio queue_depth — device parallelism comes from
+        ring depth, not threads).  ``backend``: "auto" | "uring" |
+        "threadpool"."""
+        del single_submit, overlap_events   # libaio-era knobs
         self._lib = _ensure_built()
-        self._h = self._lib.aio_create(int(thread_count), int(block_size),
-                                       int(bool(use_odirect)))
+        bk = {"auto": -1, "uring": 1, "threadpool": 0}[backend]
+        self._h = self._lib.aio_create2(int(thread_count), int(block_size),
+                                        int(bool(use_odirect)), bk,
+                                        int(queue_depth))
         self.block_size = block_size
         self.thread_count = thread_count
+        self.queue_depth = queue_depth
+        self.backend = ("uring" if self._lib.aio_backend(self._h) == 1
+                        else "threadpool")
         # keep submitted buffers alive until wait() (the C side reads them)
         self._live: dict = {}
 

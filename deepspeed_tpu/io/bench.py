@@ -31,7 +31,11 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 DEFAULT_BLOCK_SIZES = [1 << 20, 8 << 20]          # 1M, 8M
-DEFAULT_THREAD_COUNTS = [1, 4, 8, 16]
+DEFAULT_THREAD_COUNTS = [1, 4]
+# io_uring ring depth: the reference's libaio queue_depth axis — on
+# NVMe this is the lever that matters, not thread count
+DEFAULT_QUEUE_DEPTHS = [32, 128]
+DEFAULT_ODIRECT = [False, True]
 
 
 def _sync_and_evict(path: str) -> None:
@@ -51,9 +55,11 @@ def _sync_and_evict(path: str) -> None:
 
 
 def bench_point(directory: str, size_bytes: int, block_size: int,
-                thread_count: int, loops: int = 3
+                thread_count: int, loops: int = 3,
+                queue_depth: int = 64, use_odirect: bool = False
                 ) -> Tuple[float, float]:
-    """(read_gbps, write_gbps) for one (block_size, thread_count) point.
+    """(read_gbps, write_gbps) for one (block_size, thread_count,
+    queue_depth, odirect) point.
 
     Write timing includes the fsync (device flush), and the page cache is
     evicted (best effort) before each read so both directions measure
@@ -66,7 +72,8 @@ def bench_point(directory: str, size_bytes: int, block_size: int,
 
     if loops < 1:
         raise ValueError(f"loops must be >= 1, got {loops}")
-    h = aio_handle(block_size=block_size, thread_count=thread_count)
+    h = aio_handle(block_size=block_size, thread_count=thread_count,
+                   queue_depth=queue_depth, use_odirect=use_odirect)
     path = os.path.join(directory, f"dstpu_io_bench_{os.getpid()}.bin")
     buf = np.random.default_rng(0).integers(
         0, 255, size_bytes, dtype=np.uint8)
@@ -100,6 +107,8 @@ def bench_point(directory: str, size_bytes: int, block_size: int,
 def sweep(directory: str, size_bytes: int,
           block_sizes: Optional[List[int]] = None,
           thread_counts: Optional[List[int]] = None,
+          queue_depths: Optional[List[int]] = None,
+          odirect: Optional[List[bool]] = None,
           loops: int = 3, verbose: bool = True) -> List[Dict]:
     """Full sweep; one record per point, best combined read+write GB/s
     first (the swap workload is symmetric: every step reads AND writes
@@ -107,15 +116,21 @@ def sweep(directory: str, size_bytes: int,
     results = []
     for bs in (block_sizes or DEFAULT_BLOCK_SIZES):
         for tc in (thread_counts or DEFAULT_THREAD_COUNTS):
-            read_gbps, write_gbps = bench_point(
-                directory, size_bytes, bs, tc, loops=loops)
-            rec = {"block_size": bs, "thread_count": tc,
-                   "read_gbps": read_gbps, "write_gbps": write_gbps}
-            results.append(rec)
-            if verbose:
-                print(f"block={bs >> 20}M threads={tc:<3d} "
-                      f"read={read_gbps:6.2f} GB/s "
-                      f"write={write_gbps:6.2f} GB/s", flush=True)
+            for qd in (queue_depths or DEFAULT_QUEUE_DEPTHS):
+                for od in (DEFAULT_ODIRECT if odirect is None else odirect):
+                    read_gbps, write_gbps = bench_point(
+                        directory, size_bytes, bs, tc, loops=loops,
+                        queue_depth=qd, use_odirect=od)
+                    rec = {"block_size": bs, "thread_count": tc,
+                           "queue_depth": qd, "use_odirect": od,
+                           "read_gbps": read_gbps,
+                           "write_gbps": write_gbps}
+                    results.append(rec)
+                    if verbose:
+                        print(f"block={bs >> 20}M threads={tc:<3d} "
+                              f"qd={qd:<4d} odirect={int(od)} "
+                              f"read={read_gbps:6.2f} GB/s "
+                              f"write={write_gbps:6.2f} GB/s", flush=True)
     return sorted(results, key=lambda r: -(r["read_gbps"] +
                                            r["write_gbps"]))
 
@@ -123,17 +138,22 @@ def sweep(directory: str, size_bytes: int,
 def tune(directory: str, size_bytes: int = 256 << 20,
          block_sizes: Optional[List[int]] = None,
          thread_counts: Optional[List[int]] = None,
+         queue_depths: Optional[List[int]] = None,
+         odirect: Optional[List[bool]] = None,
          loops: int = 3, verbose: bool = True) -> Dict:
     """``ds_nvme_tune`` equivalent: run the sweep, return the winning
     config.  ``best["config"]`` is shaped exactly like the DeepSpeed
     config subtree it belongs in (``AioConfig``): paste it as the
     ``aio`` section."""
     results = sweep(directory, size_bytes, block_sizes=block_sizes,
-                    thread_counts=thread_counts, loops=loops,
-                    verbose=verbose)
+                    thread_counts=thread_counts,
+                    queue_depths=queue_depths, odirect=odirect,
+                    loops=loops, verbose=verbose)
     best = dict(results[0])
     best["config"] = {"aio": {"block_size": best["block_size"],
-                              "thread_count": best["thread_count"]}}
+                              "thread_count": best["thread_count"],
+                              "queue_depth": best["queue_depth"],
+                              "use_odirect": best["use_odirect"]}}
     return best
 
 
@@ -155,17 +175,25 @@ def main(argv=None) -> None:
                    help="block sizes in bytes")
     p.add_argument("--threads", type=int, nargs="*",
                    help="thread counts")
+    p.add_argument("--queue-depths", type=int, nargs="*",
+                   help="io_uring ring depths")
+    p.add_argument("--odirect", type=int, nargs="*", choices=[0, 1],
+                   help="O_DIRECT settings to sweep (0/1)")
     p.add_argument("--tune", action="store_true",
                    help="print the winning config as a JSON line")
     args = p.parse_args(argv)
     size = args.size_mb << 20
+    od = None if args.odirect is None else [bool(v) for v in args.odirect]
     if args.tune:
         best = tune(args.dir, size, block_sizes=args.block_sizes,
-                    thread_counts=args.threads, loops=args.loops)
+                    thread_counts=args.threads,
+                    queue_depths=args.queue_depths, odirect=od,
+                    loops=args.loops)
         print(json.dumps(best))
     else:
         sweep(args.dir, size, block_sizes=args.block_sizes,
-              thread_counts=args.threads, loops=args.loops)
+              thread_counts=args.threads, queue_depths=args.queue_depths,
+              odirect=od, loops=args.loops)
 
 
 if __name__ == "__main__":

@@ -64,6 +64,10 @@ class LlamaConfig:
     paged_decode: bool = False
     kv_page_size: int = 64
     kv_num_pages: int = 0                  # 0 -> engine must set it
+    # paged KV pool storage format: "none" (model dtype), "fp8" (e4m3) or
+    # "int8" — per-(row, head) scales, dequantized transiently at
+    # attention (reference fp_quantizer KV configs)
+    kv_cache_dtype: str = "none"
     # family knobs shared with Mistral/Qwen2 (both are Llama-shaped):
     # qkv-projection biases (Qwen2) and sliding-window attention
     # (Mistral) — None disables the window

@@ -86,7 +86,8 @@ class MixtralBlock(nn.Module):
                                                       ragged_meta)
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
                     name="post_attention_layernorm")(x)
-        y, l_aux = _moe(cfg, "block_sparse_moe")(h)
+        y, l_aux = _moe(cfg, "block_sparse_moe")(h,
+                                                 is_training=not deterministic)
         return x + y, l_aux
 
 

@@ -1,0 +1,71 @@
+"""Phi-3 model family.
+
+Reference serves Phi-3 through its FastGen v2 registry
+(``inference/v2/model_implementations/phi3/model.py``,
+``containers.py``): architecturally a Llama — RMSNorm, RoPE, GQA, SwiGLU,
+untied LM head — whose HF checkpoints FUSE the attention projections into
+one ``qkv_proj`` and the MLP gate/up into one ``gate_up_proj`` (the
+reference maps them with ``FusedQKVParameter`` / ``FusedGatedMLPParameter``).
+
+Here the module IS :class:`deepspeed_tpu.models.llama.LlamaForCausalLM`
+(split projections are the better TPU layout — XLA fuses the three
+matmuls' reads anyway and AutoTP shards each on its own dim); family
+identity lives in :class:`Phi3Config` so the HF loader
+(``module_inject/hf_loader.py``) knows to split the fused tensors.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                        LlamaLMLoss, count_params,
+                                        flops_per_token)
+
+__all__ = ["Phi3Config", "Phi3ForCausalLM", "Phi3LMLoss", "get_config",
+           "count_params", "flops_per_token"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Phi3Config(LlamaConfig):
+    """Llama-shaped; the dataclass name routes the HF converter to the
+    fused-weight splitter (reference ``phi3/containers.py`` PARAM_MAPPING:
+    ``self_attn.qkv_proj.weight``, ``mlp.gate_up_proj.weight``)."""
+
+
+# Phi-3 HF configs (microsoft/Phi-3-*): head_dim 96/128, vocab 32064
+PRESETS = {
+    "phi3-mini": dict(vocab_size=32064, hidden_size=3072,
+                      intermediate_size=8192, num_hidden_layers=32,
+                      num_attention_heads=32, num_key_value_heads=32,
+                      max_position_embeddings=4096, rms_norm_eps=1e-5,
+                      rope_theta=10000.0),
+    "phi3-small": dict(vocab_size=100352, hidden_size=4096,
+                       intermediate_size=14336, num_hidden_layers=32,
+                       num_attention_heads=32, num_key_value_heads=8,
+                       max_position_embeddings=8192, rope_theta=10000.0),
+    "phi3-medium": dict(vocab_size=32064, hidden_size=5120,
+                        intermediate_size=17920, num_hidden_layers=40,
+                        num_attention_heads=40, num_key_value_heads=10,
+                        max_position_embeddings=4096, rope_theta=10000.0),
+    "tinyphi3": dict(vocab_size=96, hidden_size=32, intermediate_size=64,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     num_key_value_heads=2, max_position_embeddings=64),
+}
+
+
+def get_config(preset: str, **overrides) -> Phi3Config:
+    kw = dict(PRESETS[preset])
+    kw.update(overrides)
+    kw.setdefault("dtype", jnp.bfloat16)
+    return Phi3Config(**kw)
+
+
+class Phi3ForCausalLM(LlamaForCausalLM):
+    """Same module; the subclass keeps ``type(model)(cfg)`` reconstruction
+    (inference engines) inside the Phi-3 family."""
+
+
+class Phi3LMLoss(LlamaLMLoss):
+    pass

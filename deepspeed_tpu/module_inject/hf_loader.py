@@ -186,6 +186,79 @@ def _convert_llama(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     return _nest(flat)
 
 
+def _convert_phi3(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """Phi-3 fuses qkv into ``qkv_proj`` and gate/up into ``gate_up_proj``
+    (reference ``phi3/containers.py`` FusedQKVParameter /
+    FusedGatedMLPParameter); split them onto the Llama layout."""
+    L = cfg.num_hidden_layers
+    H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                  cfg.head_dim)
+    layers = []
+    for i in range(L):
+        p = f"model.layers.{i}."
+        qkv = sd[p + "self_attn.qkv_proj.weight"]     # [(H+2Hkv)*Dh, E]
+        q, k_, v = np.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=0)
+        gate_up = sd[p + "mlp.gate_up_proj.weight"]   # [2*I, E]
+        gate, up = np.split(gate_up, 2, axis=0)
+        layers.append({
+            "input_layernorm/scale": sd[p + "input_layernorm.weight"],
+            "post_attention_layernorm/scale":
+                sd[p + "post_attention_layernorm.weight"],
+            "self_attn/q_proj/kernel": q.T,
+            "self_attn/k_proj/kernel": k_.T,
+            "self_attn/v_proj/kernel": v.T,
+            "self_attn/o_proj/kernel": sd[p + "self_attn.o_proj.weight"].T,
+            "mlp/gate_proj/kernel": gate.T,
+            "mlp/up_proj/kernel": up.T,
+            "mlp/down_proj/kernel": sd[p + "mlp.down_proj.weight"].T,
+        })
+    flat = {
+        "model/embed_tokens/embedding": sd["model.embed_tokens.weight"],
+        "model/norm/scale": sd["model.norm.weight"],
+        "lm_head/kernel": (sd.get("lm_head.weight",
+                                  sd["model.embed_tokens.weight"])).T,
+    }
+    _place_layers(flat, layers, cfg, prefix="model/layers")
+    return _nest(flat)
+
+
+def _convert_qwen2_moe(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """Qwen2-MoE (reference ``qwen_v2_moe/container.py``): Qwen2 attention
+    (qkv biases) + routed experts + dense shared expert with sigmoid
+    gate."""
+    L = cfg.num_hidden_layers
+    E = cfg.num_local_experts
+    layers = []
+    for i in range(L):
+        p = f"model.layers.{i}."
+        layer = _llama_layer(sd, p, qkv_bias=True)
+        moe = p + "mlp."
+        layer["mlp/gate"] = sd[moe + "gate.weight"].T
+        layer["mlp/w1"] = np.stack(
+            [sd[f"{moe}experts.{e}.gate_proj.weight"].T for e in range(E)])
+        layer["mlp/w3"] = np.stack(
+            [sd[f"{moe}experts.{e}.up_proj.weight"].T for e in range(E)])
+        layer["mlp/w2"] = np.stack(
+            [sd[f"{moe}experts.{e}.down_proj.weight"].T for e in range(E)])
+        if getattr(cfg, "shared_expert_intermediate_size", 0):
+            for ours, theirs in (("gate_proj", "gate_proj"),
+                                 ("up_proj", "up_proj"),
+                                 ("down_proj", "down_proj")):
+                layer[f"shared_expert/{ours}/kernel"] = \
+                    sd[f"{moe}shared_expert.{theirs}.weight"].T
+            layer["shared_expert_gate/kernel"] = \
+                sd[moe + "shared_expert_gate.weight"].T
+        layers.append(layer)
+    flat = {
+        "model/embed_tokens/embedding": sd["model.embed_tokens.weight"],
+        "model/norm/scale": sd["model.norm.weight"],
+        "lm_head/kernel": (sd.get("lm_head.weight",
+                                  sd["model.embed_tokens.weight"])).T,
+    }
+    _place_layers(flat, layers, cfg, prefix="model/layers")
+    return _nest(flat)
+
+
 def _convert_mixtral(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     L = cfg.num_hidden_layers
     E = cfg.num_local_experts
@@ -230,6 +303,10 @@ _CONVERTERS = {
     "MistralConfig": _convert_llama,
     "Qwen2Config": _convert_llama,
     "MixtralConfig": _convert_mixtral,
+    # Phi-3: Llama-shaped with FUSED qkv/gate_up tensors (split on load);
+    # Qwen2-MoE: routed experts + shared expert w/ sigmoid gate
+    "Phi3Config": _convert_phi3,
+    "Qwen2MoeConfig": _convert_qwen2_moe,
 }
 
 

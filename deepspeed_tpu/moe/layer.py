@@ -70,6 +70,9 @@ class MoE(nn.Module):
     expert_parallel: bool = True           # annotate the expert mesh axis
     tensor_parallel: bool = False          # shard expert FFN over `tensor`
     noisy_gate_policy: Optional[str] = None  # None | "Jitter"
+    # renormalize the selected experts' gates to sum to 1 (Mixtral); False
+    # keeps raw softmax gates (Qwen2-MoE norm_topk_prob=False)
+    normalize_weights: bool = True
     # "sorted": expert-sorted row gathers feeding the dense batched FFN —
     # linear in token count, no [G, E, C] one-hots, no scatter anywhere
     # (fwd or bwd); the TPU equivalent of the reference's grouped MoE
@@ -172,7 +175,8 @@ class MoE(nn.Module):
             capacity_factor=(self.capacity_factor if is_training
                              else self.eval_capacity_factor),
             min_capacity=self.min_capacity, drop_tokens=self.drop_tokens,
-            noise_rng=noise_rng), logits
+            noise_rng=noise_rng,
+            normalize_weights=self.normalize_weights), logits
 
     # -- the multi-chip linear path --------------------------------------
 

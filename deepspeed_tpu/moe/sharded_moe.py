@@ -48,13 +48,18 @@ def topkgating(logits: jax.Array, k: int = 1,
                capacity_factor: float = 1.0, min_capacity: int = 4,
                drop_tokens: bool = True,
                noise_rng: Optional[jax.Array] = None,
-               noise_eps: float = 1e-2) -> GatingResult:
+               noise_eps: float = 1e-2,
+               normalize_weights: bool = True) -> GatingResult:
     """Top-k gating with capacity-bounded dispatch.
 
     Covers the reference's ``top1gating``/``top2gating``/``topkgating``:
     iterative argmax selection, position-in-expert via token cumsum, gate
     normalization over the selected experts (top2-style), capacity drop, and
     the switch-transformer load-balancing aux loss from the first choice.
+
+    ``normalize_weights=False`` keeps the raw softmax gate values of the
+    selected experts (Qwen2-MoE ``norm_topk_prob=False``; the reference's
+    topkgating exposes the same toggle, ``sharded_moe.py:374``).
     """
     G, E = logits.shape
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -101,8 +106,11 @@ def topkgating(logits: jax.Array, k: int = 1,
     # BEFORE computing gates1_s/gates2_s (top2gating, sharded_moe.py:290), so
     # when one choice drops the other absorbs the full weight (sums to 1)
     gate_k = [jnp.sum(gates * m, axis=-1) for m in masks]        # k x [G]
-    denom = sum(g * keep for g, keep in zip(gate_k, keeps))
-    denom = jnp.maximum(denom, jnp.finfo(jnp.float32).eps)
+    if normalize_weights:
+        denom = sum(g * keep for g, keep in zip(gate_k, keeps))
+        denom = jnp.maximum(denom, jnp.finfo(jnp.float32).eps)
+    else:
+        denom = jnp.ones_like(gate_k[0])
 
     combine = jnp.zeros((G, E, C), jnp.float32)
     weights_k = []

@@ -192,6 +192,12 @@ def auto_tp_specs(params, tp_size: int,
                 got = _shard(-2)
             elif is_col:
                 got = _shard(-1)
+        elif leaf_name in ("w1", "w2", "w3") and len(shape) >= 3:
+            # stacked expert tensors [E, in, out] (MoE layers store the
+            # whole expert bank as one leaf): w1/w3 are column-parallel
+            # (output dim), w2 row-parallel (input dim) — the reference's
+            # MoE TP policy (module_inject auto_tp w1/w3 vs w2)
+            got = _shard(-2) if leaf_name == "w2" else _shard(-1)
         elif leaf_name in ("bias", "b") and shape:
             # column-parallel biases follow the sharded output; row-parallel
             # biases are added after the all-reduce and must replicate

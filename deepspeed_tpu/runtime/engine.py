@@ -361,7 +361,9 @@ class DeepSpeedEngine:
                 weight_decay=float(p_cfg.get("weight_decay", 0.0)),
                 adam_w_mode=_adam_w,
                 aio_block_size=config.aio.block_size,
-                aio_thread_count=config.aio.thread_count)
+                aio_thread_count=config.aio.thread_count,
+                aio_queue_depth=config.aio.queue_depth,
+                aio_use_odirect=config.aio.use_odirect)
             opt_state, opt_shardings, opt_specs = (), (), None
         elif self._onebit_axes is not None:
             opt_state, opt_shardings = self._init_onebit_opt_state(params)

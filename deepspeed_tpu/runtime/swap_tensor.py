@@ -151,7 +151,9 @@ class NvmeOptimizerSwapper:
                  eps: float = 1e-8, weight_decay: float = 0.0,
                  adam_w_mode: bool = True,
                  aio_block_size: int = 1 << 20,
-                 aio_thread_count: int = 8):
+                 aio_thread_count: int = 8,
+                 aio_queue_depth: int = 64,
+                 aio_use_odirect: bool = False):
         from deepspeed_tpu.io.aio import aio_handle
 
         # pid-scoped: two jobs pointing at the same NVMe mount must not
@@ -175,7 +177,9 @@ class NvmeOptimizerSwapper:
         self._restored = False              # a load_from() succeeded
         self._reshard_warned = False
         self.handle = aio_handle(block_size=aio_block_size,
-                                 thread_count=aio_thread_count)
+                                 thread_count=aio_thread_count,
+                                 queue_depth=aio_queue_depth,
+                                 use_odirect=aio_use_odirect)
         self.b1, self.b2 = float(betas[0]), float(betas[1])
         self.eps = float(eps)
         self.wd = float(weight_decay)

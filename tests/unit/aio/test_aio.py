@@ -103,10 +103,43 @@ def test_io_bench_sweep_and_tune(tmp_path):
     from deepspeed_tpu.io.bench import sweep, tune
 
     results = sweep(str(tmp_path), 1 << 20, block_sizes=[1 << 18],
-                    thread_counts=[1, 2], loops=1, verbose=False)
+                    thread_counts=[1, 2], queue_depths=[32],
+                    odirect=[False], loops=1, verbose=False)
     assert len(results) == 2
     assert all(r["read_gbps"] > 0 and r["write_gbps"] > 0 for r in results)
     best = tune(str(tmp_path), 1 << 20, loops=1, verbose=False)
     # shaped like the AioConfig subtree so it pastes into a config as-is
-    assert best["config"]["aio"]["thread_count"] in (1, 4, 8, 16)
-    assert best["config"]["aio"]["block_size"] >= 1 << 20
+    aio_cfg = best["config"]["aio"]
+    assert aio_cfg["thread_count"] in (1, 4, 8, 16)
+    assert aio_cfg["block_size"] >= 1 << 20
+    assert aio_cfg["queue_depth"] in (32, 128)
+    assert isinstance(aio_cfg["use_odirect"], bool)
+
+
+def test_uring_backend_selected_and_roundtrips(tmp_path):
+    """The io_uring backend (raw-syscall rings, reference libaio
+    queue_depth equivalent) is the default where the kernel supports it,
+    and all four (backend x odirect) paths roundtrip correctly."""
+    import numpy as np
+
+    from deepspeed_tpu.io.aio import aio_handle
+
+    data = np.random.default_rng(1).integers(0, 255, 3 << 20,
+                                             dtype=np.uint8)
+    for backend in ("uring", "threadpool", "auto"):
+        for od in (False, True):
+            h = aio_handle(block_size=1 << 18, thread_count=2,
+                           queue_depth=16, use_odirect=od,
+                           backend=backend)
+            if backend == "uring":
+                assert h.backend == "uring"
+            path = str(tmp_path / f"rt_{backend}_{int(od)}.bin")
+            h.sync_pwrite(data, path)
+            out = np.empty_like(data)
+            h.sync_pread(out, path)
+            assert np.array_equal(out, data), (backend, od)
+            # unaligned offset exercise (O_DIRECT must fall back)
+            h.sync_pwrite(data[: 1 << 16], path, offset=1000)
+            out2 = np.empty(1 << 16, np.uint8)
+            h.sync_pread(out2, path, offset=1000)
+            assert np.array_equal(out2, data[: 1 << 16])
