@@ -1,3 +1,11 @@
 from deepspeed_tpu.autotuning.autotuner import Autotuner, ModelInfo
+from deepspeed_tpu.autotuning.scheduler import (ExperimentScheduler,
+                                                GridSearchTuner,
+                                                ModelBasedTuner,
+                                                RandomTuner, expand_space,
+                                                make_subprocess_runner,
+                                                tune_space)
 
-__all__ = ["Autotuner", "ModelInfo"]
+__all__ = ["Autotuner", "ModelInfo", "ExperimentScheduler",
+           "GridSearchTuner", "RandomTuner", "ModelBasedTuner",
+           "expand_space", "make_subprocess_runner", "tune_space"]

@@ -1,0 +1,55 @@
+"""Single-experiment subprocess entry (reference: the training script
+relaunched by the autotuner's ResourceManager with ``--autotuning run``).
+
+Builds the user's model via the ``--factory`` import path, runs a few
+timed ``train_batch`` steps under the candidate ds_config, and writes
+``{"metric_val": samples_per_sec}`` to ``--out``.  Any failure (OOM,
+compile error, bad config) exits nonzero — the parent quarantines it.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import time
+
+
+def _load_factory(spec: str):
+    mod_name, _, fn_name = spec.partition(":")
+    assert fn_name, f"--factory must be 'pkg.module:fn', got {spec!r}"
+    return getattr(importlib.import_module(mod_name), fn_name)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", required=True)
+    p.add_argument("--factory", required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--steps", type=int, default=3)
+    args = p.parse_args(argv)
+
+    import jax
+
+    import deepspeed_tpu
+
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    model, batch_fn = _load_factory(args.factory)()
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, config=ds_config, example_batch=batch_fn(1),
+        rng=jax.random.PRNGKey(0))
+    batch = batch_fn(engine.config.train_batch_size)
+    engine.train_batch(batch=batch)             # compile
+    t0 = time.perf_counter()
+    loss = None
+    for _ in range(args.steps):
+        loss = engine.train_batch(batch=batch)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / args.steps
+    with open(args.out, "w") as f:
+        json.dump({"metric_val": engine.config.train_batch_size / dt,
+                   "seconds_per_step": dt}, f)
+
+
+if __name__ == "__main__":
+    main()

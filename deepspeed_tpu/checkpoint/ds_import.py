@@ -1,0 +1,170 @@
+"""Ingest reference (torch-DeepSpeed) ZeRO checkpoints.
+
+The migration path the reference provides as ``ds_to_universal.py`` /
+``zero_to_fp32.py`` (``deepspeed/checkpoint/ds_to_universal.py:112,232``,
+``deepspeed/utils/zero_to_fp32.py``): a torch-DeepSpeed training run
+leaves per-rank files
+
+- ``mp_rank_00_model_states.pt`` — module state dict (possibly 16-bit) +
+  ``param_shapes`` (ordered {name: shape} per optimizer group),
+- ``zero_pp_rank_{dp}_mp_rank_{mp}_optim_states.pt`` — the rank's flat
+  fp32 partition(s): ``single_partition_of_fp32_groups`` (stage 1/2) or
+  ``fp32_flat_groups`` (stage 3).
+
+:func:`consolidate_reference_zero_checkpoint` reproduces the reference
+consolidation: concatenate each group's per-rank flat partitions, strip
+the stage-3 round-robin padding, and split by ``param_shapes`` into a
+named fp32 state dict.  :func:`load_reference_checkpoint` then feeds it
+through the HF-layout converters (``module_inject/hf_loader.py``) into a
+flax params tree — torch-DeepSpeed runs migrate without ever loading
+torch-DeepSpeed.
+
+Scope: mp_size 1 checkpoints (TP resharding of a torch checkpoint is the
+reference's own ds_to_universal + load pipeline; our engines reshard
+from the FULL tree at load time anyway, so consolidation is the part
+that matters).
+"""
+from __future__ import annotations
+
+import glob
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["consolidate_reference_zero_checkpoint",
+           "load_reference_checkpoint"]
+
+
+def _torch_load(path: str):
+    import torch
+
+    return torch.load(path, map_location="cpu", weights_only=False)
+
+
+def _to_np(t) -> np.ndarray:
+    import torch
+
+    if isinstance(t, torch.Tensor):
+        return t.detach().to(torch.float32).cpu().numpy()
+    return np.asarray(t, np.float32)
+
+
+def _find_tag_dir(ckpt_dir: str, tag: Optional[str]) -> str:
+    if tag is None:
+        latest = os.path.join(ckpt_dir, "latest")
+        if os.path.isfile(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+    if tag is not None:
+        cand = os.path.join(ckpt_dir, tag)
+        if os.path.isdir(cand):
+            return cand
+    if glob.glob(os.path.join(ckpt_dir, "*_model_states.pt")):
+        return ckpt_dir
+    raise FileNotFoundError(
+        f"no reference DeepSpeed checkpoint under {ckpt_dir!r} "
+        f"(tag={tag!r}): expected <dir>/<tag>/*_model_states.pt")
+
+
+def _ordered_shapes(param_shapes) -> List[Dict[str, tuple]]:
+    """``param_shapes`` is one ordered {name: shape} dict per optimizer
+    group (newer checkpoints) or a single dict (older)."""
+    if isinstance(param_shapes, dict):
+        param_shapes = [param_shapes]
+    return [{k: tuple(int(d) for d in v) for k, v in g.items()}
+            for g in param_shapes]
+
+
+def consolidate_reference_zero_checkpoint(
+        ckpt_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Reference ``zero_to_fp32`` consolidation: named fp32 tensors from
+    the per-rank flat partitions."""
+    d = _find_tag_dir(ckpt_dir, tag)
+    model_files = sorted(glob.glob(os.path.join(d, "*_model_states.pt")))
+    assert model_files, f"no *_model_states.pt under {d}"
+    mp_files = [f for f in model_files if "mp_rank" in os.path.basename(f)]
+    assert len(mp_files) <= 1, (
+        "multi-TP reference checkpoints are not supported — run the "
+        "reference's own ds_to_universal first, or consolidate per "
+        "mp_rank")
+    model_sd = _torch_load(model_files[0])
+
+    optim_files = sorted(
+        glob.glob(os.path.join(d, "*_optim_states.pt")),
+        key=lambda p: [int(x) for x in re.findall(r"\d+",
+                                                  os.path.basename(p))])
+    if not optim_files:
+        # no ZeRO shards: the module weights are already whole
+        module = model_sd.get("module", model_sd)
+        return {k: _to_np(v) for k, v in module.items()}
+
+    param_shapes = _ordered_shapes(model_sd["param_shapes"])
+    per_rank = [_torch_load(f)["optimizer_state_dict"]
+                for f in optim_files]
+    world = len(per_rank)
+
+    stage3 = "fp32_flat_groups" in per_rank[0]
+    out: Dict[str, np.ndarray] = {}
+    if stage3:
+        # stage 3: each rank holds ceil(numel/world) of EVERY param,
+        # flattened group-wise with padding (reference zero_to_fp32
+        # _merge_zero3); concatenating rank partitions per group yields
+        # [world, group_pad] whose columns interleave per-param slices
+        for gi, shapes in enumerate(param_shapes):
+            flats = [_to_np(r["fp32_flat_groups"][gi]).reshape(-1)
+                     for r in per_rank]
+            offsets = [0] * world
+            for name, shape in shapes.items():
+                numel = int(np.prod(shape)) if shape else 1
+                per = -(-numel // world)            # padded per-rank slice
+                parts = []
+                for rk in range(world):
+                    sl = flats[rk][offsets[rk]:offsets[rk] + per]
+                    parts.append(sl)
+                    offsets[rk] += per
+                out[name] = np.concatenate(parts)[:numel].reshape(shape)
+    else:
+        # stage 1/2: each group's fp32 master is flat-partitioned across
+        # ranks (reference single_partition_of_fp32_groups); concat then
+        # split by shapes
+        for gi, shapes in enumerate(param_shapes):
+            key = ("single_partition_of_fp32_groups"
+                   if "single_partition_of_fp32_groups" in per_rank[0]
+                   else "fp32_flat_groups")
+            flat = np.concatenate(
+                [_to_np(r[key][gi]).reshape(-1) for r in per_rank])
+            off = 0
+            for name, shape in shapes.items():
+                numel = int(np.prod(shape)) if shape else 1
+                out[name] = flat[off:off + numel].reshape(shape)
+                off += numel
+            if off > flat.size:
+                raise ValueError(
+                    f"group {gi}: shapes need {off} elements, flat "
+                    f"partitions hold {flat.size}")
+    logger.info(f"consolidated reference ZeRO checkpoint: {len(out)} "
+                f"tensors from {world} rank partition(s) "
+                f"(stage {'3' if stage3 else '1/2'})")
+    return out
+
+
+def _strip_module_prefix(sd: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    if sd and all(k.startswith("module.") for k in sd):
+        return {k[len("module."):]: v for k, v in sd.items()}
+    return sd
+
+
+def load_reference_checkpoint(model: Any, ckpt_dir: str,
+                              tag: Optional[str] = None) -> Dict[str, Any]:
+    """torch-DeepSpeed run -> flax params for our engines: consolidate
+    the ZeRO shards, then map the named tensors through the HF-layout
+    converter for ``model``'s family."""
+    from deepspeed_tpu.module_inject import convert_hf_state_dict
+
+    sd = _strip_module_prefix(
+        consolidate_reference_zero_checkpoint(ckpt_dir, tag))
+    return convert_hf_state_dict(model, sd)

@@ -116,3 +116,109 @@ class DataAnalyzer:
                 out[fname[:-len("_metric_values.npy")]] = np.load(
                     os.path.join(save_path, fname), mmap_mode="r")
         return out
+
+
+# ---------------------------------------------------------------------------
+# Distributed map/reduce tier (reference data_analyzer.py:455
+# DistributedDataAnalyzer)
+# ---------------------------------------------------------------------------
+
+# fork-inherited worker context: pool.map pickles its ARGUMENTS even
+# under the fork start method, which breaks closure-based metrics (e.g.
+# make_vocab_rarity_metric) and serializes the whole dataset through a
+# pipe — globals set before Pool() are inherited by fork for free
+_DDA_CTX: Dict[str, Any] = {}
+
+
+def _dda_worker(worker_id: int):
+    """One worker's map phase (module-level for multiprocessing; reads
+    the fork-inherited context, receives only its worker id)."""
+    return DataAnalyzer(_DDA_CTX["fns"],
+                        num_workers=_DDA_CTX["w"],
+                        worker_id=worker_id).run(_DDA_CTX["dataset"])
+
+
+class DistributedDataAnalyzer:
+    """Map/reduce dataset analysis across worker processes.
+
+    Re-design of the reference ``DistributedDataAnalyzer``
+    (``data_analyzer.py:455``): the reference maps over torch-dist ranks
+    with per-rank thread splits and merges via collective sorts; here the
+    map phase forks ``num_workers`` local processes (each scanning its
+    stride — one JAX host process drives all chips, so dataset analysis
+    parallelism is process-level, not rank-level), and the reduce phase
+    merges in the parent and writes the metric tables plus sorted
+    sample-order indices.
+
+    ``metric_types`` per metric (reference semantics):
+
+    - ``"single_value_per_sample"`` (default): one float per sample;
+      merged table ``[num_samples]``, plus
+      ``<name>_index_to_sample_sorted.npy`` — sample ids ordered by
+      metric value (the reference's metric_to_sample index, used to form
+      curriculum difficulty buckets).
+    - ``"accumulate_value_over_samples"``: the metric returns an ARRAY
+      accumulated (summed) over samples — e.g. a vocabulary histogram;
+      merged by summing worker partials.
+    """
+
+    def __init__(self, metric_functions: Dict[str, Callable[[Any], Any]],
+                 metric_types: Optional[Dict[str, str]] = None,
+                 save_path: Optional[str] = None,
+                 num_workers: Optional[int] = None):
+        assert metric_functions, "no metric functions given"
+        self.metric_functions = dict(metric_functions)
+        self.metric_types = dict(metric_types or {})
+        for name, t in self.metric_types.items():
+            assert t in ("single_value_per_sample",
+                         "accumulate_value_over_samples"), t
+            assert name in self.metric_functions, name
+        self.save_path = save_path
+        self.num_workers = num_workers or min(os.cpu_count() or 1, 8)
+
+    def _split(self):
+        singles = {n: f for n, f in self.metric_functions.items()
+                   if self.metric_types.get(n, "single_value_per_sample")
+                   == "single_value_per_sample"}
+        accums = {n: f for n, f in self.metric_functions.items()
+                  if n not in singles}
+        return singles, accums
+
+    def run(self, dataset) -> Dict[str, np.ndarray]:
+        import multiprocessing as mp
+
+        singles, accums = self._split()
+        n = len(dataset)
+        w = max(1, min(self.num_workers, n))
+        merged: Dict[str, np.ndarray] = {}
+        if singles:
+            if w == 1:
+                merged.update(DataAnalyzer(singles).run(dataset))
+            else:
+                ctx = mp.get_context("fork")
+                _DDA_CTX.update(dataset=dataset, fns=singles, w=w)
+                try:
+                    with ctx.Pool(w) as pool:
+                        parts = pool.map(_dda_worker, range(w))
+                finally:
+                    _DDA_CTX.clear()
+                merged.update(DataAnalyzer.merge_worker_results(parts))
+        for name, fn in accums.items():
+            # accumulate metrics are cheap reductions; strided partials
+            # sum associatively
+            acc = None
+            for i in range(n):
+                v = np.asarray(fn(dataset[i]), np.float64)
+                acc = v if acc is None else acc + v
+            merged[name] = acc.astype(np.float32)
+        if self.save_path is not None:
+            os.makedirs(self.save_path, exist_ok=True)
+            for name, vals in merged.items():
+                np.save(os.path.join(self.save_path,
+                                     f"{name}_metric_values.npy"), vals)
+                if name in singles:
+                    np.save(os.path.join(
+                        self.save_path,
+                        f"{name}_index_to_sample_sorted.npy"),
+                        np.argsort(vals, kind="stable").astype(np.int64))
+        return merged

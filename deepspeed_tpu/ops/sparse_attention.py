@@ -147,6 +147,119 @@ class BSLongformerSparsityConfig(SparsityConfig):
         return self.check_and_propagate_first_head_layout(layout)
 
 
+class VariableSparsityConfig(SparsityConfig):
+    """Variable local windows + explicit global windows + random blocks
+    (reference ``sparsity_config.py:239``): ``local_window_blocks[i]``
+    sizes the i-th local window (last entry repeats), global blocks come
+    as indices or [start, end) ranges, plus seeded random blocks."""
+
+    def __init__(self, num_heads: int, block: int = 64,
+                 different_layout_per_head: bool = False,
+                 num_random_blocks: int = 0,
+                 local_window_blocks: Sequence[int] = (4,),
+                 global_block_indices: Sequence[int] = (0,),
+                 global_block_end_indices: Optional[Sequence[int]] = None,
+                 attention: str = "bidirectional",
+                 horizontal_global_attention: bool = False, seed: int = 0):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.local_window_blocks = list(local_window_blocks)
+        self.global_block_indices = list(global_block_indices)
+        if global_block_end_indices is not None:
+            assert len(global_block_end_indices) == \
+                len(self.global_block_indices), (
+                    "global_block_end_indices must pair 1:1 with "
+                    "global_block_indices")
+            for s, e in zip(self.global_block_indices,
+                            global_block_end_indices):
+                assert s < e, f"global range [{s}, {e}) is empty"
+        self.global_block_end_indices = (
+            None if global_block_end_indices is None
+            else list(global_block_end_indices))
+        assert attention in ("unidirectional", "bidirectional")
+        assert attention == "bidirectional" or \
+            not horizontal_global_attention, (
+                "horizontal global attention requires bidirectional")
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+        self.seed = seed
+
+    def _global_cols(self, nb: int):
+        if self.global_block_end_indices is None:
+            return [g for g in self.global_block_indices if g < nb]
+        cols = []
+        for s, e in zip(self.global_block_indices,
+                        self.global_block_end_indices):
+            cols.extend(range(s, min(e, nb)))
+        return cols
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        rng = np.random.default_rng(self.seed)
+        heads = layout.shape[0] if self.different_layout_per_head else 1
+        for h in range(heads):
+            # variable-size local windows: sizes from the list, the last
+            # size repeating for the remaining windows
+            start = 0
+            wi = 0
+            while start < nb:
+                size = self.local_window_blocks[
+                    min(wi, len(self.local_window_blocks) - 1)]
+                end = min(start + size, nb)
+                for i in range(start, end):
+                    hi = (i + 1) if self.attention == "unidirectional" \
+                        else end
+                    layout[h, i, start:hi] = True
+                start = end
+                wi += 1
+            for g in self._global_cols(nb):
+                if self.attention == "unidirectional":
+                    layout[h, g:, g] = True          # attended by later
+                else:
+                    layout[h, :, g] = True           # attended by all
+                if self.horizontal_global_attention:
+                    layout[h, g, :] = True
+            for i in range(nb):
+                if not self.num_random_blocks:
+                    break
+                bound = (i + 1) if self.attention == "unidirectional" \
+                    else nb
+                choices = rng.integers(0, max(bound, 1),
+                                       size=self.num_random_blocks)
+                layout[h, i, choices] = True
+        return self.check_and_propagate_first_head_layout(layout)
+
+
+class LocalSlidingWindowSparsityConfig(SparsityConfig):
+    """Purely-local sliding window (reference
+    ``sparsity_config.py:674``): each query block attends the
+    ``num_sliding_window_blocks`` centered on it (its causal half for
+    unidirectional attention) — no global blocks at all."""
+
+    def __init__(self, num_heads: int, block: int = 64,
+                 num_sliding_window_blocks: int = 3,
+                 attention: str = "unidirectional"):
+        super().__init__(num_heads, block)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        assert attention in ("unidirectional", "bidirectional")
+        self.attention = attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        nb = layout.shape[1]
+        assert nb >= self.num_sliding_window_blocks, (
+            f"need >= {self.num_sliding_window_blocks} blocks, "
+            f"seq has {nb}")
+        w = self.num_sliding_window_blocks // 2
+        for i in range(nb):
+            lo = max(0, i - w)
+            hi = (min(i + w + 1, nb)
+                  if self.attention == "bidirectional" else i + 1)
+            layout[:, i, lo:hi] = True
+        return self.check_and_propagate_first_head_layout(layout)
+
+
 class BigBirdSparsityConfig(SparsityConfig):
     """Sliding window + global edges + seeded random blocks (reference
     ``:411``)."""

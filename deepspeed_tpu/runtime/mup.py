@@ -1,0 +1,103 @@
+"""muP (Maximal Update Parametrization) optimizer scaling.
+
+Re-creation of the reference's muP optimizer integration
+(``runtime/engine.py:1479``: ``MuAdam/MuAdamW/MuSGD`` from the ``mup``
+package, Tensor Programs V, Yang & Hu et al.).  The mup package stores an
+``infshape`` on every torch parameter via ``set_base_shapes``; here the
+same information arrives as a ``base_shapes`` pytree (the shapes of the
+proxy base model's params) and the per-leaf learning-rate multipliers
+become an optax transform the engine chains after the base optimizer —
+the scalar schedule lr stays outside the jit, multipliers live inside.
+
+Scaling rules (TP-V Table 8; dims that differ from the base shape are
+the "infinite" width dims):
+
+==============  =====================  =====================
+leaf kind       Adam lr mult           SGD lr mult
+==============  =====================  =====================
+no inf dims     1                      1
+vector-like     1                      width_mult
+(1 inf dim)     (1/fan_in_mult if      (fan_out side) /
+                the inf dim is the     1/fan_in_mult (fan_in
+                fan_in — output        side — output
+                weights)               weights)
+matrix-like     1 / fan_in_mult        fan_out_mult /
+(2 inf dims)                           fan_in_mult
+==============  =====================  =====================
+
+Kernels follow the flax convention ``(..., fan_in, fan_out)``;
+embeddings ``(vocab, embd)`` are input-weight-like (their lookup is a
+selection, not a matmul over width) — the mup package classifies them
+the same way.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class MupScaleState(NamedTuple):
+    mults: Any            # params-shaped tree of f32 scalars
+
+
+def _leaf_mult(shape, base_shape, rule: str, path: str) -> float:
+    assert len(shape) == len(base_shape), (
+        f"muP base shape rank mismatch at {path}: {shape} vs {base_shape}")
+    ratios = [s / b for s, b in zip(shape, base_shape)]
+    inf = [i for i, (s, b) in enumerate(zip(shape, base_shape)) if s != b]
+    if not inf:
+        return 1.0
+    if len(shape) == 1:
+        # biases / norm scales: vector-like, width_mult = its ratio
+        return ratios[inf[0]] if rule == "sgd" else 1.0
+    fan_in_dim, fan_out_dim = len(shape) - 2, len(shape) - 1
+    fan_in_inf = fan_in_dim in inf
+    fan_out_inf = fan_out_dim in inf
+    if len(inf) >= 2 and fan_in_inf and fan_out_inf:    # hidden weights
+        return (ratios[fan_out_dim] / ratios[fan_in_dim] if rule == "sgd"
+                else 1.0 / ratios[fan_in_dim])
+    if fan_in_inf:                                      # output weights
+        return 1.0 / ratios[fan_in_dim]
+    if fan_out_inf:                                     # input weights
+        return ratios[fan_out_dim] if rule == "sgd" else 1.0
+    # a leading (e.g. scan-layer or expert) dim changed: layer count is
+    # not a width axis — no scaling
+    return 1.0
+
+
+def mup_multipliers(params: Any, base_shapes: Any, rule: str) -> Any:
+    """Params-shaped tree of per-leaf lr multipliers.
+
+    ``base_shapes``: same tree structure with shape tuples (or arrays —
+    their ``.shape`` is used) from the BASE (narrow proxy) model.
+    """
+    assert rule in ("adam", "sgd"), rule
+
+    def walk(path, leaf, base):
+        b = tuple(getattr(base, "shape", base))
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        return jnp.float32(_leaf_mult(tuple(leaf.shape), b, rule, name))
+
+    return jax.tree_util.tree_map_with_path(walk, params, base_shapes)
+
+
+def scale_by_mup(base_shapes: Any,
+                 rule: str = "adam") -> optax.GradientTransformation:
+    """Chain element applying the muP per-leaf lr multipliers to the
+    update direction (reference MuAdam/MuSGD mutate per-group lr; here
+    lr is a host-side scalar, so the multiplier folds into the update)."""
+
+    def init(params):
+        return MupScaleState(mults=mup_multipliers(params, base_shapes,
+                                                   rule))
+
+    def update(updates, state, params=None):
+        del params
+        new = jax.tree_util.tree_map(
+            lambda u, m: u * m.astype(u.dtype), updates, state.mults)
+        return new, state
+
+    return optax.GradientTransformation(init, update)

@@ -129,9 +129,43 @@ def build_optimizer(name: Optional[str], params: Dict[str, Any]
             chain.append(optax.add_decayed_weights(wd))
         tx = optax.chain(*chain) if chain else optax.identity()
     elif name in ("muadam", "muadamw", "musgd"):
-        raise NotImplementedError(
-            f"{name} (muP optimizers) require muP base-shape plumbing; "
-            "not yet available on TPU")
+        # muP optimizers (reference engine.py:1479 MuAdam/MuAdamW/MuSGD):
+        # base optimizer + per-leaf lr multipliers from the base-model
+        # shapes (runtime/mup.py).  ``params.base_shapes`` is the proxy
+        # model's param-shape tree (what mup.set_base_shapes records).
+        from deepspeed_tpu.runtime.mup import scale_by_mup
+
+        base_shapes = p.get("base_shapes")
+        if base_shapes is None:
+            raise ValueError(
+                f"{name} requires optimizer.params.base_shapes — the "
+                "param-shape tree of the BASE (narrow) model, e.g. "
+                "jax.tree_util.tree_map(lambda l: l.shape, "
+                "base_model_params)")
+        # decay chains AFTER the muP scaling: the multipliers apply to
+        # the gradient-descent direction only, keeping the effective
+        # decoupled decay at lr*wd for every width (the mup package's
+        # MuAdamW scales wd by width_mult for exactly this invariance)
+        if name == "musgd":
+            momentum = float(p.get("momentum", 0.0))
+            chain = []
+            if momentum:
+                chain.append(optax.trace(
+                    decay=momentum, nesterov=bool(p.get("nesterov",
+                                                        False))))
+            chain.append(scale_by_mup(base_shapes, rule="sgd"))
+            if wd:
+                chain.append(optax.add_decayed_weights(wd))
+        else:
+            # decoupled decay like the adam branch above (true L2 mode
+            # exists only in the fused kernel — same documented
+            # divergence)
+            chain = [optax.scale_by_adam(b1=betas[0], b2=betas[1],
+                                         eps=eps),
+                     scale_by_mup(base_shapes, rule="adam")]
+            if wd:
+                chain.append(optax.add_decayed_weights(wd))
+        tx = optax.chain(*chain)
     else:
         raise ValueError(f"Unknown optimizer type {name!r}")
     return tx, base_lr

@@ -36,3 +36,10 @@ class TokenDataset:
 
     def __getitem__(self, i):
         return {"input_ids": self.ids[i]}
+
+
+def autotune_factory():
+    """Factory for the autotuner's subprocess runner tests
+    (``make_subprocess_runner("tests.unit.simple_model:autotune_factory")``):
+    returns (model, batch_fn)."""
+    return tiny_gpt2(), lambda n: random_tokens(max(n, 1))

@@ -132,3 +132,105 @@ class TestEngineBackedTuning:
         cfg, val = t.tune()
         assert cfg is not None and val > 0
         assert cfg["zero_optimization"]["stage"] == 0
+
+
+class TestOrchestration:
+    """Reference autotuning/scheduler.py + tuner/ tier: experiment
+    quarantine, grid/random/model-based search."""
+
+    BASE = {"train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}}
+    SPACE = {"zero_optimization.stage": [0, 1, 2, 3],
+             "train_micro_batch_size_per_gpu": [1, 2, 4, 8]}
+
+    @staticmethod
+    def _synthetic_runner(cfg):
+        """Deterministic metric with a known optimum (stage 2, mbs 4);
+        stage 3 + mbs 8 'OOMs' to exercise quarantine."""
+        pt = cfg["_tuning_point"]
+        stage = pt["zero_optimization.stage"]
+        mbs = pt["train_micro_batch_size_per_gpu"]
+        if stage == 3 and mbs == 8:
+            raise MemoryError("synthetic OOM")
+        return 100.0 - (stage - 2) ** 2 * 10 - (mbs - 4) ** 2
+
+    def test_expand_space(self):
+        from deepspeed_tpu.autotuning import expand_space
+
+        cfgs = expand_space(self.BASE, self.SPACE)
+        assert len(cfgs) == 16
+        assert all("_tuning_point" in c for c in cfgs)
+        assert cfgs[0]["zero_optimization"]["stage"] == 0
+
+    def test_grid_finds_optimum_and_quarantines(self):
+        from deepspeed_tpu.autotuning import tune_space
+
+        best = tune_space(self.BASE, self.SPACE, self._synthetic_runner,
+                          tuner="gridsearch")
+        assert best.metric_val == 100.0
+        assert best.ds_config["_tuning_point"] == {
+            "zero_optimization.stage": 2,
+            "train_micro_batch_size_per_gpu": 4}
+
+    def test_quarantine_records_error(self):
+        from deepspeed_tpu.autotuning import (ExperimentScheduler,
+                                              expand_space)
+
+        sched = ExperimentScheduler(self._synthetic_runner)
+        exps = sched.run_experiments(expand_space(self.BASE, self.SPACE))
+        bad = [e for e in exps if not e.ok]
+        assert len(bad) == 1
+        assert "MemoryError" in bad[0].error
+        assert len([e for e in exps if e.ok]) == 15
+
+    def test_random_tuner_covers_space(self):
+        from deepspeed_tpu.autotuning import tune_space
+
+        best = tune_space(self.BASE, self.SPACE, self._synthetic_runner,
+                          tuner="random", n_trials=16)
+        assert best.metric_val == 100.0
+
+    def test_model_based_tuner_beats_budgeted_random(self):
+        """With a budget of half the space, the cost model should still
+        find the optimum of this smooth synthetic surface."""
+        from deepspeed_tpu.autotuning import tune_space
+
+        best = tune_space(self.BASE, self.SPACE, self._synthetic_runner,
+                          tuner="model_based", n_trials=10, seed=0)
+        assert best is not None and best.metric_val >= 97.0
+
+    def test_early_stopping(self):
+        from deepspeed_tpu.autotuning import (ExperimentScheduler,
+                                              GridSearchTuner,
+                                              expand_space)
+
+        sched = ExperimentScheduler(self._synthetic_runner)
+        t = GridSearchTuner(expand_space(self.BASE, self.SPACE), sched)
+        t.tune(early_stopping=3)
+        assert len(sched.finished) < 16
+
+    def test_subprocess_runner_real_engine(self, tmp_path):
+        """Isolation end-to-end: a real engine measurement in a fresh
+        interpreter, plus a bad config quarantined WITHOUT killing the
+        tuner process."""
+        import os
+
+        from deepspeed_tpu.autotuning import (ExperimentScheduler,
+                                              make_subprocess_runner)
+
+        import pathlib
+        repo_root = str(pathlib.Path(__file__).resolve().parents[2])
+        env = {"PYTHONPATH": repo_root,
+               "JAX_PLATFORMS": "cpu"}
+        runner = make_subprocess_runner(
+            "tests.unit.simple_model:autotune_factory", steps=1,
+            timeout=300, env=env)
+        sched = ExperimentScheduler(runner, exps_dir=str(tmp_path))
+        good = {"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "steps_per_print": 10000}
+        bad = dict(good, zero_optimization={"stage": 99})   # invalid
+        exps = sched.run_experiments([good, bad])
+        assert exps[0].ok and exps[0].metric_val > 0
+        assert not exps[1].ok and exps[1].error
+        assert os.path.exists(tmp_path / "exp_0.json")

@@ -1,0 +1,107 @@
+"""Synthetic torch-DeepSpeed ZeRO checkpoint fabrication for the ingest
+tests (the layout ``checkpoint/ds_import.py`` consumes: reference
+``zero_to_fp32.py`` / ``ds_to_universal.py`` file structure)."""
+import os
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def tiny_llama_cfg():
+    from deepspeed_tpu.models.llama import get_config
+
+    return get_config("tinyllama", vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64, dtype=jnp.float32,
+                      param_dtype=jnp.float32, scan_layers=True,
+                      remat=False, use_flash_attention=False)
+
+
+def hf_named_tensors(cfg, seed=0) -> Dict[str, np.ndarray]:
+    """HF/torch-layout named tensors ([out, in] linears) for the tiny
+    llama config — what a torch-DeepSpeed run's module would hold."""
+    rng = np.random.default_rng(seed)
+
+    def t(*shape):
+        return (rng.standard_normal(shape) * 0.05).astype(np.float32)
+
+    E, I, V = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                  cfg.head_dim)
+    sd = {"model.embed_tokens.weight": t(V, E),
+          "model.norm.weight": np.ones((E,), np.float32),
+          "lm_head.weight": t(V, E)}
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        sd.update({
+            p + "input_layernorm.weight": np.ones((E,), np.float32),
+            p + "post_attention_layernorm.weight":
+                np.ones((E,), np.float32),
+            p + "self_attn.q_proj.weight": t(H * Dh, E),
+            p + "self_attn.k_proj.weight": t(Hkv * Dh, E),
+            p + "self_attn.v_proj.weight": t(Hkv * Dh, E),
+            p + "self_attn.o_proj.weight": t(E, H * Dh),
+            p + "mlp.gate_proj.weight": t(I, E),
+            p + "mlp.up_proj.weight": t(I, E),
+            p + "mlp.down_proj.weight": t(E, I),
+        })
+    return sd
+
+
+def write_reference_zero_checkpoint(ckpt_dir: str,
+                                    sd: Dict[str, np.ndarray],
+                                    world: int = 2, tag: str = "global_step10",
+                                    stage3: bool = False) -> str:
+    """Fabricate the reference's on-disk layout: ``latest`` tag file,
+    ``mp_rank_00_model_states.pt`` (param_shapes + 16-bit module), and
+    per-dp-rank ``zero_pp_rank_*_optim_states.pt`` flat fp32 partitions
+    (stage-1/2 ``single_partition_of_fp32_groups`` or stage-3 round-robin
+    ``fp32_flat_groups``)."""
+    import torch
+
+    d = os.path.join(ckpt_dir, tag)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(ckpt_dir, "latest"), "w") as f:
+        f.write(tag)
+
+    names = list(sd)
+    param_shapes = {n: torch.Size(sd[n].shape) for n in names}
+    torch.save(
+        {"module": {("module." + n): torch.from_numpy(sd[n]).to(
+            torch.bfloat16) for n in names},
+         "param_shapes": [param_shapes]},
+        os.path.join(d, "mp_rank_00_model_states.pt"))
+
+    if stage3:
+        # each param flattened, padded to world, split round-robin; each
+        # rank's flat group concatenates its slice of EVERY param
+        rank_parts = [[] for _ in range(world)]
+        for n in names:
+            flat = sd[n].reshape(-1)
+            per = -(-flat.size // world)
+            padded = np.zeros((per * world,), np.float32)
+            padded[:flat.size] = flat
+            for rk in range(world):
+                rank_parts[rk].append(padded[rk * per:(rk + 1) * per])
+        for rk in range(world):
+            torch.save(
+                {"optimizer_state_dict": {
+                    "fp32_flat_groups":
+                        [torch.from_numpy(np.concatenate(rank_parts[rk]))]}},
+                os.path.join(
+                    d, f"zero_pp_rank_{rk}_mp_rank_00_optim_states.pt"))
+    else:
+        flat = np.concatenate([sd[n].reshape(-1) for n in names])
+        per = -(-flat.size // world)
+        padded = np.zeros((per * world,), np.float32)
+        padded[:flat.size] = flat
+        for rk in range(world):
+            torch.save(
+                {"optimizer_state_dict": {
+                    "single_partition_of_fp32_groups":
+                        [torch.from_numpy(padded[rk * per:(rk + 1) * per])]}},
+                os.path.join(
+                    d, f"zero_pp_rank_{rk}_mp_rank_00_optim_states.pt"))
+    return d
