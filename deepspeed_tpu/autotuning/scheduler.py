@@ -71,6 +71,8 @@ def config_features(cfg: Dict[str, Any]) -> List[float]:
     """Numeric feature vector from a config's tuning point (the
     reference flattens the whole ds_config; the tuning point is the part
     that varies)."""
+    import zlib
+
     feats = []
     for _, v in sorted(cfg.get("_tuning_point", {}).items()):
         if isinstance(v, bool):
@@ -78,7 +80,9 @@ def config_features(cfg: Dict[str, Any]) -> List[float]:
         elif isinstance(v, Number):
             feats.append(float(v))
         else:
-            feats.append(float(abs(hash(str(v))) % 97))
+            # stable across interpreters (hash() is salted, which would
+            # break seed reproducibility of the cost model)
+            feats.append(float(zlib.crc32(str(v).encode()) % 97))
     return feats
 
 
@@ -216,12 +220,17 @@ class BaseTuner:
         while i < n_trials and self.has_next():
             batch = self.next_batch(sample_size)
             exps = self.scheduler.run_experiments(batch)
+            improved = False
             for e in exps:
                 if e.ok and (self.best is None or
                              e.metric_val > self.best.metric_val):
                     self.best = e
-                    best_at = i
+                    improved = True
             i += len(exps)
+            if improved:
+                # count from AFTER the improving batch, else any
+                # sample_size >= early_stopping stops immediately
+                best_at = i
             self.update(exps)
             if early_stopping is not None and i - best_at >= early_stopping:
                 logger.info(f"autotuning early stop at {i} experiments "
@@ -266,6 +275,7 @@ class ModelBasedTuner(BaseTuner):
         self.explore_ratio = explore_ratio
         self._X: List[List[float]] = []
         self._y: List[float] = []
+        self._ok_vals: List[float] = []
         self._init_left = min(self.INIT_NUM, len(self.pool))
 
     def _predict(self) -> np.ndarray:
@@ -304,12 +314,16 @@ class ModelBasedTuner(BaseTuner):
         for e in exps:
             feats = config_features(e.ds_config)
             self._X.append(feats)
-            # failures train the model too: a large penalty steers the
-            # search away from the infeasible region (reference feeds
-            # errored exps back as worst-rank)
-            ok_vals = [v for v in self._y if v > -1e8]
-            floor = (min(ok_vals) if ok_vals else 0.0) - 1.0
-            self._y.append(e.metric_val if e.ok else floor - 1e-3)
+            # failures train the model too: a fixed penalty one unit
+            # below the worst REAL observation steers the search away
+            # from the infeasible region (tracked separately — deriving
+            # the floor from _y would cascade past penalties downward)
+            if e.ok:
+                self._ok_vals.append(e.metric_val)
+                self._y.append(e.metric_val)
+            else:
+                floor = (min(self._ok_vals) if self._ok_vals else 0.0)
+                self._y.append(floor - 1.0)
 
 
 TUNERS = {"gridsearch": GridSearchTuner, "random": RandomTuner,

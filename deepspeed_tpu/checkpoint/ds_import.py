@@ -86,12 +86,16 @@ def consolidate_reference_zero_checkpoint(
     d = _find_tag_dir(ckpt_dir, tag)
     model_files = sorted(glob.glob(os.path.join(d, "*_model_states.pt")))
     assert model_files, f"no *_model_states.pt under {d}"
-    mp_files = [f for f in model_files if "mp_rank" in os.path.basename(f)]
-    assert len(mp_files) <= 1, (
+    # stage 3 writes per-DP-rank zero_pp_rank_*_model_states.pt (all with
+    # identical param_shapes); stages 1/2 write one mp_rank_XX file.  TP
+    # ranks are the plain mp_rank files — only those gate the assert.
+    plain_mp = [f for f in model_files
+                if not os.path.basename(f).startswith("zero_pp_rank_")]
+    assert len(plain_mp) <= 1, (
         "multi-TP reference checkpoints are not supported — run the "
         "reference's own ds_to_universal first, or consolidate per "
         "mp_rank")
-    model_sd = _torch_load(model_files[0])
+    model_sd = _torch_load((plain_mp or model_files)[0])
 
     optim_files = sorted(
         glob.glob(os.path.join(d, "*_optim_states.pt")),

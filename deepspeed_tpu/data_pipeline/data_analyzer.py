@@ -132,10 +132,22 @@ _DDA_CTX: Dict[str, Any] = {}
 
 def _dda_worker(worker_id: int):
     """One worker's map phase (module-level for multiprocessing; reads
-    the fork-inherited context, receives only its worker id)."""
-    return DataAnalyzer(_DDA_CTX["fns"],
-                        num_workers=_DDA_CTX["w"],
-                        worker_id=worker_id).run(_DDA_CTX["dataset"])
+    the fork-inherited context, receives only its worker id).  Returns
+    ``(single_value_results, accumulate_partials)`` — accumulate metrics
+    sum their strided partials associatively in the parent reduce."""
+    ds = _DDA_CTX["dataset"]
+    w = _DDA_CTX["w"]
+    singles = (DataAnalyzer(_DDA_CTX["fns"], num_workers=w,
+                            worker_id=worker_id).run(ds)
+               if _DDA_CTX["fns"] else {})
+    accums = {}
+    for name, fn in _DDA_CTX["accums"].items():
+        acc = None
+        for i in range(worker_id, len(ds), w):
+            v = np.asarray(fn(ds[i]), np.float64)
+            acc = v if acc is None else acc + v
+        accums[name] = acc
+    return singles, accums
 
 
 class DistributedDataAnalyzer:
@@ -189,28 +201,36 @@ class DistributedDataAnalyzer:
 
         singles, accums = self._split()
         n = len(dataset)
+        if n == 0:
+            return {}
         w = max(1, min(self.num_workers, n))
         merged: Dict[str, np.ndarray] = {}
-        if singles:
-            if w == 1:
+        if w == 1:
+            parts = []
+            if singles:
                 merged.update(DataAnalyzer(singles).run(dataset))
-            else:
-                ctx = mp.get_context("fork")
-                _DDA_CTX.update(dataset=dataset, fns=singles, w=w)
-                try:
-                    with ctx.Pool(w) as pool:
-                        parts = pool.map(_dda_worker, range(w))
-                finally:
-                    _DDA_CTX.clear()
-                merged.update(DataAnalyzer.merge_worker_results(parts))
-        for name, fn in accums.items():
-            # accumulate metrics are cheap reductions; strided partials
-            # sum associatively
-            acc = None
-            for i in range(n):
-                v = np.asarray(fn(dataset[i]), np.float64)
-                acc = v if acc is None else acc + v
-            merged[name] = acc.astype(np.float32)
+            for name, fn in accums.items():
+                acc = None
+                for i in range(n):
+                    v = np.asarray(fn(dataset[i]), np.float64)
+                    acc = v if acc is None else acc + v
+                merged[name] = acc.astype(np.float32)
+        else:
+            ctx = mp.get_context("fork")
+            _DDA_CTX.update(dataset=dataset, fns=singles, accums=accums,
+                            w=w)
+            try:
+                with ctx.Pool(w) as pool:
+                    parts = pool.map(_dda_worker, range(w))
+            finally:
+                _DDA_CTX.clear()
+            if singles:
+                merged.update(DataAnalyzer.merge_worker_results(
+                    [p[0] for p in parts]))
+            for name in accums:
+                partials = [p[1][name] for p in parts
+                            if p[1][name] is not None]
+                merged[name] = sum(partials).astype(np.float32)
         if self.save_path is not None:
             os.makedirs(self.save_path, exist_ok=True)
             for name, vals in merged.items():

@@ -86,8 +86,11 @@ class MixtralBlock(nn.Module):
                                                       ragged_meta)
         h = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
                     name="post_attention_layernorm")(x)
-        y, l_aux = _moe(cfg, "block_sparse_moe")(h,
-                                                 is_training=not deterministic)
+        # is_training stays the MoE default (train capacity factor):
+        # `deterministic` is a traced value under nn.remat, so the static
+        # capacity selection cannot branch on it — serving engines that
+        # want the eval capacity set capacity_factor on the decode config
+        y, l_aux = _moe(cfg, "block_sparse_moe")(h)
         return x + y, l_aux
 
 

@@ -104,7 +104,7 @@ class Qwen2MoeBlock(nn.Module):
                        tensor_parallel=cfg.tensor_parallel,
                        dispatch_impl=cfg.dispatch_impl,
                        normalize_weights=cfg.norm_topk_prob,
-                       name="mlp")(h, is_training=not deterministic)
+                       name="mlp")(h)
         if cfg.shared_expert_intermediate_size:
             shared_cfg = dataclasses.replace(
                 cfg, intermediate_size=cfg.shared_expert_intermediate_size)

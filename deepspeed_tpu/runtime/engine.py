@@ -901,8 +901,19 @@ class DeepSpeedEngine:
 
         return jax.jit(eval_step, out_shardings=self._repl())
 
-    def _build_grad_step(self):
-        """Imperative-mode micro step: grads for ONE micro-batch."""
+    def _build_grad_step(self, host_grads: bool = False):
+        """Imperative-mode micro step: grads for ONE micro-batch.
+
+        ``host_grads=True`` (ZeRO-Infinity: offload_param + NVMe
+        optimizer) lands the grads in pinned host memory via
+        out_shardings — with unrolled layers XLA streams each layer's
+        grad out as the backward produces it, so HBM never holds the
+        full grad tree (the reference's offload grad buffers,
+        ``zero/stage3.py`` partitioned gradient offload).  Grads keep
+        the PARAM dtype in this mode (bf16 on the wire; the fp32
+        accumulation fidelity lives in the NVMe moments, which cast per
+        leaf — the measured fp32-cast temps are what pushed a 7B step
+        80MB past a 16GB chip)."""
         loss_fn = self.loss_fn
         compute_dtype = self.compute_dtype
         mesh = self.mesh
@@ -918,12 +929,27 @@ class DeepSpeedEngine:
 
             loss_s, grads = jax.value_and_grad(scaled_loss)(
                 fetch_params(state.params))
-            grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32),
-                                           grads)
+            if not host_grads:
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads)
             grads = constrain_tree(grads, grad_spec_tree, mesh)
             return loss_s / scale, grads
 
-        return jax.jit(grad_step)
+        if not host_grads:
+            return jax.jit(grad_step)
+        host = jax.tree_util.tree_map(
+            lambda s: s.with_memory_kind("pinned_host"),
+            self._state_shardings.params)
+        opts = None
+        if jax.devices()[0].platform != "cpu":
+            # the latency-hiding scheduler prefetches several layers'
+            # host->HBM param copies concurrently — measured +2.7G over
+            # budget at 7B on a 16GB chip; a serialized copy schedule
+            # trades overlap for fitting (this tier is streaming-bound
+            # anyway)
+            opts = {"xla_tpu_enable_latency_hiding_scheduler": "false"}
+        return jax.jit(grad_step, out_shardings=(None, host),
+                       compiler_options=opts)
 
     def _build_apply_step(self):
         tx_update = self._tx_update
@@ -989,8 +1015,10 @@ class DeepSpeedEngine:
         step streaming Adam moments NVMe→HBM→NVMe (reference
         ``pipelined_optimizer_swapper`` semantics; see
         ``runtime/swap_tensor.py``)."""
+        host_grads = bool(self.offload_param)
         if self._grad_step_fn is None:
-            self._grad_step_fn = self._build_grad_step()
+            self._grad_step_fn = self._build_grad_step(
+                host_grads=host_grads)
         state = self.state
         rng = state.rng
         loss_sum, grads = None, None
@@ -999,21 +1027,66 @@ class DeepSpeedEngine:
             rng, sub = jax.random.split(rng)
             loss, g = self._grad_step_fn(state, mb, sub)
             loss_sum = loss if loss_sum is None else loss_sum + loss
-            grads = g if grads is None else jax.tree_util.tree_map(
-                jnp.add, grads, g)
-        new_state, metrics = self._nvme_apply_grads(grads, lr, rng)
+            if grads is None:
+                grads = g
+            elif host_grads:
+                grads = self._host_tree_add(grads, g)
+            else:
+                grads = jax.tree_util.tree_map(jnp.add, grads, g)
+        new_state, metrics = self._nvme_apply_grads(grads, lr, rng,
+                                                    leafwise=host_grads)
         metrics["loss"] = loss_sum / self.gas
         return new_state, metrics
 
-    def _nvme_apply_grads(self, grads, lr, rng):
+    def _host_tree_add(self, a, b):
+        """Leaf-by-leaf accumulate with pinned-host outputs: a whole-tree
+        jitted add would stage the full fp32 grad tree in HBM, undoing
+        the host-grad streaming."""
+        if getattr(self, "_host_add_fn", None) is None:
+            self._host_add_fn = {}
+        flat_a, tree = jax.tree_util.tree_flatten(a)
+        flat_b = jax.tree_util.tree_leaves(b)
+        out = []
+        for x, y in zip(flat_a, flat_b):
+            sh = x.sharding.with_memory_kind("pinned_host")
+            # sharding in the key: same-shape leaves with different specs
+            # (e.g. col- vs row-parallel kernels) must not alias one
+            # cached out_sharding
+            key = (x.shape, str(x.dtype), sh)
+            if key not in self._host_add_fn:
+                self._host_add_fn[key] = jax.jit(
+                    jnp.add, out_shardings=sh, donate_argnums=(0,))
+            out.append(self._host_add_fn[key](x, y))
+        return jax.tree_util.tree_unflatten(tree, out)
+
+    def _nvme_apply_grads(self, grads, lr, rng, leafwise: bool = False):
         """Overflow check + loss-scale update on device, then the per-leaf
         swapped Adam update (skipped entirely on overflow — the moments on
-        disk are the authoritative state and simply stay put)."""
+        disk are the authoritative state and simply stay put).
+
+        ``leafwise``: grads live in pinned host memory — compute the
+        overflow/norm reductions one leaf at a time so HBM holds one
+        leaf, not the tree."""
         state = self.state
-        if getattr(self, "_nvme_metrics_fn", None) is None:
-            self._nvme_metrics_fn = jax.jit(
-                lambda g: (prec.has_inf_or_nan(g), prec.global_norm(g)))
-        overflow, norm_raw = self._nvme_metrics_fn(grads)
+        if leafwise:
+            if getattr(self, "_nvme_leaf_metric_fn", None) is None:
+                self._nvme_leaf_metric_fn = jax.jit(
+                    lambda g: (jnp.isfinite(g).all(),
+                               jnp.sum(jnp.square(g.astype(jnp.float32)))))
+            finite = True
+            sumsq = 0.0
+            for leaf in jax.tree_util.tree_leaves(grads):
+                f, s2 = self._nvme_leaf_metric_fn(leaf)
+                finite = finite and bool(jax.device_get(f))
+                sumsq += float(jax.device_get(s2))
+            overflow = jnp.asarray(not finite)
+            norm_raw = jnp.asarray(np.sqrt(sumsq), jnp.float32)
+        else:
+            if getattr(self, "_nvme_metrics_fn", None) is None:
+                self._nvme_metrics_fn = jax.jit(
+                    lambda g: (prec.has_inf_or_nan(g),
+                               prec.global_norm(g)))
+            overflow, norm_raw = self._nvme_metrics_fn(grads)
         scale_f = float(jax.device_get(state.scale.loss_scale))
         inv = 1.0 / (scale_f * self.gas)
         ovf = bool(jax.device_get(overflow))

@@ -115,6 +115,16 @@ def _unique_shards(leaf) -> dict:
     return seen
 
 
+def _to_device_space(x):
+    """Move a pinned_host-resident array into device memory (leaf-wise —
+    the swap loop's streaming granularity); anything else passes
+    through."""
+    sh = getattr(x, "sharding", None)
+    if sh is not None and getattr(sh, "memory_kind", None) == "pinned_host":
+        return jax.device_put(x, sh.with_memory_kind("device"))
+    return x
+
+
 def _float_leaf(x) -> bool:
     return jnp.issubdtype(np.asarray(x).dtype if not hasattr(x, "dtype")
                           else x.dtype, jnp.floating)
@@ -377,6 +387,11 @@ class NvmeOptimizerSwapper:
                     nxt = todo[pos + 1]
                     started[nxt] = self.start_read(keys[nxt], leaves[nxt])
                 p, g = leaves[i], flat_g[i]
+                # host-offloaded params/grads (ZeRO-Infinity composition)
+                # stream through DEVICE memory one leaf at a time — jit
+                # math can't mix host- and device-space operands
+                p = _to_device_space(p)
+                g = _to_device_space(g)
                 m_dev, v_dev = self.finish_read(keys[i], p,
                                                 started.pop(i))
                 p_new, m_new, v_new = _adam_update(

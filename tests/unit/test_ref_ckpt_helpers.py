@@ -68,11 +68,18 @@ def write_reference_zero_checkpoint(ckpt_dir: str,
 
     names = list(sd)
     param_shapes = {n: torch.Size(sd[n].shape) for n in names}
-    torch.save(
-        {"module": {("module." + n): torch.from_numpy(sd[n]).to(
-            torch.bfloat16) for n in names},
-         "param_shapes": [param_shapes]},
-        os.path.join(d, "mp_rank_00_model_states.pt"))
+    model_state = {"module": {("module." + n): torch.from_numpy(sd[n]).to(
+        torch.bfloat16) for n in names},
+        "param_shapes": [param_shapes]}
+    if stage3:
+        # real stage-3 runs write per-DP-rank model states and NO plain
+        # mp_rank file (each rank's param_shapes are identical)
+        for rk in range(world):
+            torch.save(model_state, os.path.join(
+                d, f"zero_pp_rank_{rk}_mp_rank_00_model_states.pt"))
+    else:
+        torch.save(model_state,
+                   os.path.join(d, "mp_rank_00_model_states.pt"))
 
     if stage3:
         # each param flattened, padded to world, split round-robin; each
