@@ -410,10 +410,15 @@ def bench_moe_ep(args) -> None:
         # the tuned micro=12 was measured against the default 0.65B dims
         # only; user --size presets keep the conservative micro
         micro = 4 if not single else (12 if args.size is None else 2)
-        seq, steps = 1024, args.steps
+        # single chip: gradient accumulation amortizes the optimizer's
+        # all-expert-params HBM traffic (measured 46.7 -> 48.6% MFU at
+        # gas=8, micro=12 on v5e)
+        gas = 8 if single and args.size is None else 1
+        seq, steps = 1024, max(args.steps // (2 if gas > 1 else 1), 3)
     else:
         cfg = get_config("tinymixtral", dtype=jnp.float32, remat=False)
         micro, seq, steps = 2, 32, 3
+        gas = 1
 
     ep = min(n_dev, cfg.num_local_experts)
     topo = dist.initialize_mesh(dp=n_dev // ep, ep=ep) if ep > 1 \
@@ -421,24 +426,27 @@ def bench_moe_ep(args) -> None:
     dp = topo.zero_partition_count()
     pure_bf16 = on_tpu and n_dev < 4    # see bench_llama_zero3
     ds = {
-        "train_batch_size": micro * max(dp, 1),
+        "train_batch_size": micro * max(dp, 1) * gas,
         "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
         "bf16": {"enabled": on_tpu, "master_weights": not pure_bf16},
         "zero_optimization": {"stage": 2},
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
         "steps_per_print": 1000000,
     }
-    batch = _tokens(cfg.vocab_size, micro * max(dp, 1), seq)
+    batch = _tokens(cfg.vocab_size, micro * max(dp, 1) * gas, seq)
     engine, *_ = deepspeed_tpu.initialize(
         model=MixtralLMLoss(cfg), config=ds, topology=topo,
         example_batch={"input_ids": batch["input_ids"][:1]},
         rng=jax.random.PRNGKey(0))
     _measure_train(
-        engine, batch, steps=steps, micro_global=micro * max(dp, 1),
+        engine, batch, steps=steps,
+        micro_global=micro * max(dp, 1) * gas,
         seq=seq, flops_per_tok=flops_per_token(cfg, seq),
         metric="mixtral_ep_train_mfu",
         extra_detail={"params": count_params(engine.state.params),
                       "experts": cfg.num_local_experts,
+                      "gas": gas,
                       "expert_parallel": ep})
 
 

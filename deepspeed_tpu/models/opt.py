@@ -1,0 +1,242 @@
+"""OPT model family.
+
+Reference serves OPT through FastGen v2
+(``inference/v2/model_implementations/opt/container.py``): learned
+positional embeddings with the family's +2 offset, separate q/k/v/out
+projections WITH biases, pre-LayerNorm blocks, ReLU MLP, final LN, tied
+LM head.  GPT-2-shaped rather than Llama-shaped (no rotary), so it
+serves through the v1 engine's fused decode loop — the ragged paged path
+requires per-token rotary positions.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class OPTConfig:
+    vocab_size: int = 50272
+    max_position_embeddings: int = 2048
+    hidden_size: int = 768
+    ffn_dim: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = True
+    remat_policy: str = "full"
+    use_flash_attention: bool = False
+    tensor_parallel: bool = False
+    pipeline_stages: int = 1
+    pipeline_microbatches: int = 0
+    decode: bool = False
+    max_cache_len: int = 0
+
+    # OPT's HF implementation offsets positions by 2 (its pad/bos rows)
+    POSITION_OFFSET = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def n_positions(self) -> int:   # engine position-bound probes
+        return self.max_position_embeddings
+
+
+PRESETS = {
+    "opt-125m": dict(hidden_size=768, ffn_dim=3072, num_hidden_layers=12,
+                     num_attention_heads=12),
+    "opt-1.3b": dict(hidden_size=2048, ffn_dim=8192, num_hidden_layers=24,
+                     num_attention_heads=32),
+    "opt-6.7b": dict(hidden_size=4096, ffn_dim=16384,
+                     num_hidden_layers=32, num_attention_heads=32),
+    "opt-13b": dict(hidden_size=5120, ffn_dim=20480, num_hidden_layers=40,
+                    num_attention_heads=40),
+    "tinyopt": dict(vocab_size=96, hidden_size=32, ffn_dim=64,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    max_position_embeddings=64),
+}
+
+
+def get_config(preset: str, **overrides) -> OPTConfig:
+    kw = dict(PRESETS[preset])
+    kw.update(overrides)
+    return OPTConfig(**kw)
+
+
+def _tp(cfg, kind):
+    from deepspeed_tpu.parallel.tensor_parallel import tp_dense_kwargs
+
+    return tp_dense_kwargs(cfg.tensor_parallel, kind, with_bias=True)
+
+
+class OPTAttention(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        B, S, E = x.shape
+        H, Dh = cfg.num_attention_heads, cfg.head_dim
+        dense = dict(use_bias=True, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+        q = nn.Dense(E, name="q_proj", **dense, **_tp(cfg, "col"))(x)
+        k = nn.Dense(E, name="k_proj", **dense, **_tp(cfg, "col"))(x)
+        v = nn.Dense(E, name="v_proj", **dense, **_tp(cfg, "col"))(x)
+
+        def heads(t):
+            return t.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if cfg.decode:
+            from deepspeed_tpu.inference.kv_cache import (cached_attention,
+                                                          update_kv_cache)
+
+            max_len = cfg.max_cache_len or cfg.max_position_embeddings
+            k_full, v_full, start = update_kv_cache(self, k, v, max_len)
+            if S == 1:
+                y = cached_attention(q, k_full, v_full,
+                                     (start + jnp.arange(S))[None])
+                y = y.transpose(0, 2, 1, 3).reshape(B, S, E)
+                return nn.Dense(E, name="out_proj", **dense,
+                                **_tp(cfg, "row"))(y)
+        if cfg.use_flash_attention:
+            from deepspeed_tpu.ops.flash_attention import flash_attention
+
+            y = flash_attention(q, k, v, causal=True)
+        else:
+            scale = 1.0 / np.sqrt(Dh)
+            att = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+            mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+            att = jnp.where(mask[None, None], att,
+                            jnp.finfo(att.dtype).min)
+            att = jax.nn.softmax(att.astype(jnp.float32),
+                                 axis=-1).astype(cfg.dtype)
+            y = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+        y = y.transpose(0, 2, 1, 3).reshape(B, S, E)
+        return nn.Dense(E, name="out_proj", **dense, **_tp(cfg, "row"))(y)
+
+
+class OPTBlock(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        ln = dict(epsilon=1e-5, dtype=cfg.dtype, param_dtype=jnp.float32)
+        h = nn.LayerNorm(name="self_attn_layer_norm", **ln)(x)
+        x = x + OPTAttention(cfg, name="self_attn")(h, deterministic)
+        h = nn.LayerNorm(name="final_layer_norm", **ln)(x)
+        dense = dict(use_bias=True, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+        h = nn.Dense(cfg.ffn_dim, name="fc1", **dense,
+                     **_tp(cfg, "col"))(h)
+        h = jax.nn.relu(h)
+        h = nn.Dense(cfg.hidden_size, name="fc2", **dense,
+                     **_tp(cfg, "row"))(h)
+        return x + h
+
+
+class ScanOPTBlock(nn.Module):
+    config: OPTConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, _):
+        return OPTBlock(self.config, name="block")(x,
+                                                   self.deterministic), None
+
+
+class OPTModel(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None,
+                 deterministic: bool = True):
+        from deepspeed_tpu.models.gpt2 import _maybe_remat
+        from deepspeed_tpu.parallel.tensor_parallel import tp_embed_kwargs
+
+        cfg = self.config
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.arange(S)
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="embed_tokens",
+                     **tp_embed_kwargs(cfg.tensor_parallel))(input_ids)
+        # learned positions with OPT's historical +2 offset
+        pos_tab = nn.Embed(
+            cfg.max_position_embeddings + cfg.POSITION_OFFSET,
+            cfg.hidden_size, dtype=cfg.dtype,
+            param_dtype=cfg.param_dtype, name="embed_positions")
+        x = x + pos_tab(jnp.atleast_1d(positions) + cfg.POSITION_OFFSET)
+
+        if cfg.scan_layers:
+            block_cls = _maybe_remat(ScanOPTBlock, cfg)
+            vaxes = {"params": 0}
+            if cfg.decode:
+                vaxes["cache"] = 0
+            x, _ = nn.scan(
+                block_cls,
+                variable_axes=vaxes,
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, deterministic, name="layers")(x, None)
+        else:
+            block_cls = _maybe_remat(OPTBlock, cfg)
+            for i in range(cfg.num_hidden_layers):
+                x = block_cls(cfg, name=f"layers_{i}")(x, deterministic)
+        return nn.LayerNorm(name="final_layer_norm", epsilon=1e-5,
+                            dtype=cfg.dtype, param_dtype=jnp.float32)(x)
+
+
+class OPTForCausalLM(nn.Module):
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        x = OPTModel(cfg, name="model")(input_ids, positions,
+                                        deterministic)
+        from deepspeed_tpu.models.llama import _tp_kwargs
+
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="lm_head",
+                        **_tp_kwargs(cfg, "col"))(x)
+
+
+class OPTLMLoss(nn.Module):
+    """``module(batch) -> scalar`` next-token CE (engine contract)."""
+
+    config: OPTConfig
+
+    @nn.compact
+    def __call__(self, batch):
+        from deepspeed_tpu.models.gpt2 import next_token_loss
+
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        logits = OPTForCausalLM(self.config, name="lm")(input_ids)
+        return next_token_loss(logits, input_ids)
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(p.shape))
+               for p in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_token(cfg: OPTConfig, seq_len: Optional[int] = None) -> float:
+    E, I, L = cfg.hidden_size, cfg.ffn_dim, cfg.num_hidden_layers
+    per_layer = 4 * E * E + 2 * E * I
+    n = L * per_layer + cfg.vocab_size * E
+    s = seq_len or cfg.max_position_embeddings
+    attn = 12 * L * E * s
+    return 6.0 * n + attn

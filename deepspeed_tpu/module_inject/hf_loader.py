@@ -136,13 +136,7 @@ def _convert_gpt2(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
         "ln_f/scale": sd["ln_f.weight"],
         "ln_f/bias": sd["ln_f.bias"],
     }
-    if cfg.scan_layers:
-        for k, v in _stack(layers, True).items():
-            flat[f"h/block/{k}"] = v
-    else:
-        for i, layer in enumerate(layers):
-            for k, v in layer.items():
-                flat[f"h_{i}/{k}"] = v
+    _place_layers(flat, layers, cfg, prefix="h")
     return _nest(flat)
 
 
@@ -259,6 +253,107 @@ def _convert_qwen2_moe(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     return _nest(flat)
 
 
+def _convert_opt(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """OPT (reference ``opt/container.py``): q/k/v/out with biases,
+    learned positions (+2 offset rows kept verbatim), pre-LN, ReLU MLP."""
+    sd = _strip_prefix(sd, "model.decoder.", "decoder.")
+    assert not any("project_in" in k or "project_out" in k for k in sd), (
+        "OPT converter: word_embed_proj_dim != hidden_size checkpoints "
+        "(opt-350m's project_in/project_out) are not supported")
+    L = cfg.num_hidden_layers
+    layers = []
+    for i in range(L):
+        p = f"layers.{i}."
+        layer = {}
+        for w in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            layer[f"self_attn/{w}/kernel"] = \
+                sd[f"{p}self_attn.{w}.weight"].T
+            layer[f"self_attn/{w}/bias"] = sd[f"{p}self_attn.{w}.bias"]
+        for ln in ("self_attn_layer_norm", "final_layer_norm"):
+            layer[f"{ln}/scale"] = sd[f"{p}{ln}.weight"]
+            layer[f"{ln}/bias"] = sd[f"{p}{ln}.bias"]
+        for fc in ("fc1", "fc2"):
+            layer[f"{fc}/kernel"] = sd[f"{p}{fc}.weight"].T
+            layer[f"{fc}/bias"] = sd[f"{p}{fc}.bias"]
+        layers.append(layer)
+    flat = {
+        "model/embed_tokens/embedding": sd["embed_tokens.weight"],
+        "model/embed_positions/embedding": sd["embed_positions.weight"],
+        "model/final_layer_norm/scale": sd["final_layer_norm.weight"],
+        "model/final_layer_norm/bias": sd["final_layer_norm.bias"],
+        "lm_head/kernel": (sd.get("lm_head.weight",
+                                  sd["embed_tokens.weight"])).T,
+    }
+    _place_layers(flat, layers, cfg, prefix="model/layers")
+    return _nest(flat)
+
+
+def _convert_falcon(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """Falcon (reference ``falcon/container.py``): fused query_key_value
+    split into q/k/v (contiguous rows for the 7B MQA layout, per-kv-group
+    interleave for the 40B new_decoder_architecture), LayerNorms with
+    biases, GELU MLP."""
+    sd = _strip_prefix(sd, "transformer.")
+    L = cfg.num_hidden_layers
+    H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                  cfg.head_dim)
+    # supported layouts: contiguous q|k|v rows (MQA, falcon-7b) or the
+    # new-architecture per-kv-group interleave.  The falcon-rw lineage
+    # (old arch, num_kv_heads == num_heads) interleaves [q_i,k_i,v_i]
+    # per head — a contiguous split would silently scramble it
+    assert cfg.parallel_attn, (
+        "falcon converter: parallel_attn=False checkpoints (falcon-rw "
+        "lineage) are not supported")
+    assert getattr(cfg, "new_decoder_architecture", False) or Hkv == 1, (
+        "falcon converter: old-architecture checkpoints with "
+        f"num_kv_heads={Hkv} > 1 interleave qkv per head — only MQA "
+        "(falcon-7b) or new_decoder_architecture (falcon-40b+) layouts "
+        "are supported")
+    layers = []
+    for i in range(L):
+        p = f"h.{i}."
+        qkv = sd[p + "self_attention.query_key_value.weight"]
+        if getattr(cfg, "new_decoder_architecture", False):
+            # [Hkv, H/Hkv + 2, Dh, E]: each kv group carries its q heads
+            # then its k then its v row-blocks
+            g = H // Hkv
+            qkv4 = qkv.reshape(Hkv, g + 2, Dh, -1)
+            q = qkv4[:, :g].reshape(H * Dh, -1)
+            k_ = qkv4[:, g].reshape(Hkv * Dh, -1)
+            v = qkv4[:, g + 1].reshape(Hkv * Dh, -1)
+        else:
+            q, k_, v = np.split(qkv, [H * Dh, (H + Hkv) * Dh], axis=0)
+        ln_attn = ("ln_attn" if getattr(cfg, "new_decoder_architecture",
+                                        False) else "input_layernorm")
+        layer = {
+            "input_layernorm/scale": sd[f"{p}{ln_attn}.weight"],
+            "input_layernorm/bias": sd[f"{p}{ln_attn}.bias"],
+            "self_attention/q_proj/kernel": q.T,
+            "self_attention/k_proj/kernel": k_.T,
+            "self_attention/v_proj/kernel": v.T,
+            "self_attention/o_proj/kernel":
+                sd[p + "self_attention.dense.weight"].T,
+            "mlp/dense_h_to_4h/kernel":
+                sd[p + "mlp.dense_h_to_4h.weight"].T,
+            "mlp/dense_4h_to_h/kernel":
+                sd[p + "mlp.dense_4h_to_h.weight"].T,
+        }
+        if getattr(cfg, "new_decoder_architecture", False):
+            layer["ln_mlp/scale"] = sd[p + "ln_mlp.weight"]
+            layer["ln_mlp/bias"] = sd[p + "ln_mlp.bias"]
+        layers.append(layer)
+    flat = {
+        "transformer/word_embeddings/embedding":
+            sd["word_embeddings.weight"],
+        "transformer/ln_f/scale": sd["ln_f.weight"],
+        "transformer/ln_f/bias": sd["ln_f.bias"],
+        "lm_head/kernel": (sd.get("lm_head.weight",
+                                  sd["word_embeddings.weight"])).T,
+    }
+    _place_layers(flat, layers, cfg, prefix="transformer/h")
+    return _nest(flat)
+
+
 def _convert_mixtral(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     L = cfg.num_hidden_layers
     E = cfg.num_local_experts
@@ -282,15 +377,21 @@ def _convert_mixtral(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     return _nest(flat)
 
 
-def _place_layers(flat, layers, cfg, prefix: str) -> None:
+def _place_layers(flat, layers, cfg, prefix: str,
+                  unrolled: Optional[str] = None) -> None:
+    """Place per-layer trees: scan-stacked under ``<prefix>/block`` or
+    unrolled as ``<parent>/<unrolled.format(i)>``.  ``unrolled`` defaults
+    to ``<last prefix component>_{i}`` (``model/layers`` -> ``layers_{i}``)."""
     if cfg.scan_layers:
         for k, v in _stack(layers, True).items():
             flat[f"{prefix}/block/{k}"] = v
     else:
-        base = prefix.rsplit("/", 1)[0]  # "model/layers" -> "model"
+        base, _, leaf = prefix.rpartition("/")
+        pat = unrolled or (leaf + "_{i}")
+        stem = f"{base}/" if base else ""
         for i, layer in enumerate(layers):
             for k, v in layer.items():
-                flat[f"{base}/layers_{i}/{k}"] = v
+                flat[f"{stem}{pat.format(i=i)}/{k}"] = v
 
 
 _CONVERTERS = {
@@ -304,9 +405,12 @@ _CONVERTERS = {
     "Qwen2Config": _convert_llama,
     "MixtralConfig": _convert_mixtral,
     # Phi-3: Llama-shaped with FUSED qkv/gate_up tensors (split on load);
-    # Qwen2-MoE: routed experts + shared expert w/ sigmoid gate
+    # Qwen2-MoE: routed experts + shared expert w/ sigmoid gate;
+    # Falcon: fused query_key_value + parallel-residual block
     "Phi3Config": _convert_phi3,
     "Qwen2MoeConfig": _convert_qwen2_moe,
+    "FalconConfig": _convert_falcon,
+    "OPTConfig": _convert_opt,
 }
 
 

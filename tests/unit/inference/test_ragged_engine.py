@@ -337,3 +337,15 @@ class TestModelBreadth:
                                 max_new_tokens=5)
         for got, ref in zip([outs[u] for u in sorted(outs)], sols):
             np.testing.assert_array_equal(got, ref)
+
+    def test_falcon_ragged_serving(self):
+        """Falcon (parallel-residual MQA) through the ragged paged path —
+        4th family through FastGen v2 (reference falcon/model.py)."""
+        from deepspeed_tpu.models.falcon import (FalconForCausalLM,
+                                                 get_config)
+
+        cfg = get_config("tinyfalcon", vocab_size=64, dtype=jnp.float32,
+                         param_dtype=jnp.float32, scan_layers=False,
+                         remat=False, use_flash_attention=False,
+                         max_position_embeddings=64)
+        self._serve_matches_v1(FalconForCausalLM, cfg, seed=23)

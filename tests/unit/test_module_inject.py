@@ -240,3 +240,126 @@ class TestQwen2MoeConversion:
         got, _aux = ours.apply(params, jnp.asarray(ids, jnp.int32))
         np.testing.assert_allclose(np.asarray(got), ref, rtol=3e-4,
                                    atol=3e-4)
+
+
+class TestFalconConversion:
+    """Reference falcon/container.py: fused query_key_value split, MQA,
+    parallel attention+MLP residual, LayerNorms with bias."""
+
+    def _pair(self, scan_layers=True):
+        hf_cfg = transformers.FalconConfig(
+            vocab_size=96, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, num_kv_heads=1, alibi=False,
+            parallel_attn=True, new_decoder_architecture=False, bias=False,
+            max_position_embeddings=64, rope_theta=10000.0,
+            layer_norm_epsilon=1e-5, hidden_dropout=0.0,
+            attention_dropout=0.0)
+        hf = transformers.FalconForCausalLM(hf_cfg).eval()
+
+        from deepspeed_tpu.models.falcon import (FalconForCausalLM,
+                                                 get_config)
+
+        cfg = get_config("tinyfalcon", dtype=jnp.float32,
+                         param_dtype=jnp.float32, scan_layers=scan_layers,
+                         remat=False, use_flash_attention=False)
+        return hf, FalconForCausalLM(cfg)
+
+    @pytest.mark.parametrize("scan_layers", [True, False])
+    def test_logits_parity_with_transformers(self, scan_layers):
+        hf, ours = self._pair(scan_layers)
+        params = convert_hf_state_dict(ours, hf)
+        ids = np.random.default_rng(4).integers(0, 96, size=(2, 12),
+                                                dtype=np.int64)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(ids)).logits.numpy()
+        got = np.asarray(ours.apply(params, jnp.asarray(ids, jnp.int32)))
+        np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
+class TestOPTConversion:
+    """Reference opt/container.py: learned positions (+2 offset), biased
+    q/k/v/out, pre-LN, ReLU MLP; serves through the v1 engine."""
+
+    def _pair(self, scan_layers=True):
+        hf_cfg = transformers.OPTConfig(
+            vocab_size=96, hidden_size=32, ffn_dim=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, do_layer_norm_before=True,
+            dropout=0.0, attention_dropout=0.0, activation_function="relu",
+            word_embed_proj_dim=32)
+        hf = transformers.OPTForCausalLM(hf_cfg).eval()
+
+        from deepspeed_tpu.models.opt import OPTForCausalLM, get_config
+
+        cfg = get_config("tinyopt", dtype=jnp.float32,
+                         param_dtype=jnp.float32, scan_layers=scan_layers,
+                         remat=False, use_flash_attention=False)
+        return hf, OPTForCausalLM(cfg)
+
+    @pytest.mark.parametrize("scan_layers", [True, False])
+    def test_logits_parity_with_transformers(self, scan_layers):
+        hf, ours = self._pair(scan_layers)
+        params = convert_hf_state_dict(ours, hf)
+        ids = np.random.default_rng(5).integers(0, 96, size=(2, 12),
+                                                dtype=np.int64)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(ids)).logits.numpy()
+        got = np.asarray(ours.apply(params, jnp.asarray(ids, jnp.int32)))
+        np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+    def test_v1_generate_matches_hf(self):
+        import deepspeed_tpu
+
+        hf, ours = self._pair(scan_layers=True)
+        from deepspeed_tpu.models.opt import get_config
+
+        params = convert_hf_state_dict(ours, hf)
+        eng = deepspeed_tpu.init_inference(model=ours, params=params,
+                                           max_out_tokens=32,
+                                           dtype="float32")
+        prompt = np.arange(3, 9, dtype=np.int32)[None]
+        out = eng.generate(prompt, max_new_tokens=5, do_sample=False)
+        with torch.no_grad():
+            ref = hf.generate(torch.from_numpy(prompt.astype(np.int64)),
+                              max_new_tokens=5, do_sample=False).numpy()
+        np.testing.assert_array_equal(out, ref)
+
+    def test_falcon_40b_layout_parity(self):
+        """new_decoder_architecture: per-kv-group qkv interleave + the
+        ln_attn/ln_mlp pair (reference falcon 40B containers)."""
+        hf_cfg = transformers.FalconConfig(
+            vocab_size=96, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, num_kv_heads=2, alibi=False,
+            parallel_attn=True, new_decoder_architecture=True, bias=False,
+            max_position_embeddings=64, rope_theta=10000.0,
+            layer_norm_epsilon=1e-5, hidden_dropout=0.0,
+            attention_dropout=0.0)
+        hf = transformers.FalconForCausalLM(hf_cfg).eval()
+
+        from deepspeed_tpu.models.falcon import (FalconForCausalLM,
+                                                 get_config)
+
+        cfg = get_config("tinyfalcon", num_key_value_heads=2,
+                         new_decoder_architecture=True,
+                         dtype=jnp.float32, param_dtype=jnp.float32,
+                         scan_layers=True, remat=False,
+                         use_flash_attention=False)
+        ours = FalconForCausalLM(cfg)
+        params = convert_hf_state_dict(ours, hf)
+        ids = np.random.default_rng(6).integers(0, 96, size=(2, 10),
+                                                dtype=np.int64)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(ids)).logits.numpy()
+        got = np.asarray(ours.apply(params, jnp.asarray(ids, jnp.int32)))
+        np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+    def test_unsupported_falcon_layouts_fail_fast(self):
+        from deepspeed_tpu.models.falcon import get_config
+        from deepspeed_tpu.module_inject import convert_hf_state_dict
+
+        class M:
+            config = get_config("tinyfalcon", num_key_value_heads=4,
+                                dtype=jnp.float32)
+
+        with pytest.raises(AssertionError, match="num_kv_heads"):
+            convert_hf_state_dict(M(), {})
