@@ -25,6 +25,9 @@ ROWS = [
     ("ragged", "ragged", "Continuous batching, paged KV, 64 mixed-length "
                          "requests over 32 slots"),
     ("io", "io", "Native AIO engine, read+write sweep winner"),
+    ("infinity", "infinity", "Llama-2-7B fwd+bwd on ONE 16GB chip "
+                             "(host-streamed params + grads, NVMe "
+                             "moments)"),
 ]
 
 START = "<!-- BENCH-TABLE:START (python bench.py --all; scripts/update_readme_bench.py) -->"
