@@ -73,6 +73,10 @@ class LlamaConfig:
     # (Mistral) — None disables the window
     attention_bias: bool = False
     sliding_window: Optional[int] = None
+    # Phi family: bias on the attention out-projection, and rotary over
+    # only the first partial_rotary_factor * head_dim dims (phi-2: 0.4)
+    attention_out_bias: bool = False
+    partial_rotary_factor: float = 1.0
 
     def __post_init__(self):
         assert self.sequence_parallel in ("none", "ulysses", "ring"), (
@@ -180,8 +184,19 @@ class LlamaAttention(nn.Module):
         q = q.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
         v = v.reshape(B, S, Hkv, Dh).transpose(0, 2, 1, 3)
-        q = rotary_embedding(q, positions, cfg.rope_theta)
-        k = rotary_embedding(k, positions, cfg.rope_theta)
+        rot = int(Dh * cfg.partial_rotary_factor)
+        if rot >= Dh:
+            q = rotary_embedding(q, positions, cfg.rope_theta)
+            k = rotary_embedding(k, positions, cfg.rope_theta)
+        else:
+            # partial rotary (Phi family): rope the first `rot` dims,
+            # pass the rest through untouched
+            q = jnp.concatenate(
+                [rotary_embedding(q[..., :rot], positions, cfg.rope_theta),
+                 q[..., rot:]], axis=-1)
+            k = jnp.concatenate(
+                [rotary_embedding(k[..., :rot], positions, cfg.rope_theta),
+                 k[..., rot:]], axis=-1)
 
         if cfg.paged_decode:
             # blocked-KV continuous batching: one fused token batch over
@@ -194,7 +209,8 @@ class LlamaAttention(nn.Module):
             assert B == 1, "paged token batches are [1, T]"
             y = paged_update_and_attend(self, q, k, v, ragged_meta, cfg)
             y = y.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
-            return nn.Dense(E, name="o_proj", **dense,
+            return nn.Dense(E, name="o_proj",
+                            **dict(dense, use_bias=cfg.attention_out_bias),
                             **_tp_kwargs(cfg, "row"))(y)
 
         if cfg.decode:
@@ -218,7 +234,9 @@ class LlamaAttention(nn.Module):
                 y = cached_attention(q, k_full, v_full, positions,
                                      window=cfg.sliding_window)
                 y = y.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
-                return nn.Dense(E, name="o_proj", **dense,
+                return nn.Dense(E, name="o_proj",
+                                **dict(dense,
+                                       use_bias=cfg.attention_out_bias),
                                 **_tp_kwargs(cfg, "row"))(y)
             # full-prefill: cache written above; attend within the chunk
 
@@ -259,7 +277,8 @@ class LlamaAttention(nn.Module):
 
             y = mha_reference(q, k, v, causal=True)
         y = y.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
-        return nn.Dense(E, name="o_proj", **dense,
+        return nn.Dense(E, name="o_proj",
+                        **dict(dense, use_bias=cfg.attention_out_bias),
                         **_tp_kwargs(cfg, "row"))(y)
 
 

@@ -12,8 +12,9 @@ GPT-2's Conv1D stays untransposed — after which the inference engine's
 AutoTP sharding places them across the mesh (the TP half of the
 reference's injection policies).
 
-Supported families: GPT-2, Llama, Mistral, Qwen2, Mixtral (matching
-``models/gpt2|llama|mistral|qwen2|mixtral.py``).  Sources: a dict of tensors, an HF
+Supported families: GPT-2, Llama, Mistral, Qwen2, Mixtral, Phi,
+Phi-3, Qwen2-MoE, Falcon, OPT (matching ``models/*.py``; the reference
+v2 model zoo).  Sources: a dict of tensors, an HF
 ``transformers`` model object, or a directory holding
 ``pytorch_model.bin`` / sharded ``pytorch_model-*.bin`` /
 ``model.safetensors``.
@@ -288,6 +289,42 @@ def _convert_opt(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     return _nest(flat)
 
 
+def _convert_phi(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """Phi-1/1.5/2 (reference ``phi/containers.py``): biased q/k/v/dense,
+    parallel residual, gelu_new MLP with biases, biased LM head."""
+    assert not any("q_layernorm" in k or "k_layernorm" in k for k in sd), (
+        "Phi converter: qk_layernorm=True checkpoints are not supported "
+        "(the module has no q/k layernorms) — loading one silently would "
+        "produce wrong logits")
+    L = cfg.num_hidden_layers
+    layers = []
+    for i in range(L):
+        p = f"model.layers.{i}."
+        layer = {
+            "input_layernorm/scale": sd[p + "input_layernorm.weight"],
+            "input_layernorm/bias": sd[p + "input_layernorm.bias"],
+            "self_attn/o_proj/kernel": sd[p + "self_attn.dense.weight"].T,
+            "self_attn/o_proj/bias": sd[p + "self_attn.dense.bias"],
+        }
+        for w in ("q_proj", "k_proj", "v_proj"):
+            layer[f"self_attn/{w}/kernel"] = \
+                sd[f"{p}self_attn.{w}.weight"].T
+            layer[f"self_attn/{w}/bias"] = sd[f"{p}self_attn.{w}.bias"]
+        for fc in ("fc1", "fc2"):
+            layer[f"mlp/{fc}/kernel"] = sd[f"{p}mlp.{fc}.weight"].T
+            layer[f"mlp/{fc}/bias"] = sd[f"{p}mlp.{fc}.bias"]
+        layers.append(layer)
+    flat = {
+        "model/embed_tokens/embedding": sd["model.embed_tokens.weight"],
+        "model/final_layernorm/scale": sd["model.final_layernorm.weight"],
+        "model/final_layernorm/bias": sd["model.final_layernorm.bias"],
+        "lm_head/kernel": sd["lm_head.weight"].T,
+        "lm_head/bias": sd["lm_head.bias"],
+    }
+    _place_layers(flat, layers, cfg, prefix="model/layers")
+    return _nest(flat)
+
+
 def _convert_falcon(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     """Falcon (reference ``falcon/container.py``): fused query_key_value
     split into q/k/v (contiguous rows for the 7B MQA layout, per-kv-group
@@ -411,6 +448,7 @@ _CONVERTERS = {
     "Qwen2MoeConfig": _convert_qwen2_moe,
     "FalconConfig": _convert_falcon,
     "OPTConfig": _convert_opt,
+    "PhiConfig": _convert_phi,
 }
 
 

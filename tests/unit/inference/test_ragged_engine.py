@@ -349,3 +349,15 @@ class TestModelBreadth:
                          remat=False, use_flash_attention=False,
                          max_position_embeddings=64)
         self._serve_matches_v1(FalconForCausalLM, cfg, seed=23)
+
+    def test_phi_ragged_serving(self):
+        """Phi (partial rotary + parallel residual) through the ragged
+        paged path (reference phi/model.py) — partial rotary composes
+        with the paged KV writes."""
+        from deepspeed_tpu.models.phi import PhiForCausalLM, get_config
+
+        cfg = get_config("tinyphi", vocab_size=64, dtype=jnp.float32,
+                         param_dtype=jnp.float32, scan_layers=False,
+                         remat=False, use_flash_attention=False,
+                         max_position_embeddings=64)
+        self._serve_matches_v1(PhiForCausalLM, cfg, seed=29)

@@ -363,3 +363,36 @@ class TestOPTConversion:
 
         with pytest.raises(AssertionError, match="num_kv_heads"):
             convert_hf_state_dict(M(), {})
+
+
+class TestPhiConversion:
+    """Reference phi/containers.py: biased projections, parallel
+    residual, PARTIAL rotary (0.5 of head dims at test scale)."""
+
+    def _pair(self, scan_layers=True):
+        hf_cfg = transformers.PhiConfig(
+            vocab_size=96, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, partial_rotary_factor=0.5,
+            max_position_embeddings=64, rope_theta=10000.0,
+            layer_norm_eps=1e-5, resid_pdrop=0.0, embd_pdrop=0.0,
+            attention_dropout=0.0, qk_layernorm=False)
+        hf = transformers.PhiForCausalLM(hf_cfg).eval()
+
+        from deepspeed_tpu.models.phi import PhiForCausalLM, get_config
+
+        cfg = get_config("tinyphi", dtype=jnp.float32,
+                         param_dtype=jnp.float32, scan_layers=scan_layers,
+                         remat=False, use_flash_attention=False)
+        return hf, PhiForCausalLM(cfg)
+
+    @pytest.mark.parametrize("scan_layers", [True, False])
+    def test_logits_parity_with_transformers(self, scan_layers):
+        hf, ours = self._pair(scan_layers)
+        params = convert_hf_state_dict(ours, hf)
+        ids = np.random.default_rng(7).integers(0, 96, size=(2, 12),
+                                                dtype=np.int64)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(ids)).logits.numpy()
+        got = np.asarray(ours.apply(params, jnp.asarray(ids, jnp.int32)))
+        np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
