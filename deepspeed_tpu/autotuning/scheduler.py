@@ -280,7 +280,10 @@ class ModelBasedTuner(BaseTuner):
 
     def _predict(self) -> np.ndarray:
         X = np.asarray([config_features(c) for c in self.pool], np.float64)
-        if len(self._y) < 2:
+        if len(self._ok_vals) < 2:
+            # need 2+ REAL observations before fitting: an all-failure
+            # start would train the ridge purely on synthetic penalty
+            # values whose scale says nothing about the metric
             return self._rng.standard_normal(len(self.pool))
         A = np.asarray(self._X, np.float64)
         y = np.asarray(self._y, np.float64)

@@ -14,15 +14,16 @@ leaves per-rank files
 :func:`consolidate_reference_zero_checkpoint` reproduces the reference
 consolidation: concatenate each group's per-rank flat partitions, strip
 the stage-3 round-robin padding, and split by ``param_shapes`` into a
-named fp32 state dict.  :func:`load_reference_checkpoint` then feeds it
-through the HF-layout converters (``module_inject/hf_loader.py``) into a
-flax params tree — torch-DeepSpeed runs migrate without ever loading
-torch-DeepSpeed.
-
-Scope: mp_size 1 checkpoints (TP resharding of a torch checkpoint is the
-reference's own ds_to_universal + load pipeline; our engines reshard
-from the FULL tree at load time anyway, so consolidation is the part
-that matters).
+named fp32 state dict.  mp_size>1 (Megatron-style tensor-parallel)
+checkpoints are consolidated per mp rank and the TP slices merged per
+param class (reference ``ds_to_universal.py:232`` ``merge_tp_slices``:
+replicated → first slice, column-parallel → cat dim 0, row-parallel →
+cat dim 1) — classes come from explicit ``tp_merge_rules`` regexes, an
+exact-equality probe (replicated), and Megatron/HF naming heuristics
+for the row-parallel projections.  :func:`load_reference_checkpoint`
+then feeds the merged dict through the HF-layout converters
+(``module_inject/hf_loader.py``) into a flax params tree —
+torch-DeepSpeed runs migrate without ever loading torch-DeepSpeed.
 """
 from __future__ import annotations
 
@@ -36,7 +37,72 @@ import numpy as np
 from deepspeed_tpu.utils.logging import logger
 
 __all__ = ["consolidate_reference_zero_checkpoint",
-           "load_reference_checkpoint"]
+           "load_reference_checkpoint", "merge_tp_state_dicts"]
+
+# Megatron/HF decoder naming for ROW-parallel linears (sharded along the
+# input dim → merge = cat axis 1); column-parallel is the 2-D default.
+# (reference ds_to_universal reads these patterns from the checkpoint's
+# UNIVERSAL_CHECKPOINT_INFO; torch-DS training checkpoints usually lack
+# it, so the common layouts are encoded here and anything unusual goes
+# through ``tp_merge_rules``.)
+_ROW_PARALLEL_PATTERNS = (
+    r".*attention\.dense\.weight$",          # megatron attn out-proj
+    r".*self_attn\.o_proj\.weight$",         # llama-family
+    r".*attn\.c_proj\.weight$",              # gpt2-family
+    r".*mlp\.dense_4h_to_h\.weight$",        # megatron mlp down
+    r".*mlp\.down_proj\.weight$",            # llama-family
+    r".*mlp\.c_proj\.weight$",               # gpt2-family
+    r".*\.fc2\.weight$",                     # opt-family
+    r".*dense_4h_to_h\.weight$",
+)
+
+
+def merge_tp_state_dicts(per_mp: List[Dict[str, np.ndarray]],
+                         tp_merge_rules: Optional[Dict[str, str]] = None
+                         ) -> Dict[str, np.ndarray]:
+    """Merge per-TP-rank consolidated state dicts into full tensors
+    (reference ``ds_to_universal.py:232`` per-param-class rules).
+
+    ``tp_merge_rules``: {regex: rule} with rule in {"replicate",
+    "average", "cat0", "cat1"}; unmatched names fall back to: exact
+    equality across ranks → replicate; 1-D → cat0 (column-parallel bias);
+    2-D row-parallel names (``_ROW_PARALLEL_PATTERNS``) → cat1;
+    remaining → cat0."""
+    assert per_mp, "no TP ranks to merge"
+    if len(per_mp) == 1:
+        return per_mp[0]
+    names = list(per_mp[0].keys())
+    for r, sd in enumerate(per_mp[1:], 1):
+        if set(sd.keys()) != set(names):
+            raise ValueError(
+                f"mp rank {r} holds different param names than rank 0 — "
+                "not a tensor-parallel checkpoint family")
+    rules = [(re.compile(pat), rule)
+             for pat, rule in (tp_merge_rules or {}).items()]
+    out: Dict[str, np.ndarray] = {}
+    for name in names:
+        slices = [sd[name] for sd in per_mp]
+        rule = next((r for pat, r in rules if pat.match(name)), None)
+        if rule is None:
+            if all(np.array_equal(slices[0], s) for s in slices[1:]):
+                rule = "replicate"
+            elif slices[0].ndim <= 1:
+                rule = "cat0"
+            elif any(re.match(p, name) for p in _ROW_PARALLEL_PATTERNS):
+                rule = "cat1"
+            else:
+                rule = "cat0"
+        if rule == "replicate":
+            out[name] = slices[0]
+        elif rule == "average":
+            out[name] = np.mean(slices, axis=0)
+        elif rule == "cat0":
+            out[name] = np.concatenate(slices, axis=0)
+        elif rule == "cat1":
+            out[name] = np.concatenate(slices, axis=1)
+        else:
+            raise ValueError(f"unknown tp merge rule {rule!r} for {name}")
+    return out
 
 
 def _torch_load(path: str):
@@ -79,28 +145,51 @@ def _ordered_shapes(param_shapes) -> List[Dict[str, tuple]]:
             for g in param_shapes]
 
 
+def _mp_index(path: str) -> int:
+    """TP rank from an ``mp_rank_XX`` / ``zero_pp_rank_D_mp_rank_XX``
+    file name (0 when the name carries no mp marker)."""
+    m = re.search(r"mp_rank_(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else 0
+
+
 def consolidate_reference_zero_checkpoint(
-        ckpt_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+        ckpt_dir: str, tag: Optional[str] = None,
+        tp_merge_rules: Optional[Dict[str, str]] = None
+        ) -> Dict[str, np.ndarray]:
     """Reference ``zero_to_fp32`` consolidation: named fp32 tensors from
-    the per-rank flat partitions."""
+    the per-rank flat partitions.  mp_size>1 checkpoints consolidate per
+    TP rank and merge the slices (``merge_tp_state_dicts``)."""
     d = _find_tag_dir(ckpt_dir, tag)
     model_files = sorted(glob.glob(os.path.join(d, "*_model_states.pt")))
     assert model_files, f"no *_model_states.pt under {d}"
+    optim_all = glob.glob(os.path.join(d, "*_optim_states.pt"))
     # stage 3 writes per-DP-rank zero_pp_rank_*_model_states.pt (all with
-    # identical param_shapes); stages 1/2 write one mp_rank_XX file.  TP
-    # ranks are the plain mp_rank files — only those gate the assert.
+    # identical param_shapes per TP rank); stages 1/2 write one
+    # mp_rank_XX file per TP rank.  Group everything by TP rank,
+    # consolidate each, then merge the TP slices.
     plain_mp = [f for f in model_files
                 if not os.path.basename(f).startswith("zero_pp_rank_")]
-    assert len(plain_mp) <= 1, (
-        "multi-TP reference checkpoints are not supported — run the "
-        "reference's own ds_to_universal first, or consolidate per "
-        "mp_rank")
-    model_sd = _torch_load((plain_mp or model_files)[0])
+    mp_ranks = sorted({_mp_index(f) for f in (plain_mp or model_files)})
+    per_mp = []
+    for mp in mp_ranks:
+        model_f = next(f for f in (plain_mp or model_files)
+                       if _mp_index(f) == mp)
+        optim_f = sorted(
+            (f for f in optim_all if _mp_index(f) == mp),
+            key=lambda p: [int(x) for x in re.findall(
+                r"\d+", os.path.basename(p))])
+        per_mp.append(_consolidate_one_mp(model_f, optim_f))
+    merged = merge_tp_state_dicts(per_mp, tp_merge_rules)
+    if len(per_mp) > 1:
+        logger.info(f"merged {len(per_mp)} TP slices "
+                    f"(reference mp_size={len(per_mp)} checkpoint)")
+    return merged
 
-    optim_files = sorted(
-        glob.glob(os.path.join(d, "*_optim_states.pt")),
-        key=lambda p: [int(x) for x in re.findall(r"\d+",
-                                                  os.path.basename(p))])
+
+def _consolidate_one_mp(model_file: str,
+                        optim_files: List[str]) -> Dict[str, np.ndarray]:
+    """One TP rank's consolidation across its DP partitions."""
+    model_sd = _torch_load(model_file)
     if not optim_files:
         # no ZeRO shards: the module weights are already whole
         module = model_sd.get("module", model_sd)
@@ -150,8 +239,9 @@ def consolidate_reference_zero_checkpoint(
                 raise ValueError(
                     f"group {gi}: shapes need {off} elements, flat "
                     f"partitions hold {flat.size}")
-    logger.info(f"consolidated reference ZeRO checkpoint: {len(out)} "
-                f"tensors from {world} rank partition(s) "
+    logger.info(f"consolidated reference ZeRO slice "
+                f"(mp_rank {_mp_index(model_file)}): {len(out)} tensors "
+                f"from {world} DP partition(s) "
                 f"(stage {'3' if stage3 else '1/2'})")
     return out
 
@@ -163,12 +253,16 @@ def _strip_module_prefix(sd: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
 
 
 def load_reference_checkpoint(model: Any, ckpt_dir: str,
-                              tag: Optional[str] = None) -> Dict[str, Any]:
+                              tag: Optional[str] = None,
+                              tp_merge_rules: Optional[Dict[str, str]]
+                              = None) -> Dict[str, Any]:
     """torch-DeepSpeed run -> flax params for our engines: consolidate
-    the ZeRO shards, then map the named tensors through the HF-layout
-    converter for ``model``'s family."""
+    the ZeRO shards (merging TP slices for mp_size>1), then map the
+    named tensors through the HF-layout converter for ``model``'s
+    family."""
     from deepspeed_tpu.module_inject import convert_hf_state_dict
 
     sd = _strip_module_prefix(
-        consolidate_reference_zero_checkpoint(ckpt_dir, tag))
+        consolidate_reference_zero_checkpoint(ckpt_dir, tag,
+                                              tp_merge_rules))
     return convert_hf_state_dict(model, sd)

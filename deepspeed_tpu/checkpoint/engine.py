@@ -204,7 +204,12 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         engine.lr_scheduler.load_state_dict(extra["lr_scheduler"])
     if load_optimizer_states and \
             getattr(engine, "nvme_swapper", None) is not None:
-        engine.nvme_swapper.load_from(path)
+        if not engine.nvme_swapper.load_from(path):
+            # resume compat: the checkpoint may have been saved by the
+            # device/fused offload path (optimizer records in the sharded
+            # store, no swap files) — ingest its Adam moments instead of
+            # silently restarting them from zero
+            _ingest_fused_opt_state(engine, path)
     log_dist(f"loaded checkpoint {path} (global_steps="
              f"{engine.global_steps})", ranks=[0])
     return path, extra.get("client_state")
@@ -295,3 +300,45 @@ def save_16bit_model(engine, save_dir: str,
             pickle.dump(flat, f)
     log_dist(f"save_16bit_model: {len(flat)} tensors -> {path}", ranks=[0])
     return path
+
+
+def _ingest_fused_opt_state(engine, path: str) -> bool:
+    """Feed a fused-optimizer checkpoint's Adam ``mu``/``nu`` records
+    into the engine's swapped-moment tier (``import_moments``) — the
+    cross-format half of tier-portable resumes."""
+    r = sharded._Reader(path)
+    try:
+        opt = [p for p in r.paths() if p.startswith("optimizer/")]
+
+        def by(marker):
+            # namedtuple fields render as ".mu"/".nu" in record paths
+            return {p.split(marker, 1)[1]: p for p in opt if marker in p}
+
+        mu = by("/.mu/") or by("/mu/")
+        nu = by("/.nu/") or by("/nu/")
+        if not mu or set(mu) != set(nu):
+            return False
+        count = 0
+        for p in opt:
+            if p.endswith(".count") or p.endswith("/count"):
+                shape, _ = r.meta(p)
+                count = int(np.asarray(r.read_slice(
+                    p, tuple(slice(0, d) for d in shape))))
+                break
+
+        def fetch(key):
+            mp, np_ = mu.get(key), nu.get(key)
+            if mp is None:
+                return None
+            shape, _ = r.meta(mp)
+            idx = tuple(slice(0, d) for d in shape)
+            return r.read_slice(mp, idx), r.read_slice(np_, idx)
+
+        n = engine.nvme_swapper.import_moments(fetch, count)
+        if n:
+            log_dist(f"ingested {n} Adam moment tensors from a "
+                     "fused-optimizer checkpoint into the swapped tier "
+                     f"(count={count})", ranks=[0])
+        return n > 0
+    finally:
+        r.close()

@@ -71,6 +71,10 @@ def init_distributed(dist_backend: str = "xla",
     runs set ``DSTPU_COORDINATOR`` (or the standard JAX env/cloud TPU
     metadata) and we call ``jax.distributed.initialize`` — the analogue of
     the reference's ``torch.distributed.init_process_group`` rendezvous.
+    With ``auto_mpi_discovery`` (default), the Slurm / OpenMPI / PMI /
+    torchrun / Cloud-TPU environment is consulted when no explicit
+    coordinator is configured (reference ``mpi_discovery`` + managed-env
+    patching, comm.py:694,754).
     """
     if _state.initialized:
         return
@@ -78,6 +82,26 @@ def init_distributed(dist_backend: str = "xla",
     num_processes = world_size if world_size > 0 else int(
         os.environ.get("DSTPU_NUM_PROCESSES", "0"))
     process_id = rank if rank >= 0 else int(os.environ.get("DSTPU_PROCESS_ID", "-1"))
+    if not coordinator and auto_mpi_discovery:
+        from deepspeed_tpu.launcher.env_discovery import \
+            discover_distributed_env
+
+        found = discover_distributed_env()
+        if found and found.get("auto"):
+            jax.distributed.initialize()
+            log_dist("jax.distributed initialized from Cloud-TPU pod "
+                     "metadata", ranks=[0])
+            _state.backend_name = dist_backend
+            _state.initialized = True
+            return
+        if found:
+            coordinator = found["coordinator_address"]
+            num_processes = found["num_processes"]
+            process_id = found["process_id"]
+            log_dist(
+                f"distributed env discovered from {found['source']}: "
+                f"rank={process_id}/{num_processes} "
+                f"coordinator={coordinator}", ranks=[0])
     if coordinator and num_processes > 1:
         jax.distributed.initialize(
             coordinator_address=coordinator,

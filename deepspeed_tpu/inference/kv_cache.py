@@ -90,14 +90,18 @@ def update_kv_cache(mdl, k: jax.Array, v: jax.Array, max_len: int,
 
 
 def cached_attention(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
-                     q_positions: jax.Array, window=None) -> jax.Array:
+                     q_positions: jax.Array, window=None,
+                     k_bias: jax.Array = None) -> jax.Array:
     """Attention of ``q`` [B, H, S, Dh] against the TIME-MAJOR cache
     buffers [L, B, Hkv, Dh], masking key slots beyond each query's
     absolute position.  ``q_positions``: [S] or [B, S] absolute
     positions.  ``window``: Mistral-style sliding window — key slots
-    more than ``window-1`` behind the query are masked too.  Used for
-    decode steps (S=1) and ragged chunked prefill; full prefill attends
-    within its chunk via the normal causal kernels.
+    more than ``window-1`` behind the query are masked too.  ``k_bias``:
+    per-head additive score bias over key SLOTS, shape [H, L] — ALiBi
+    (BLOOM) reduces to this because its per-query shift is constant
+    along each softmax row.  Used for decode steps (S=1) and ragged
+    chunked prefill; full prefill attends within its chunk via the
+    normal causal kernels.
     """
     B, H, S, Dh = q.shape
     L, Hkv = k_full.shape[0], k_full.shape[2]
@@ -106,6 +110,8 @@ def cached_attention(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
         k_full = jnp.repeat(k_full, rep, axis=2)
         v_full = jnp.repeat(v_full, rep, axis=2)
     att = jnp.einsum("bhsd,lbhd->bhsl", q, k_full) / np.sqrt(Dh)
+    if k_bias is not None:
+        att = att + k_bias[None, :, None, :].astype(att.dtype)
     qpos = q_positions if q_positions.ndim == 2 else q_positions[None]
     kpos = jnp.arange(L)[None, None, None, :]
     mask = kpos <= qpos[:, None, :, None]

@@ -49,10 +49,12 @@ class PageAllocator:
     """Free-list page allocator over ``num_pages`` fixed-size pages.
 
     Page 0 is reserved (trash page for padding-token writes).  Sequences
-    reserve their worst case (``prompt + max_new_tokens``) at admission —
-    a documented divergence from the reference's on-demand growth +
-    scheduler backpressure: same memory ceiling, no mid-flight
-    out-of-pages state to unwind.
+    either reserve their worst case (``prompt + max_new_tokens``) at
+    admission or — the reference's on-demand model
+    (``ragged/blocked_allocator.py:1`` + ``engine_v2.py:184``
+    ``can_schedule``) — take pages as they grow via :meth:`grow`, with
+    the engine's scheduler providing admission backpressure and
+    eviction when the pool runs dry mid-flight.
     """
 
     def __init__(self, num_pages: int, page_size: int):
@@ -78,6 +80,18 @@ class PageAllocator:
         assert need <= len(self._free), "out of KV pages"
         pages = [self._free.pop() for _ in range(need)]
         self._owned[slot] = pages
+        return pages
+
+    def owned(self, slot: int) -> int:
+        return len(self._owned.get(slot, ()))
+
+    def grow(self, slot: int, n_pages: int) -> List[int]:
+        """Extend ``slot`` by ``n_pages`` (on-demand growth; caller
+        checks ``free_pages`` first — running dry here is a scheduler
+        bug, not backpressure)."""
+        assert n_pages <= len(self._free), "out of KV pages (grow)"
+        pages = [self._free.pop() for _ in range(n_pages)]
+        self._owned.setdefault(slot, []).extend(pages)
         return pages
 
     def free(self, slot: int) -> None:

@@ -76,13 +76,25 @@ class Request:
     top_p: float = 1.0
     # runtime state
     slot: int = -1
-    prefill_done: int = 0                 # prompt tokens already cached
+    prefill_done: int = 0                 # context tokens already cached
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    # prefill SOURCE: the prompt, or prompt + already-generated tokens
+    # after an eviction (the continuation re-prefills its own output)
+    ctx: Optional[np.ndarray] = None
+
+    @property
+    def ctx_len(self) -> int:
+        return int(self.ctx.size if self.ctx is not None
+                   else self.prompt.size)
 
     @property
     def length(self) -> int:
-        return self.prefill_done + len(self.generated)
+        # tokens in the KV cache: prefilled context + tokens generated
+        # AFTER that context (an evicted continuation's ctx already
+        # contains its earlier output)
+        return self.prefill_done + len(self.generated) - \
+            (self.ctx_len - self.prompt.size)
 
 
 class RaggedInferenceEngineV2:
@@ -102,12 +114,18 @@ class RaggedInferenceEngineV2:
                  num_pages: Optional[int] = None, topology=None,
                  decode_block_size: int = 8,
                  kv_cache_dtype: str = "none",
-                 quantize_weights: Optional[str] = None):
+                 quantize_weights: Optional[str] = None,
+                 kv_reserve: str = "on_demand"):
         """``kv_cache_dtype``: "none" | "fp8" | "int8" — paged KV pool
         storage format (reference fp_quantizer KV quantization).
         ``quantize_weights``: None | "int8" | "fp8" | "fp6" — weights
         persist quantized in HBM and dequantize in-jit at use (reference
-        FP6-LLM cuda_linear / int8 quantized inference)."""
+        FP6-LLM cuda_linear / int8 quantized inference).
+        ``kv_reserve``: "on_demand" (reference blocked-allocator model —
+        admit on prompt-size pages, grow per decode block, evict +
+        requeue as a continuation when the pool runs dry) or
+        "worst_case" (reserve prompt + max_new_tokens at admission; no
+        mid-flight out-of-pages state, lower concurrency per byte)."""
         mcfg = getattr(model, "config", None)
         assert dataclasses.is_dataclass(mcfg) and hasattr(mcfg, "decode"), \
             "ragged engine needs a model-zoo module with a decode config"
@@ -149,6 +167,9 @@ class RaggedInferenceEngineV2:
         self.prefill_chunk = prefill_chunk
         self.T = max_seqs + prefill_chunk          # fused batch width
         self.decode_block_size = max(int(decode_block_size), 1)
+        assert kv_reserve in ("on_demand", "worst_case"), kv_reserve
+        self.kv_reserve = kv_reserve
+        self.evictions = 0
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         from deepspeed_tpu.inference.common import normalize_params
@@ -483,11 +504,29 @@ class RaggedInferenceEngineV2:
         self._admit()
         live = [r for r in self.slots if r is not None and not r.done]
         if (self.decode_block_size > 1 and live and
-                all(r.prefill_done >= r.prompt.size for r in live)):
+                all(r.prefill_done >= r.ctx_len for r in live) and
+                all(self._ensure_pages(
+                    r.slot,
+                    r.length + min(self.decode_block_size,
+                                   r.max_new_tokens - len(r.generated)))
+                    for r in live)):
             return self._step_decode_block(live)
         plan = self._plan_tick()
         if plan is None:
             self._reap()
+            # every live sequence is page-stalled: evict the youngest as
+            # a continuation so the rest (and the queue) can progress
+            # (reference scheduler backpressure, engine_v2.py:184)
+            stalled = getattr(self, "_stalled", [])
+            if stalled and live:
+                if len(live) == 1 and not self.waiting:
+                    raise RuntimeError(
+                        "KV pool too small for the only live sequence "
+                        f"(uid={live[0].uid}, needs "
+                        f"{pages_for(live[0].length + 1, self.page_size)}"
+                        f" pages of {self.allocator.num_pages - 1}) — "
+                        "raise num_pages or lower max_new_tokens")
+                self._evict(max(stalled, key=lambda r: r.uid))
             return 0
         (token_ids, positions, kv_lens, page_indices, cu_q_lens, num_seqs,
          new_kv_dest, sample_rows, samplers) = plan
@@ -508,15 +547,63 @@ class RaggedInferenceEngineV2:
             if self.slots[i] is not None:
                 continue
             req = self.waiting[0]
-            total = req.prompt.size + req.max_new_tokens
-            if not self.allocator.can_allocate(total):
+            if req.ctx is None:
+                req.ctx = req.prompt
+            if self.kv_reserve == "worst_case":
+                # worst case INCLUDING re-prefilled output for evicted
+                # continuations (their ctx carries earlier tokens)
+                need = req.ctx_len + req.max_new_tokens - \
+                    len(req.generated)
+            else:
+                # on-demand (reference can_schedule): context + the
+                # first decode block; growth happens per block
+                need = req.ctx_len + min(self.decode_block_size,
+                                         max(req.max_new_tokens -
+                                             len(req.generated), 1))
+            if not self.allocator.can_allocate(need):
                 break                      # FIFO: wait for pages to free
             self.waiting.popleft()
             req.slot = i
+            req.prefill_done = 0
             self.slots[i] = req
-            pages = self.allocator.allocate(i, total)
+            pages = self.allocator.allocate(i, need)
             self.page_table[i, :] = -1
             self.page_table[i, :len(pages)] = pages
+
+    def _ensure_pages(self, slot: int, upto_tokens: int) -> bool:
+        """Grow ``slot``'s page run to cover ``upto_tokens`` cache
+        positions; False when the pool can't (scheduler backpressure —
+        the sequence sits this tick out, or gets evicted)."""
+        upto_tokens = min(upto_tokens, self.max_seq_len)
+        need = pages_for(upto_tokens, self.page_size)
+        have = self.allocator.owned(slot)
+        if need <= have:
+            return True
+        if need - have > self.allocator.free_pages:
+            return False
+        pages = self.allocator.grow(slot, need - have)
+        self.page_table[slot, have:have + len(pages)] = pages
+        return True
+
+    def _evict(self, r) -> None:
+        """Requeue ``r`` as a CONTINUATION: its pages return to the
+        pool, and on re-admission it re-prefills prompt + its own
+        generated tokens (greedy continuations are exact; sampled ones
+        resume from the same sampled prefix)."""
+        from deepspeed_tpu.utils.logging import logger
+
+        self.allocator.free(r.slot)
+        self.page_table[r.slot, :] = -1
+        self.slots[r.slot] = None
+        r.ctx = np.concatenate(
+            [r.prompt, np.asarray(r.generated, np.int32)])
+        r.prefill_done = 0
+        r.slot = -1
+        self.waiting.append(r)             # back of the queue: the freed
+        self.evictions += 1                # pages go to older work first
+        logger.info(f"ragged engine: evicted uid={r.uid} "
+                    f"({r.ctx.size} ctx tokens) — KV pool exhausted; "
+                    "requeued as continuation")
 
     def _flat_dest(self, slot: int, pos: int) -> int:
         page = self.page_table[slot, pos // self.page_size]
@@ -526,12 +613,20 @@ class RaggedInferenceEngineV2:
     def _plan_tick(self):
         """Host-side SplitFuse plan: one decode token per ready sequence
         plus prompt chunks for prefilling sequences, all in ONE batch."""
-        decode_rs = [r for r in self.slots
-                     if r is not None and not r.done
-                     and r.prefill_done >= r.prompt.size]
+        self._stalled = []
+        decode_rs = []
+        for r in self.slots:
+            if r is None or r.done or r.prefill_done < r.ctx_len:
+                continue
+            # the tick writes the last generated token at position
+            # length-1, so pages must cover `length` tokens
+            if self._ensure_pages(r.slot, r.length):
+                decode_rs.append(r)
+            else:
+                self._stalled.append(r)    # out of pages: sit this tick out
         prefill_rs = sorted(
             (r for r in self.slots
-             if r is not None and r.prefill_done < r.prompt.size),
+             if r is not None and r.prefill_done < r.ctx_len),
             key=lambda r: r.uid)
         if not decode_rs and not prefill_rs:
             return None
@@ -551,20 +646,30 @@ class RaggedInferenceEngineV2:
         budget = self.T - len(decode_rs)
         takes: Dict[int, int] = {}
         for r in prefill_rs:
-            take = min(budget, r.prompt.size - r.prefill_done)
+            take = min(budget, r.ctx_len - r.prefill_done)
             if take <= 0:
-                continue
+                continue                   # batch-budget-limited, not stalled
+            if not self._ensure_pages(r.slot, r.prefill_done + take):
+                # partial growth: cover what the pool allows this tick
+                coverable = (self.allocator.owned(r.slot) +
+                             self.allocator.free_pages) * self.page_size
+                take = min(take, coverable - r.prefill_done)
+                if take <= 0:
+                    self._stalled.append(r)     # page-limited
+                    continue
+                self._ensure_pages(r.slot, r.prefill_done + take)
             takes[r.uid] = take
             budget -= take
 
         # pack sequences in slot order (any fixed order works; the kernel
         # sees sequences via cu_q_lens row j)
+        stalled_uids = {r.uid for r in self._stalled}
         t = 0
         j = 0
         for r in [s for s in self.slots if s is not None]:
-            if r.done:
+            if r.done or r.uid in stalled_uids:
                 continue
-            if r.prefill_done >= r.prompt.size:             # decode: 1 tok
+            if r.prefill_done >= r.ctx_len:                 # decode: 1 tok
                 p = min(r.length - 1, self.max_seq_len - 1)
                 token_ids[t] = self._last_tokens[r.slot]
                 positions[t] = p
@@ -581,7 +686,7 @@ class RaggedInferenceEngineV2:
                 if take <= 0:
                     continue
                 lo = r.prefill_done
-                token_ids[t:t + take] = r.prompt[lo:lo + take]
+                token_ids[t:t + take] = r.ctx[lo:lo + take]
                 pos = np.arange(lo, lo + take)
                 positions[t:t + take] = pos
                 pg = self.page_table[r.slot, pos // self.page_size]
@@ -592,7 +697,7 @@ class RaggedInferenceEngineV2:
                 page_indices[j] = self.page_table[r.slot]
                 kv_lens[j] = r.prefill_done
                 cu_q_lens[j + 1] = cu_q_lens[j] + take
-                finishes = r.prefill_done >= r.prompt.size
+                finishes = r.prefill_done >= r.ctx_len
                 sample_rows[j] = t + take - 1
                 samplers.append((r, j, finishes))
                 t += take

@@ -1,0 +1,153 @@
+"""Managed-cluster environment discovery for the distributed bootstrap.
+
+TPU-native counterpart of the reference's MPI/cloud env plumbing
+(``deepspeed/comm/comm.py:694`` ``mpi_discovery``, ``:754``
+``patch_aml_env_for_torch_nccl_backend`` / AWS-SM patching): derive the
+``jax.distributed.initialize`` arguments — coordinator address, world
+size, process id — from whatever launcher scheduled this process, so
+multi-host bring-up on Slurm / OpenMPI / MPICH / Intel-MPI / torchrun /
+Cloud-TPU pods needs no manual ``DSTPU_*`` plumbing.
+
+Unlike the reference (which needs mpi4py collectives to agree on a
+master address), every convention handled here carries enough in the
+environment alone: scheduler-provided rank/size plus a deterministic
+first-host coordinator.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Mapping, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["discover_distributed_env", "first_slurm_host"]
+
+DEFAULT_COORDINATOR_PORT = 29500
+
+
+def first_slurm_host(nodelist: str) -> str:
+    """First hostname of a compact Slurm nodelist.
+
+    Handles ``host1``, ``a,b``, ``prefix[001-004,007]``, and
+    ``prefix[3,5]x,other`` forms — only the FIRST entry is expanded
+    (the coordinator); zero padding is preserved.
+    """
+    nodelist = nodelist.strip()
+    m = re.match(r"([^,\[]+)(\[([^\]]+)\])?", nodelist)
+    if not m:
+        return nodelist.split(",")[0]
+    prefix, _, spec = m.groups()
+    if not spec:
+        return prefix
+    first = spec.split(",")[0]
+    lo = first.split("-")[0]
+    suffix = nodelist[m.end():].split(",")[0]
+    return f"{prefix}{lo}{suffix}"
+
+
+def discover_distributed_env(
+        environ: Optional[Mapping[str, str]] = None,
+        default_port: int = DEFAULT_COORDINATOR_PORT
+) -> Optional[dict]:
+    """Derive distributed-init settings from scheduler conventions.
+
+    Returns ``None`` when nothing indicates a multi-process launch,
+    ``{"auto": True}`` when the runtime self-discovers (Cloud TPU pod
+    metadata — call ``jax.distributed.initialize()`` bare), else
+    ``{"coordinator_address", "num_processes", "process_id",
+    "local_rank", "source"}``.
+
+    Priority: Cloud-TPU pod metadata > Slurm > OpenMPI (incl. AML /
+    AWS-SageMaker hosted MPI) > MPICH/Intel-MPI PMI > torchrun-style
+    RANK/WORLD_SIZE.
+    """
+    env = environ if environ is not None else os.environ
+
+    # Cloud TPU pods (GKE / queued resources): libtpu metadata carries
+    # the full topology; jax.distributed.initialize() with no arguments
+    # is the supported path.  Single-worker TPU VMs also carry
+    # TPU_WORKER_ID=0 — only a multi-host hostname list means a pod
+    # (standing up a coordinator on a lone VM would break concurrent
+    # single-process jobs on the same host).
+    hostnames = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",")
+                 if h]
+    if len(hostnames) > 1:
+        return {"auto": True, "source": "cloud-tpu"}
+
+    port = int(env.get("MASTER_PORT", default_port))
+
+    if "SLURM_PROCID" in env and "SLURM_NTASKS" in env:
+        n = int(env["SLURM_NTASKS"])
+        if n <= 1:
+            return None
+        nodelist = env.get("SLURM_STEP_NODELIST",
+                           env.get("SLURM_JOB_NODELIST", ""))
+        addr = env.get("MASTER_ADDR") or first_slurm_host(nodelist)
+        if not addr:
+            logger.warning("Slurm env detected but no nodelist/"
+                           "MASTER_ADDR; skipping auto-discovery")
+            return None
+        return {"coordinator_address": f"{addr}:{port}",
+                "num_processes": n,
+                "process_id": int(env["SLURM_PROCID"]),
+                "local_rank": int(env.get("SLURM_LOCALID", 0)),
+                "source": "slurm"}
+
+    if "OMPI_COMM_WORLD_RANK" in env and "OMPI_COMM_WORLD_SIZE" in env:
+        n = int(env["OMPI_COMM_WORLD_SIZE"])
+        if n <= 1:
+            return None
+        addr = env.get("MASTER_ADDR")
+        if not addr and "AZ_BATCH_MASTER_NODE" in env:       # Azure ML
+            host_port = env["AZ_BATCH_MASTER_NODE"].split(":")
+            addr = host_port[0]
+            if len(host_port) > 1 and "MASTER_PORT" not in env:
+                port = int(host_port[1])
+        if not addr and "AZ_BATCHAI_MPI_MASTER_NODE" in env:
+            addr = env["AZ_BATCHAI_MPI_MASTER_NODE"]
+        if not addr and "SM_HOSTS" in env:                   # AWS SageMaker
+            try:
+                addr = sorted(json.loads(env["SM_HOSTS"]))[0]
+            except (ValueError, IndexError):
+                addr = None
+        if not addr:
+            logger.warning(
+                "OpenMPI env detected but no coordinator address "
+                "(set MASTER_ADDR, or launch with a hostfile-aware "
+                "runner); skipping auto-discovery")
+            return None
+        return {"coordinator_address": f"{addr}:{port}",
+                "num_processes": n,
+                "process_id": int(env["OMPI_COMM_WORLD_RANK"]),
+                "local_rank": int(
+                    env.get("OMPI_COMM_WORLD_LOCAL_RANK", 0)),
+                "source": "openmpi"}
+
+    if "PMI_RANK" in env and "PMI_SIZE" in env:              # MPICH / IMPI
+        n = int(env["PMI_SIZE"])
+        if n <= 1:
+            return None
+        addr = env.get("MASTER_ADDR") or env.get("I_MPI_HYDRA_HOST")
+        if not addr:
+            logger.warning("PMI env detected but no MASTER_ADDR; "
+                           "skipping auto-discovery")
+            return None
+        return {"coordinator_address": f"{addr}:{port}",
+                "num_processes": n,
+                "process_id": int(env["PMI_RANK"]),
+                "local_rank": int(env.get("MPI_LOCALRANKID", 0)),
+                "source": "pmi"}
+
+    if "RANK" in env and "WORLD_SIZE" in env and "MASTER_ADDR" in env:
+        n = int(env["WORLD_SIZE"])
+        if n <= 1:
+            return None
+        return {"coordinator_address": f"{env['MASTER_ADDR']}:{port}",
+                "num_processes": n,
+                "process_id": int(env["RANK"]),
+                "local_rank": int(env.get("LOCAL_RANK", 0)),
+                "source": "torchrun"}
+
+    return None

@@ -1,0 +1,268 @@
+"""BLOOM model family (BigScience 560m…176B lineage).
+
+Reference injects BLOOM through its v1 policy container
+(``module_inject/containers/bloom.py``: fused per-head ``[q;k;v]``
+``query_key_value``, ALiBi position bias, biased LayerNorms and
+projections): no rotary/learned positions — attention scores carry the
+ALiBi per-head linear distance bias — an embedding LayerNorm after
+``word_embeddings``, a biased GELU(tanh) MLP at 4×hidden, and an
+lm_head tied to the input embedding.
+
+ALiBi's bias ``-slope_h · (q_pos - k_pos)`` is constant along each
+softmax row in ``q_pos``, so it reduces to ``slope_h · k_pos`` — a
+per-head bias over KEY slots only — which is what both the training
+kernel path and the decode cache path add (``cached_attention`` k_bias).
+
+Scope follows the reference v1 container: training + v1 KV-cache
+serving; the ragged v2 paged path and sequence-parallel attention do not
+support ALiBi yet and fail loudly.  The lm_head is stored as its own
+(loader-copied) matrix rather than weight-tied — training fine-tunes
+them independently (documented divergence; serving parity is exact).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.llama import LlamaConfig, _tp_kwargs
+
+
+@dataclasses.dataclass(frozen=True)
+class BloomConfig(LlamaConfig):
+    layer_norm_epsilon: float = 1e-5
+
+
+PRESETS = {
+    "bloom-560m": dict(vocab_size=250880, hidden_size=1024,
+                       intermediate_size=4096, num_hidden_layers=24,
+                       num_attention_heads=16, num_key_value_heads=16,
+                       max_position_embeddings=2048),
+    "bloom-7b1": dict(vocab_size=250880, hidden_size=4096,
+                      intermediate_size=16384, num_hidden_layers=30,
+                      num_attention_heads=32, num_key_value_heads=32,
+                      max_position_embeddings=2048),
+    "tinybloom": dict(vocab_size=96, hidden_size=32, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=64),
+}
+
+
+def get_config(preset: str, **overrides) -> BloomConfig:
+    kw = dict(PRESETS[preset])
+    kw.update(overrides)
+    kw.setdefault("dtype", jnp.bfloat16)
+    return BloomConfig(**kw)
+
+
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes (the train-time head schedule from the
+    ALiBi paper, as used by BLOOM/HF)."""
+
+    def pow2(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(n_heads).is_integer():
+        return np.asarray(pow2(n_heads), np.float32)
+    closest = 2 ** math.floor(math.log2(n_heads))
+    slopes = pow2(closest) + pow2(2 * closest)[0::2][:n_heads - closest]
+    return np.asarray(slopes, np.float32)
+
+
+class BloomAttention(nn.Module):
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic: bool = True,
+                 ragged_meta=None):
+        cfg = self.config
+        if ragged_meta is not None or cfg.paged_decode:
+            raise NotImplementedError(
+                "ALiBi attention is not wired into the paged ragged "
+                "path yet — serve BLOOM through the v1 engine")
+        if cfg.sequence_parallel != "none":
+            raise NotImplementedError(
+                "ALiBi does not compose with sequence parallelism yet")
+        B, S, E = x.shape
+        H, Dh = cfg.num_attention_heads, cfg.head_dim
+        dense = dict(use_bias=True, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+        q = nn.Dense(H * Dh, name="q_proj", **dense,
+                     **_tp_kwargs(cfg, "col"))(x)
+        k = nn.Dense(H * Dh, name="k_proj", **dense,
+                     **_tp_kwargs(cfg, "col"))(x)
+        v = nn.Dense(H * Dh, name="v_proj", **dense,
+                     **_tp_kwargs(cfg, "col"))(x)
+        q = q.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+        slopes = jnp.asarray(alibi_slopes(H))
+
+        if cfg.decode:
+            from deepspeed_tpu.inference.kv_cache import (cached_attention,
+                                                          update_kv_cache)
+
+            max_len = cfg.max_cache_len or cfg.max_position_embeddings
+            ragged = cfg.ragged_decode
+            wp = positions[:, 0] if ragged else None
+            k_full, v_full, _ = update_kv_cache(self, k, v, max_len,
+                                                write_positions=wp)
+            if S == 1 or ragged:
+                k_bias = slopes[:, None] * jnp.arange(
+                    k_full.shape[0], dtype=jnp.float32)[None, :]
+                y = cached_attention(q, k_full, v_full, positions,
+                                     k_bias=k_bias)
+                y = y.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
+                return nn.Dense(E, name="dense", **dense,
+                                **_tp_kwargs(cfg, "row"))(y)
+            # full prefill: cache written above; attend within the chunk
+
+        from deepspeed_tpu.ops.flash_attention import mha_reference
+
+        pos = positions if positions is not None else jnp.arange(S)
+        if pos.ndim == 1:
+            pos = pos[None]
+        qpos = pos.astype(jnp.float32)                     # [1 or B, S]
+        # ALiBi ≡ slope · k_pos along each row (the -slope·q_pos shift
+        # cancels in softmax); mask strictly-future keys
+        bias = slopes[None, :, None, None] * qpos[:, None, None, :]
+        causal = qpos[:, None, :, None] >= qpos[:, None, None, :]
+        bias = jnp.where(causal, bias, -1e30)
+        y = mha_reference(q, k, v, causal=False, bias=bias)
+        y = y.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
+        return nn.Dense(E, name="dense", **dense,
+                        **_tp_kwargs(cfg, "row"))(y)
+
+
+class BloomMLP(nn.Module):
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = dict(use_bias=True, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+        h = nn.Dense(cfg.intermediate_size, name="dense_h_to_4h", **dense,
+                     **_tp_kwargs(cfg, "col"))(x)
+        h = jax.nn.gelu(h.astype(jnp.float32), approximate=True).astype(
+            cfg.dtype)
+        return nn.Dense(cfg.hidden_size, name="dense_4h_to_h", **dense,
+                        **_tp_kwargs(cfg, "row"))(h)
+
+
+class BloomBlock(nn.Module):
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, x, positions, deterministic: bool = True,
+                 ragged_meta=None):
+        cfg = self.config
+        ln = dict(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                  param_dtype=jnp.float32)
+        h = nn.LayerNorm(name="input_layernorm", **ln)(x)
+        x = x + BloomAttention(cfg, name="self_attention")(
+            h, positions, deterministic, ragged_meta)
+        h = nn.LayerNorm(name="post_attention_layernorm", **ln)(x)
+        return x + BloomMLP(cfg, name="mlp")(h)
+
+
+class ScanBloomBlock(nn.Module):
+    config: BloomConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, positions = carry
+        x = BloomBlock(self.config, name="block")(x, positions,
+                                                  self.deterministic)
+        return (x, positions), None
+
+
+class BloomModel(nn.Module):
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, deterministic: bool = True,
+                 ragged_meta=None):
+        from deepspeed_tpu.models.gpt2 import _maybe_remat
+        from deepspeed_tpu.parallel.tensor_parallel import tp_embed_kwargs
+
+        cfg = self.config
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.arange(S)
+        ln = dict(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                  param_dtype=jnp.float32)
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype, name="word_embeddings",
+                     **tp_embed_kwargs(cfg.tensor_parallel))(input_ids)
+        x = nn.LayerNorm(name="word_embeddings_layernorm", **ln)(x)
+        if cfg.scan_layers:
+            block_cls = _maybe_remat(ScanBloomBlock, cfg)
+            vaxes = {"params": 0}
+            if cfg.decode:
+                vaxes["cache"] = 0
+            (x, _), _ = nn.scan(
+                block_cls,
+                variable_axes=vaxes,
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, deterministic, name="h")((x, positions), None)
+        else:
+            block_cls = _maybe_remat(BloomBlock, cfg)
+            for i in range(cfg.num_hidden_layers):
+                x = block_cls(cfg, name=f"h_{i}")(x, positions,
+                                                  deterministic,
+                                                  ragged_meta)
+        return nn.LayerNorm(name="ln_f", **ln)(x)
+
+
+class BloomForCausalLM(nn.Module):
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, deterministic: bool = True,
+                 ragged_meta=None):
+        cfg = self.config
+        x = BloomModel(cfg, name="transformer")(input_ids, positions,
+                                                deterministic, ragged_meta)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype, name="lm_head",
+                        **_tp_kwargs(cfg, "col"))(x)
+
+
+class BloomLMLoss(nn.Module):
+    """``module(batch) -> scalar`` next-token CE (engine contract)."""
+
+    config: BloomConfig
+
+    @nn.compact
+    def __call__(self, batch):
+        from deepspeed_tpu.models.gpt2 import next_token_loss
+
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        logits = BloomForCausalLM(self.config, name="lm")(input_ids)
+        return next_token_loss(logits, input_ids)
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(p.shape))
+               for p in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_token(cfg: BloomConfig,
+                    seq_len: Optional[int] = None) -> float:
+    E, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
+    Dh, H = cfg.head_dim, cfg.num_attention_heads
+    per_layer = 4 * E * H * Dh + 2 * E * I
+    n = L * per_layer + 2 * cfg.vocab_size * E
+    s = seq_len or cfg.max_position_embeddings
+    attn = 12 * L * H * Dh * s
+    return 6.0 * n + attn

@@ -41,10 +41,10 @@ PRESETS = {
                       num_attention_heads=32, num_key_value_heads=32,
                       max_position_embeddings=4096, rms_norm_eps=1e-5,
                       rope_theta=10000.0),
-    "phi3-small": dict(vocab_size=100352, hidden_size=4096,
-                       intermediate_size=14336, num_hidden_layers=32,
-                       num_attention_heads=32, num_key_value_heads=8,
-                       max_position_embeddings=8192, rope_theta=10000.0),
+    # NO phi3-small preset: microsoft/Phi-3-small is NOT Llama-shaped
+    # (blocksparse attention, gegelu MLP, qkv biases, tiktoken vocab) —
+    # serving its checkpoint through this module would produce silently
+    # wrong logits; get_config rejects it loudly instead.
     "phi3-medium": dict(vocab_size=32064, hidden_size=5120,
                         intermediate_size=17920, num_hidden_layers=40,
                         num_attention_heads=40, num_key_value_heads=10,
@@ -56,6 +56,12 @@ PRESETS = {
 
 
 def get_config(preset: str, **overrides) -> Phi3Config:
+    if preset == "phi3-small":
+        raise ValueError(
+            "Phi-3-small uses blocksparse attention, the gegelu MLP and "
+            "qkv biases — it is not Llama-shaped and this module would "
+            "compute wrong logits for its checkpoints; only phi3-mini / "
+            "phi3-medium are supported")
     kw = dict(PRESETS[preset])
     kw.update(overrides)
     kw.setdefault("dtype", jnp.bfloat16)

@@ -391,6 +391,115 @@ def _convert_falcon(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     return _nest(flat)
 
 
+def _gptj_rot_perm(H: int, Dh: int, rot: int) -> np.ndarray:
+    """Row permutation mapping GPT-J's INTERLEAVED rotary layout
+    (rotate-every-two: freq i acts on dims 2i, 2i+1) onto the half
+    (NeoX) layout our ``rotary_embedding`` computes (freq i acts on dims
+    i, i+rot/2).  Attention scores are invariant because q and k are
+    permuted identically."""
+    idx = []
+    for h in range(H):
+        base = h * Dh
+        idx += [base + j for j in range(0, rot, 2)]
+        idx += [base + j for j in range(1, rot, 2)]
+        idx += [base + j for j in range(rot, Dh)]
+    return np.asarray(idx)
+
+
+def _convert_gptj(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """GPT-J (reference ``module_inject/containers/gptj.py``): parallel
+    residual, partial interleaved rotary (q/k rows permuted into the
+    half layout — see ``_gptj_rot_perm``), biased GELU MLP + lm_head."""
+    sd = {k: v for k, v in sd.items()}
+    L, H, Dh = (cfg.num_hidden_layers, cfg.num_attention_heads,
+                cfg.head_dim)
+    perm = _gptj_rot_perm(H, Dh, int(cfg.rotary_dim))
+    layers = []
+    for i in range(L):
+        p = f"transformer.h.{i}."
+        layers.append({
+            "ln_1/scale": sd[p + "ln_1.weight"],
+            "ln_1/bias": sd[p + "ln_1.bias"],
+            "attn/q_proj/kernel": sd[p + "attn.q_proj.weight"][perm].T,
+            "attn/k_proj/kernel": sd[p + "attn.k_proj.weight"][perm].T,
+            "attn/v_proj/kernel": sd[p + "attn.v_proj.weight"].T,
+            "attn/o_proj/kernel": sd[p + "attn.out_proj.weight"].T,
+            "mlp/fc_in/kernel": sd[p + "mlp.fc_in.weight"].T,
+            "mlp/fc_in/bias": sd[p + "mlp.fc_in.bias"],
+            "mlp/fc_out/kernel": sd[p + "mlp.fc_out.weight"].T,
+            "mlp/fc_out/bias": sd[p + "mlp.fc_out.bias"],
+        })
+    flat = {
+        "transformer/wte/embedding": sd["transformer.wte.weight"],
+        "transformer/ln_f/scale": sd["transformer.ln_f.weight"],
+        "transformer/ln_f/bias": sd["transformer.ln_f.bias"],
+        "lm_head/kernel": sd["lm_head.weight"].T,
+        "lm_head/bias": sd["lm_head.bias"],
+    }
+    _place_layers(flat, layers, cfg, prefix="transformer/h")
+    return _nest(flat)
+
+
+def _convert_bloom(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """BLOOM (reference ``module_inject/containers/bloom.py``
+    BLOOMLayerPolicy): fused per-head ``[q_h; k_h; v_h]``
+    query_key_value split into q/k/v, biased everything, embedding
+    LayerNorm, lm_head tied to word_embeddings."""
+    sd = _strip_prefix(sd, "transformer.")
+    L, H, Dh = (cfg.num_hidden_layers, cfg.num_attention_heads,
+                cfg.head_dim)
+    layers = []
+    for i in range(L):
+        p = f"h.{i}."
+        w = sd[p + "self_attention.query_key_value.weight"]
+        b = sd[p + "self_attention.query_key_value.bias"]
+        w4 = w.reshape(H, 3, Dh, -1)
+        b3 = b.reshape(H, 3, Dh)
+        layer = {
+            "input_layernorm/scale": sd[p + "input_layernorm.weight"],
+            "input_layernorm/bias": sd[p + "input_layernorm.bias"],
+            "post_attention_layernorm/scale":
+                sd[p + "post_attention_layernorm.weight"],
+            "post_attention_layernorm/bias":
+                sd[p + "post_attention_layernorm.bias"],
+            "self_attention/q_proj/kernel":
+                w4[:, 0].reshape(H * Dh, -1).T,
+            "self_attention/q_proj/bias": b3[:, 0].reshape(-1),
+            "self_attention/k_proj/kernel":
+                w4[:, 1].reshape(H * Dh, -1).T,
+            "self_attention/k_proj/bias": b3[:, 1].reshape(-1),
+            "self_attention/v_proj/kernel":
+                w4[:, 2].reshape(H * Dh, -1).T,
+            "self_attention/v_proj/bias": b3[:, 2].reshape(-1),
+            "self_attention/dense/kernel":
+                sd[p + "self_attention.dense.weight"].T,
+            "self_attention/dense/bias":
+                sd[p + "self_attention.dense.bias"],
+            "mlp/dense_h_to_4h/kernel":
+                sd[p + "mlp.dense_h_to_4h.weight"].T,
+            "mlp/dense_h_to_4h/bias": sd[p + "mlp.dense_h_to_4h.bias"],
+            "mlp/dense_4h_to_h/kernel":
+                sd[p + "mlp.dense_4h_to_h.weight"].T,
+            "mlp/dense_4h_to_h/bias": sd[p + "mlp.dense_4h_to_h.bias"],
+        }
+        layers.append(layer)
+    flat = {
+        "transformer/word_embeddings/embedding":
+            sd["word_embeddings.weight"],
+        "transformer/word_embeddings_layernorm/scale":
+            sd["word_embeddings_layernorm.weight"],
+        "transformer/word_embeddings_layernorm/bias":
+            sd["word_embeddings_layernorm.bias"],
+        "transformer/ln_f/scale": sd["ln_f.weight"],
+        "transformer/ln_f/bias": sd["ln_f.bias"],
+        # tied head: HF ties lm_head to word_embeddings
+        "lm_head/kernel": (sd.get("lm_head.weight",
+                                  sd["word_embeddings.weight"])).T,
+    }
+    _place_layers(flat, layers, cfg, prefix="transformer/h")
+    return _nest(flat)
+
+
 def _convert_mixtral(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     L = cfg.num_hidden_layers
     E = cfg.num_local_experts
@@ -449,6 +558,11 @@ _CONVERTERS = {
     "FalconConfig": _convert_falcon,
     "OPTConfig": _convert_opt,
     "PhiConfig": _convert_phi,
+    # GPT-J: parallel residual + interleaved partial rotary (permuted on
+    # load); BLOOM: ALiBi + fused per-head qkv — the encoder/bloom/gptj
+    # class of the reference v1 injection zoo
+    "GPTJConfig": _convert_gptj,
+    "BloomConfig": _convert_bloom,
 }
 
 

@@ -44,6 +44,19 @@ from deepspeed_tpu.moe.sharded_moe import (moe_combine, moe_combine_gather,
 from deepspeed_tpu.utils.sharding import maybe_constrain as _maybe_constrain
 
 EXPERT_AXIS = "expert"
+
+# Engine-pinned topology for dispatch_impl='auto' (see
+# MoE._resolve_dispatch): set by DeepSpeedEngine at build time so the
+# resolution does not depend on WHEN flax traces the layer.
+_AUTO_PIN_TOPO = None
+
+
+def pin_auto_dispatch(topology) -> None:
+    """Pin the topology that ``dispatch_impl='auto'`` resolves against
+    when no live topology is installed at trace time.  The engine calls
+    this at build; pass ``None`` to clear (tests)."""
+    global _AUTO_PIN_TOPO
+    _AUTO_PIN_TOPO = topology
 # every mesh axis the flattened token dim may be sharded over (the engine's
 # batch spec: data x data_sub x expert, plus seq under sequence parallelism)
 TOKEN_AXES = ("data", "data_sub", "expert", "seq")
@@ -108,14 +121,17 @@ class MoE(nn.Module):
         import deepspeed_tpu.comm as dist
         from deepspeed_tpu.utils.logging import log_dist
 
-        topo = dist.peek_topology()
+        # engine-pinned topology first: DeepSpeedEngine resolves 'auto'
+        # at BUILD time via pin_auto_dispatch, so a model traced before
+        # the mesh installs (or after a transient mesh teardown) cannot
+        # silently bake in the single-device choice.  A live topology
+        # still wins — it is the mesh this trace actually runs under.
+        topo = dist.peek_topology() or _AUTO_PIN_TOPO
         if topo is not None and topo.mesh.size > 1:
             impl = ("alltoall" if self._can_alltoall(topo, n_tokens)
                     else "einsum")
         else:
             impl = "sorted"
-        # 'auto' binds at TRACE time: a model traced before the mesh is
-        # installed bakes in the single-device choice — make it visible
         log_dist(f"MoE dispatch_impl=auto -> {impl!r} "
                  f"(topology={'none' if topo is None else topo.mesh.shape})",
                  ranks=[0])
@@ -191,11 +207,18 @@ class MoE(nn.Module):
         (Megatron TP) and inserts their psum.  Expert weights enter
         expert-sharded (any ZeRO sharding is gathered at the constraint
         below — the same per-layer gather ZeRO-3 implies)."""
+        import deepspeed_tpu.comm as dist
         from deepspeed_tpu.sequence.layer import resolve_mesh
 
         cfg = self
         E = cfg.num_experts
-        mesh = resolve_mesh(None, EXPERT_AXIS)
+        pinned = _AUTO_PIN_TOPO
+        if dist.peek_topology() is None and pinned is not None:
+            # traced without a live topology: the engine-pinned mesh is
+            # the one this program will run under
+            mesh = pinned.mesh
+        else:
+            mesh = resolve_mesh(None, EXPERT_AXIS)
         token_axes = tuple(a for a in TOKEN_AXES
                            if a in mesh.axis_names and
                            int(mesh.shape.get(a, 1)) > 1)

@@ -156,6 +156,12 @@ class DeepSpeedEngine:
         self.config = config
         self.topology = topology
         self.mesh = topology.mesh
+        # resolve MoE dispatch_impl='auto' against THIS mesh no matter
+        # when flax traces the layers (a trace issued before/without the
+        # live topology would otherwise bake in the single-device choice)
+        from deepspeed_tpu.moe.layer import pin_auto_dispatch
+
+        pin_auto_dispatch(topology)
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
@@ -338,32 +344,52 @@ class DeepSpeedEngine:
                                             param_shardings)
         self._grad_spec_tree = self.plan.grad_specs(params, self.base_specs)
 
+        # streamed host-moment tier: offload_optimizer=cpu TOGETHER with
+        # offload_param=cpu is the "model far beyond HBM" configuration —
+        # the fused single-program path materializes every gradient
+        # before the first moment write there (measured 41G of HBM at
+        # 7B), so Adam moments stream through the device bucket-by-bucket
+        # from pinned host memory instead (reference CPU-Adam +
+        # offload_optimizer semantics, zero/stage3.py)
+        _p_cfg = dict(opt_cfg.params) if opt_cfg else {}
+        _name = (self.optimizer_name or "adamw").lower()
+        _adam_family = _name in ("adam", "adamw", "fusedadam")
+        # mirror exactly what the device-resident transform the swapped
+        # tiers replace would have done: the fused Pallas path honors
+        # adam_w_mode (default: decoupled unless plain "Adam" —
+        # optimizers.py:84), while the optax fallback always decouples
+        # (documented divergence) regardless of the flag
+        if is_fused_optimizer(_name, _p_cfg):
+            _adam_w = bool(_p_cfg.get("adam_w_mode", _name != "adam"))
+        else:
+            _adam_w = True
+        want_opt_stream = (self.offload_optimizer and self.offload_param
+                           and _adam_family
+                           and self._onebit_axes is None
+                           and jax.process_count() == 1)
         if want_opt_nvme:
             from deepspeed_tpu.runtime.swap_tensor import NvmeOptimizerSwapper
 
-            p_cfg = dict(opt_cfg.params) if opt_cfg else {}
-            # toggling offload_optimizer.device=nvme must not change the
-            # weight-decay math, so mirror exactly what the device-resident
-            # transform this swapper replaces would have done: the fused
-            # Pallas path honors adam_w_mode (default: decoupled unless
-            # plain "Adam" — optimizers.py:84), while the optax fallback
-            # always decouples (documented divergence) regardless of the
-            # flag
-            _name = (self.optimizer_name or "adamw").lower()
-            if is_fused_optimizer(_name, p_cfg):
-                _adam_w = bool(p_cfg.get("adam_w_mode", _name != "adam"))
-            else:
-                _adam_w = True
             self.nvme_swapper = NvmeOptimizerSwapper(
                 offl_o.nvme_path, params,
-                betas=tuple(p_cfg.get("betas", (0.9, 0.999))),
-                eps=float(p_cfg.get("eps", 1e-8)),
-                weight_decay=float(p_cfg.get("weight_decay", 0.0)),
+                betas=tuple(_p_cfg.get("betas", (0.9, 0.999))),
+                eps=float(_p_cfg.get("eps", 1e-8)),
+                weight_decay=float(_p_cfg.get("weight_decay", 0.0)),
                 adam_w_mode=_adam_w,
                 aio_block_size=config.aio.block_size,
                 aio_thread_count=config.aio.thread_count,
                 aio_queue_depth=config.aio.queue_depth,
                 aio_use_odirect=config.aio.use_odirect)
+            opt_state, opt_shardings, opt_specs = (), (), None
+        elif want_opt_stream:
+            from deepspeed_tpu.runtime.swap_tensor import HostMomentSwapper
+
+            self.nvme_swapper = HostMomentSwapper(
+                params,
+                betas=tuple(_p_cfg.get("betas", (0.9, 0.999))),
+                eps=float(_p_cfg.get("eps", 1e-8)),
+                weight_decay=float(_p_cfg.get("weight_decay", 0.0)),
+                adam_w_mode=_adam_w)
             opt_state, opt_shardings, opt_specs = (), (), None
         elif self._onebit_axes is not None:
             opt_state, opt_shardings = self._init_onebit_opt_state(params)
@@ -377,14 +403,14 @@ class DeepSpeedEngine:
             logger.warning("offload_optimizer is not supported on the "
                            "1-bit compressed path; keeping state on device")
             self.offload_optimizer = False
-        if self.offload_optimizer:
+        if self.offload_optimizer and self.nvme_swapper is None:
             dev_opt_shardings = opt_shardings
             opt_shardings = to_host(opt_shardings)
             self._fetch_opt = (
                 lambda o, _s=dev_opt_shardings: jax.device_put(o, _s))
             log_dist("ZeRO-Offload: optimizer state resident in host "
                      "memory (pinned_host)", ranks=[0])
-        if self._onebit_axes is None and not want_opt_nvme:
+        if self._onebit_axes is None and self.nvme_swapper is None:
             opt_state = jax.jit(self.tx.init,
                                 out_shardings=opt_shardings)(params)
 
@@ -433,6 +459,7 @@ class DeepSpeedEngine:
         self._train_step_fn = None
         self._eval_step_fn = None
         self._grad_step_fn = None
+        self._nvme_grad_step_fn = None
         self._apply_step_fn = None
         self._pending_grads = None
         self._pending_loss = None
@@ -901,7 +928,8 @@ class DeepSpeedEngine:
 
         return jax.jit(eval_step, out_shardings=self._repl())
 
-    def _build_grad_step(self, host_grads: bool = False):
+    def _build_grad_step(self, host_grads: bool = False,
+                         with_gmetrics: bool = False):
         """Imperative-mode micro step: grads for ONE micro-batch.
 
         ``host_grads=True`` (ZeRO-Infinity: offload_param + NVMe
@@ -933,6 +961,18 @@ class DeepSpeedEngine:
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(jnp.float32), grads)
             grads = constrain_tree(grads, grad_spec_tree, mesh)
+            if with_gmetrics:
+                # overflow/norm folded into the SAME program, computed
+                # while the grads are still on device — the NVMe tier
+                # would otherwise re-stream the full host grad tree (or
+                # device_get two scalars per leaf) just for these two
+                # reductions
+                finite = jnp.array(True)
+                sumsq = jnp.float32(0.0)
+                for g in jax.tree_util.tree_leaves(grads):
+                    finite &= jnp.isfinite(g).all()
+                    sumsq += jnp.sum(jnp.square(g.astype(jnp.float32)))
+                return loss_s / scale, grads, finite, sumsq
             return loss_s / scale, grads
 
         if not host_grads:
@@ -948,7 +988,8 @@ class DeepSpeedEngine:
             # trades overlap for fitting (this tier is streaming-bound
             # anyway)
             opts = {"xla_tpu_enable_latency_hiding_scheduler": "false"}
-        return jax.jit(grad_step, out_shardings=(None, host),
+        outs = (None, host, None, None) if with_gmetrics else (None, host)
+        return jax.jit(grad_step, out_shardings=outs,
                        compiler_options=opts)
 
     def _build_apply_step(self):
@@ -1016,16 +1057,26 @@ class DeepSpeedEngine:
         ``pipelined_optimizer_swapper`` semantics; see
         ``runtime/swap_tensor.py``)."""
         host_grads = bool(self.offload_param)
-        if self._grad_step_fn is None:
-            self._grad_step_fn = self._build_grad_step(
-                host_grads=host_grads)
+        # gas==1: overflow/norm fold into the grad-step program for free
+        # (the metrics of the single micro ARE the final metrics).
+        # gas>1 needs the norm of the SUM — fused per-micro reductions
+        # would be paid and discarded, so skip them there.
+        fused_metrics = self.gas == 1
+        if getattr(self, "_nvme_grad_step_fn", None) is None:
+            self._nvme_grad_step_fn = self._build_grad_step(
+                host_grads=host_grads, with_gmetrics=fused_metrics)
         state = self.state
         rng = state.rng
         loss_sum, grads = None, None
+        gmetrics = None
         for i in range(self.gas):
             mb = jax.tree_util.tree_map(lambda x: x[i], gbatch)
             rng, sub = jax.random.split(rng)
-            loss, g = self._grad_step_fn(state, mb, sub)
+            if fused_metrics:
+                loss, g, f, s2 = self._nvme_grad_step_fn(state, mb, sub)
+                gmetrics = (~f, jnp.sqrt(s2))
+            else:
+                loss, g = self._nvme_grad_step_fn(state, mb, sub)
             loss_sum = loss if loss_sum is None else loss_sum + loss
             if grads is None:
                 grads = g
@@ -1033,8 +1084,8 @@ class DeepSpeedEngine:
                 grads = self._host_tree_add(grads, g)
             else:
                 grads = jax.tree_util.tree_map(jnp.add, grads, g)
-        new_state, metrics = self._nvme_apply_grads(grads, lr, rng,
-                                                    leafwise=host_grads)
+        new_state, metrics = self._nvme_apply_grads(
+            grads, lr, rng, leafwise=host_grads, gmetrics=gmetrics)
         metrics["loss"] = loss_sum / self.gas
         return new_state, metrics
 
@@ -1059,28 +1110,37 @@ class DeepSpeedEngine:
             out.append(self._host_add_fn[key](x, y))
         return jax.tree_util.tree_unflatten(tree, out)
 
-    def _nvme_apply_grads(self, grads, lr, rng, leafwise: bool = False):
-        """Overflow check + loss-scale update on device, then the per-leaf
-        swapped Adam update (skipped entirely on overflow — the moments on
-        disk are the authoritative state and simply stay put).
+    def _nvme_apply_grads(self, grads, lr, rng, leafwise: bool = False,
+                          gmetrics=None):
+        """Overflow check + loss-scale update on device, then the
+        bucketed/leafwise swapped Adam update (skipped entirely on
+        overflow — the moments on disk are the authoritative state and
+        simply stay put).
 
         ``leafwise``: grads live in pinned host memory — compute the
         overflow/norm reductions one leaf at a time so HBM holds one
-        leaf, not the tree."""
+        leaf, not the tree.  ``gmetrics``: (overflow, norm_raw) already
+        computed (fused into the grad step) — skips the reduction pass
+        entirely."""
         state = self.state
-        if leafwise:
+        if gmetrics is not None:
+            overflow, norm_raw = gmetrics
+        elif leafwise:
             if getattr(self, "_nvme_leaf_metric_fn", None) is None:
+                # scalar accumulation stays ON DEVICE across the loop —
+                # per-leaf blocking transfers turn this into one
+                # round-trip per leaf (minutes at 7B through a remote
+                # runtime); lazy chaining is one blocking read total
                 self._nvme_leaf_metric_fn = jax.jit(
-                    lambda g: (jnp.isfinite(g).all(),
-                               jnp.sum(jnp.square(g.astype(jnp.float32)))))
-            finite = True
-            sumsq = 0.0
+                    lambda g, fin, ss: (
+                        fin & jnp.isfinite(g).all(),
+                        ss + jnp.sum(jnp.square(g.astype(jnp.float32)))))
+            fin = jnp.array(True)
+            ss = jnp.float32(0.0)
             for leaf in jax.tree_util.tree_leaves(grads):
-                f, s2 = self._nvme_leaf_metric_fn(leaf)
-                finite = finite and bool(jax.device_get(f))
-                sumsq += float(jax.device_get(s2))
-            overflow = jnp.asarray(not finite)
-            norm_raw = jnp.asarray(np.sqrt(sumsq), jnp.float32)
+                fin, ss = self._nvme_leaf_metric_fn(leaf, fin, ss)
+            overflow = ~fin
+            norm_raw = jnp.sqrt(ss)
         else:
             if getattr(self, "_nvme_metrics_fn", None) is None:
                 self._nvme_metrics_fn = jax.jit(
@@ -1278,10 +1338,11 @@ class DeepSpeedEngine:
         if self._train_step_fn is None:
             # NVMe-offloaded step: no single fused program — cost the
             # fwd+bwd micro step (the dominant FLOPs; the optimizer apply
-            # is a host-side leaf loop with no jaxpr)
-            assert self._grad_step_fn is not None
+            # is a host-side bucket stream with no jaxpr)
+            gfn = self._nvme_grad_step_fn or self._grad_step_fn
+            assert gfn is not None
             mb = jax.tree_util.tree_map(lambda x: x[0], gbatch)
-            prof = FlopsProfiler(self._grad_step_fn, ds_engine=self)
+            prof = FlopsProfiler(gfn, ds_engine=self)
             prof.start_profile()
             prof.profile(self.state, mb, self.state.rng,
                          params=self.state.params)
@@ -1446,7 +1507,9 @@ class DeepSpeedEngine:
                            "space; no-op")
             return
         include = include or ("optimizer",)
-        to_host = jax.memory.TransferToMemoryKind("pinned_host")
+        from deepspeed_tpu.utils.sharding import memory_space
+
+        to_host = memory_space("pinned_host")
 
         def host_kind(shardings):
             return jax.tree_util.tree_map(
@@ -1487,7 +1550,9 @@ class DeepSpeedEngine:
         ``engine.reload_states:3871``)."""
         if self.mesh.devices.flat[0].platform == "cpu":
             return
-        to_dev = jax.memory.TransferToMemoryKind("device")
+        from deepspeed_tpu.utils.sharding import memory_space
+
+        to_dev = memory_space("device")
 
         def dev_kind(shardings):
             return jax.tree_util.tree_map(
@@ -1510,6 +1575,7 @@ class DeepSpeedEngine:
         self._train_step_fn = None
         self._eval_step_fn = None
         self._grad_step_fn = None
+        self._nvme_grad_step_fn = None
         self._apply_step_fn = None
 
     def save_16bit_model(self, save_dir: str,

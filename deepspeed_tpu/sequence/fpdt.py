@@ -36,6 +36,7 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.ops.flash_attention import _blockwise_fwd
 from deepspeed_tpu.parallel.topology import SEQ_AXIS
 from deepspeed_tpu.sequence.layer import resolve_mesh
+from deepspeed_tpu.utils.sharding import memory_space
 
 
 # ---------------------------------------------------------------------------
@@ -183,11 +184,11 @@ def _host_handles(mesh: Optional[Mesh]):
 
     def park(x):
         return jax.device_put(
-            x, jax.memory.TransferToMemoryKind("pinned_host"))
+            x, memory_space("pinned_host"))
 
     def fetch(x):
         return jax.device_put(
-            x, jax.memory.TransferToMemoryKind("device"))
+            x, memory_space("device"))
 
     return park, fetch
 

@@ -1,0 +1,62 @@
+"""Experiment: 7B ZeRO-Offload (params + optimizer in pinned_host) on
+one chip — does the single fused train step compile and fit, and what
+does a full measured step cost?  (Feeds the bench_infinity redesign.)"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.models.llama import LlamaLMLoss, count_params, \
+    flops_per_token, get_config
+
+size = sys.argv[1] if len(sys.argv) > 1 else "llama2-7b"
+micro, seq = 1, 1024
+cfg = get_config(size, max_position_embeddings=seq, dtype=jnp.bfloat16,
+                 remat=True, remat_policy="full", scan_layers=False,
+                 use_flash_attention=True)
+topo = dist.initialize_mesh()
+ds = {
+    "train_batch_size": micro,
+    "train_micro_batch_size_per_gpu": micro,
+    "bf16": {"enabled": True, "master_weights": False},
+    "zero_optimization": {
+        "stage": 3,
+        "offload_param": {"device": "cpu", "pin_memory": True},
+        "offload_optimizer": {"device": "cpu", "pin_memory": True},
+    },
+    "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+    "steps_per_print": 1000000,
+}
+import numpy as np
+
+rng = np.random.default_rng(0)
+batch = {"input_ids": rng.integers(0, cfg.vocab_size,
+                                   (micro, seq)).astype("int32")}
+t0 = time.time()
+engine, *_ = deepspeed_tpu.initialize(
+    model=LlamaLMLoss(cfg), config=ds, topology=topo,
+    example_batch=batch, rng=jax.random.PRNGKey(0))
+print(f"init {time.time() - t0:.1f}s params={count_params(engine.state.params)}",
+      flush=True)
+t0 = time.time()
+loss = engine.train_batch(batch=batch)
+print(f"compile+step1 {time.time() - t0:.1f}s loss={float(loss):.3f}",
+      flush=True)
+times = []
+for i in range(2):
+    t0 = time.time()
+    loss = engine.train_batch(batch=batch)
+    jax.block_until_ready(loss)
+    times.append(time.time() - t0)
+    print(f"step{i + 2} {times[-1]:.2f}s loss={float(loss):.3f}", flush=True)
+step_s = min(times)
+fl = flops_per_token(cfg, seq) * micro * seq / step_s / 1e12
+print(json.dumps({"step_s": round(step_s, 2),
+                  "tflops_6N": round(fl, 2)}))

@@ -1,0 +1,91 @@
+"""Managed-cluster env derivation (reference comm.py:694 mpi_discovery +
+AML/AWS-SM patching) — pure-function coverage over fabricated
+environments."""
+from deepspeed_tpu.launcher.env_discovery import (discover_distributed_env,
+                                                  first_slurm_host)
+
+
+def test_nothing_detected_in_plain_env():
+    assert discover_distributed_env({}) is None
+    # single-process launches stay single-process
+    assert discover_distributed_env(
+        {"SLURM_PROCID": "0", "SLURM_NTASKS": "1",
+         "SLURM_JOB_NODELIST": "n1"}) is None
+    assert discover_distributed_env(
+        {"OMPI_COMM_WORLD_RANK": "0", "OMPI_COMM_WORLD_SIZE": "1"}) is None
+
+
+def test_slurm_derivation():
+    env = {"SLURM_PROCID": "5", "SLURM_NTASKS": "8",
+           "SLURM_LOCALID": "1",
+           "SLURM_JOB_NODELIST": "tpu-host[001-004]"}
+    got = discover_distributed_env(env)
+    assert got == {"coordinator_address": "tpu-host001:29500",
+                   "num_processes": 8, "process_id": 5,
+                   "local_rank": 1, "source": "slurm"}
+    # explicit MASTER_ADDR/PORT win over nodelist parsing
+    env.update(MASTER_ADDR="10.0.0.9", MASTER_PORT="12345")
+    got = discover_distributed_env(env)
+    assert got["coordinator_address"] == "10.0.0.9:12345"
+
+
+def test_slurm_nodelist_forms():
+    assert first_slurm_host("n1") == "n1"
+    assert first_slurm_host("n1,n2") == "n1"
+    assert first_slurm_host("gpu[3,5]") == "gpu3"
+    assert first_slurm_host("gpu[07-12]") == "gpu07"
+    assert first_slurm_host("a[1-2],b[3-4]") == "a1"
+
+
+def test_openmpi_derivation():
+    env = {"OMPI_COMM_WORLD_RANK": "3", "OMPI_COMM_WORLD_SIZE": "4",
+           "OMPI_COMM_WORLD_LOCAL_RANK": "3",
+           "MASTER_ADDR": "head-node"}
+    got = discover_distributed_env(env)
+    assert got == {"coordinator_address": "head-node:29500",
+                   "num_processes": 4, "process_id": 3,
+                   "local_rank": 3, "source": "openmpi"}
+    # no coordinator derivable -> no half-configured bootstrap
+    assert discover_distributed_env(
+        {"OMPI_COMM_WORLD_RANK": "3",
+         "OMPI_COMM_WORLD_SIZE": "4"}) is None
+
+
+def test_openmpi_azureml_master_node():
+    env = {"OMPI_COMM_WORLD_RANK": "1", "OMPI_COMM_WORLD_SIZE": "2",
+           "AZUREML_EXPERIMENT_ID": "x",
+           "AZ_BATCH_MASTER_NODE": "10.1.2.3:6105"}
+    got = discover_distributed_env(env)
+    assert got["coordinator_address"] == "10.1.2.3:6105"
+    assert got["source"] == "openmpi"
+
+
+def test_openmpi_sagemaker_hosts():
+    env = {"OMPI_COMM_WORLD_RANK": "1", "OMPI_COMM_WORLD_SIZE": "2",
+           "SM_TRAINING_ENV": "{}",
+           "SM_HOSTS": '["algo-2", "algo-1"]'}
+    got = discover_distributed_env(env)
+    assert got["coordinator_address"] == "algo-1:29500"
+
+
+def test_pmi_and_torchrun():
+    got = discover_distributed_env(
+        {"PMI_RANK": "2", "PMI_SIZE": "4", "MASTER_ADDR": "m"})
+    assert (got["source"], got["process_id"], got["num_processes"]) == \
+        ("pmi", 2, 4)
+    got = discover_distributed_env(
+        {"RANK": "1", "WORLD_SIZE": "2", "MASTER_ADDR": "m",
+         "MASTER_PORT": "777", "LOCAL_RANK": "1"})
+    assert got == {"coordinator_address": "m:777", "num_processes": 2,
+                   "process_id": 1, "local_rank": 1,
+                   "source": "torchrun"}
+
+
+def test_cloud_tpu_pod_is_auto():
+    got = discover_distributed_env({"TPU_WORKER_HOSTNAMES": "a,b",
+                                    "TPU_WORKER_ID": "0"})
+    assert got == {"auto": True, "source": "cloud-tpu"}
+    # a lone TPU VM also carries TPU_WORKER_ID=0 — no coordinator there
+    assert discover_distributed_env({"TPU_WORKER_ID": "0"}) is None
+    assert discover_distributed_env(
+        {"TPU_WORKER_HOSTNAMES": "solo", "TPU_WORKER_ID": "0"}) is None

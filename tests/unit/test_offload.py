@@ -171,10 +171,16 @@ def test_nvme_optimizer_parity(tmp_path, devices):
     np.testing.assert_allclose(mu, m_disk, atol=1e-6)
     np.testing.assert_allclose(nu, v_disk, atol=1e-8)
     assert int(adam_state.count) == nvme.nvme_swapper.count == 3
-    # moments really live on disk, one file per addressable shard
+    # moments really live on disk: flat bucket files in the bucketed
+    # (single-process) stream, one file per addressable shard leafwise
     assert nvme.nvme_swapper._initialized
-    k0, tag0 = sorted(nvme.nvme_swapper._initialized)[0]
-    assert os.path.getsize(nvme.nvme_swapper._shard_fname(k0, tag0)) > 0
+    if nvme.nvme_swapper._buckets is not None:
+        assert nvme.nvme_swapper._bucket_ready
+        kb0 = sorted(nvme.nvme_swapper._bucket_ready)[0]
+        assert os.path.getsize(nvme.nvme_swapper._bucket_fname(kb0)) > 0
+    else:
+        k0, tag0 = sorted(nvme.nvme_swapper._initialized)[0]
+        assert os.path.getsize(nvme.nvme_swapper._shard_fname(k0, tag0)) > 0
 
 
 def test_nvme_checkpoint_roundtrip(tmp_path, devices):
@@ -232,12 +238,25 @@ def test_nvme_bf16_moments_stay_fp32(tmp_path, devices):
     assert dt == np.float32
     leaf = next(lf for kp, lf in jax.tree_util.tree_flatten_with_path(
         eng.state.params)[0] if path_str(kp) == key)
-    # the leaf's unique shard files together hold 2x fp32 of the leaf
-    # (replicated leaves have one full-extent shard file)
-    shard_bytes = sum(
-        os.path.getsize(eng.nvme_swapper._shard_fname(k, t))
-        for k, t in eng.nvme_swapper._initialized if k == key)
-    assert shard_bytes == 2 * 4 * int(np.prod(shape))
+    # on disk the leaf owns 2x fp32 of its extent: an [m; v] range inside
+    # a flat bucket file (bucketed stream) or its own shard files
+    if eng.nvme_swapper._buckets is not None:
+        kb, off, _tag, n_it, n_tot = eng.nvme_swapper._item_loc[key]
+        assert n_it == int(np.prod(shape))
+        bucket_file = eng.nvme_swapper._bucket_fname(kb)
+        # the bucket file physically holds 2 x n_total fp32 and the
+        # item's m/v ranges are finite fp32 (a bf16-sized layout or a
+        # truncated write would fail both)
+        assert os.path.getsize(bucket_file) == 2 * 4 * n_tot
+        raw = np.fromfile(bucket_file, dtype=np.float32)
+        m_disk = raw[off:off + n_it]
+        v_disk = raw[n_tot + off:n_tot + off + n_it]
+        assert np.isfinite(m_disk).all() and (v_disk >= 0).all()
+    else:
+        shard_bytes = sum(
+            os.path.getsize(eng.nvme_swapper._shard_fname(k, t))
+            for k, t in eng.nvme_swapper._initialized if k == key)
+        assert shard_bytes == 2 * 4 * int(np.prod(shape))
     m_dev, v_dev = eng.nvme_swapper.finish_read(
         key, leaf, eng.nvme_swapper.start_read(key, leaf))
     m = np.asarray(jax.device_get(m_dev))
@@ -306,3 +325,73 @@ def test_nvme_flops_profiler_fwd_bwd_only(tmp_path, capsys, devices):
     eng.train_batch(batch=random_tokens(8))
     out = capsys.readouterr().out
     assert "flops" in out.lower()
+
+
+def test_nvme_leafwise_fallback_then_bucketed_keeps_moments(tmp_path,
+                                                           devices):
+    """A leafwise fallback apply (subset tree) BEFORE any bucketed step
+    must not lose its moments when the next full-tree apply takes the
+    bucketed stream (write() marks the item files dirty so they fold
+    into the bucket files)."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.swap_tensor import NvmeOptimizerSwapper
+
+    topo = dist.initialize_mesh(dp=1, devices=jax.devices()[:1])
+    params = {"a": jnp.ones((8, 4), jnp.float32),
+              "b": jnp.full((4,), 2.0, jnp.float32)}
+    params = jax.device_put(params)
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    sw = NvmeOptimizerSwapper(str(tmp_path), params)
+    assert sw._buckets is not None
+    # subset call -> leafwise fallback writes item files
+    sw.apply({"a": params["a"]}, {"a": grads["a"]}, lr=1e-2, gscale=1.0)
+    key = sorted(sw._meta)[0]
+    assert sw._initialized
+    # full-tree call -> bucketed stream must fold the item files back in
+    new = sw.apply(params, grads, lr=1e-2, gscale=1.0)
+    leaf = params["a"]
+    m, v = sw.finish_read("a", leaf, sw.start_read("a", leaf))
+    m = np.asarray(jax.device_get(m))
+    # two applies with all-ones grads: m = 0.1*1 then 0.9*0.1 + 0.1*1
+    np.testing.assert_allclose(m, np.full(leaf.shape, 0.19), rtol=1e-5)
+    assert sw.count == 2
+    sw.close()
+
+
+def test_fused_checkpoint_resumes_into_swapped_tier(tmp_path, devices):
+    """A checkpoint saved with device-resident (fused) optimizer state
+    resumes under the NVMe-swapped tier with its Adam moments INGESTED,
+    not silently zeroed (tier-portable resumes, both directions)."""
+    topo = dist.initialize_mesh(dp=8)
+    dev_cfg = _nvme_cfg(tmp_path / "nvme", gas=1)
+    del dev_cfg["zero_optimization"]["offload_optimizer"]
+    dev, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=dev_cfg, topology=topo,
+        example_batch=random_tokens(8), rng=jax.random.PRNGKey(0))
+    for s in range(2):
+        dev.train_batch(batch=random_tokens(8, seed=s))
+    dev.save_checkpoint(str(tmp_path / "ck"), tag="t")
+    adam_state = jax.device_get(dev.state.opt_state)[0]
+
+    nvme, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=_nvme_cfg(tmp_path / "nvme", gas=1),
+        topology=topo, example_batch=random_tokens(8),
+        rng=jax.random.PRNGKey(0))
+    nvme.load_checkpoint(str(tmp_path / "ck"), tag="t")
+    assert nvme.nvme_swapper.count == int(adam_state.count) == 2
+    from deepspeed_tpu.checkpoint.sharded import path_str
+
+    key = "params/transformer/h/block/attn/c_attn/bias"
+    leaf = next(lf for kp, lf in jax.tree_util.tree_flatten_with_path(
+        nvme.state.params)[0] if path_str(kp) == key)
+    m_dev, _v = nvme.nvme_swapper.finish_read(
+        key, leaf, nvme.nvme_swapper.start_read(key, leaf))
+    mu = np.asarray(adam_state.mu["params"]["transformer"]["h"]["block"]
+                    ["attn"]["c_attn"]["bias"])
+    np.testing.assert_allclose(np.asarray(jax.device_get(m_dev)), mu,
+                               atol=1e-7)
+    # and training continues finitely from the ingested moments
+    l2 = float(jax.device_get(nvme.train_batch(
+        batch=random_tokens(8, seed=7))))
+    assert np.isfinite(l2)

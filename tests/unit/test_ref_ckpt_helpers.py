@@ -50,10 +50,31 @@ def hf_named_tensors(cfg, seed=0) -> Dict[str, np.ndarray]:
     return sd
 
 
+_ROW_PARALLEL = ("self_attn.o_proj.weight", "mlp.down_proj.weight")
+
+
+def tp_slice_state_dict(sd: Dict[str, np.ndarray], mp: int,
+                        rank: int) -> Dict[str, np.ndarray]:
+    """Megatron-style TP slice of a full HF state dict: column-parallel
+    2-D weights (qkv/gate/up/embed/lm_head) shard dim 0, row-parallel
+    projections (o_proj/down_proj) shard dim 1, everything else
+    replicates."""
+    out = {}
+    for n, w in sd.items():
+        if w.ndim == 2 and any(n.endswith(r) for r in _ROW_PARALLEL):
+            out[n] = np.split(w, mp, axis=1)[rank]
+        elif w.ndim == 2:
+            out[n] = np.split(w, mp, axis=0)[rank]
+        else:
+            out[n] = w
+    return out
+
+
 def write_reference_zero_checkpoint(ckpt_dir: str,
                                     sd: Dict[str, np.ndarray],
                                     world: int = 2, tag: str = "global_step10",
-                                    stage3: bool = False) -> str:
+                                    stage3: bool = False,
+                                    mp: int = 1) -> str:
     """Fabricate the reference's on-disk layout: ``latest`` tag file,
     ``mp_rank_00_model_states.pt`` (param_shapes + 16-bit module), and
     per-dp-rank ``zero_pp_rank_*_optim_states.pt`` flat fp32 partitions
@@ -66,49 +87,56 @@ def write_reference_zero_checkpoint(ckpt_dir: str,
     with open(os.path.join(ckpt_dir, "latest"), "w") as f:
         f.write(tag)
 
-    names = list(sd)
-    param_shapes = {n: torch.Size(sd[n].shape) for n in names}
-    model_state = {"module": {("module." + n): torch.from_numpy(sd[n]).to(
-        torch.bfloat16) for n in names},
-        "param_shapes": [param_shapes]}
-    if stage3:
-        # real stage-3 runs write per-DP-rank model states and NO plain
-        # mp_rank file (each rank's param_shapes are identical)
-        for rk in range(world):
+    for mpr in range(mp):
+        sd_mp = tp_slice_state_dict(sd, mp, mpr) if mp > 1 else sd
+        names = list(sd_mp)
+        param_shapes = {n: torch.Size(sd_mp[n].shape) for n in names}
+        model_state = {"module": {
+            ("module." + n): torch.from_numpy(sd_mp[n]).to(torch.bfloat16)
+            for n in names},
+            "param_shapes": [param_shapes]}
+        if stage3:
+            # real stage-3 runs write per-DP-rank model states and NO
+            # plain mp_rank file (each rank's param_shapes are identical)
+            for rk in range(world):
+                torch.save(model_state, os.path.join(
+                    d, f"zero_pp_rank_{rk}_mp_rank_{mpr:02d}"
+                       "_model_states.pt"))
+        else:
             torch.save(model_state, os.path.join(
-                d, f"zero_pp_rank_{rk}_mp_rank_00_model_states.pt"))
-    else:
-        torch.save(model_state,
-                   os.path.join(d, "mp_rank_00_model_states.pt"))
+                d, f"mp_rank_{mpr:02d}_model_states.pt"))
 
-    if stage3:
-        # each param flattened, padded to world, split round-robin; each
-        # rank's flat group concatenates its slice of EVERY param
-        rank_parts = [[] for _ in range(world)]
-        for n in names:
-            flat = sd[n].reshape(-1)
+        if stage3:
+            # each param flattened, padded to world, split round-robin;
+            # each rank's flat group concatenates its slice of EVERY param
+            rank_parts = [[] for _ in range(world)]
+            for n in names:
+                flat = sd_mp[n].reshape(-1)
+                per = -(-flat.size // world)
+                padded = np.zeros((per * world,), np.float32)
+                padded[:flat.size] = flat
+                for rk in range(world):
+                    rank_parts[rk].append(padded[rk * per:(rk + 1) * per])
+            for rk in range(world):
+                torch.save(
+                    {"optimizer_state_dict": {
+                        "fp32_flat_groups": [torch.from_numpy(
+                            np.concatenate(rank_parts[rk]))]}},
+                    os.path.join(
+                        d, f"zero_pp_rank_{rk}_mp_rank_{mpr:02d}"
+                           "_optim_states.pt"))
+        else:
+            flat = np.concatenate([sd_mp[n].reshape(-1) for n in names])
             per = -(-flat.size // world)
             padded = np.zeros((per * world,), np.float32)
             padded[:flat.size] = flat
             for rk in range(world):
-                rank_parts[rk].append(padded[rk * per:(rk + 1) * per])
-        for rk in range(world):
-            torch.save(
-                {"optimizer_state_dict": {
-                    "fp32_flat_groups":
-                        [torch.from_numpy(np.concatenate(rank_parts[rk]))]}},
-                os.path.join(
-                    d, f"zero_pp_rank_{rk}_mp_rank_00_optim_states.pt"))
-    else:
-        flat = np.concatenate([sd[n].reshape(-1) for n in names])
-        per = -(-flat.size // world)
-        padded = np.zeros((per * world,), np.float32)
-        padded[:flat.size] = flat
-        for rk in range(world):
-            torch.save(
-                {"optimizer_state_dict": {
-                    "single_partition_of_fp32_groups":
-                        [torch.from_numpy(padded[rk * per:(rk + 1) * per])]}},
-                os.path.join(
-                    d, f"zero_pp_rank_{rk}_mp_rank_00_optim_states.pt"))
+                torch.save(
+                    {"optimizer_state_dict": {
+                        "single_partition_of_fp32_groups":
+                            [torch.from_numpy(
+                                padded[rk * per:(rk + 1) * per])]}},
+                    os.path.join(
+                        d, f"zero_pp_rank_{rk}_mp_rank_{mpr:02d}"
+                           "_optim_states.pt"))
     return d
