@@ -13,8 +13,9 @@ AutoTP sharding places them across the mesh (the TP half of the
 reference's injection policies).
 
 Supported families: GPT-2, Llama, Mistral, Qwen2, Mixtral, Phi,
-Phi-3, Qwen2-MoE, Falcon, OPT (matching ``models/*.py``; the reference
-v2 model zoo).  Sources: a dict of tensors, an HF
+Phi-3, Qwen2-MoE, Falcon, OPT, GPT-J, BLOOM, GPT-NeoX (matching
+``models/*.py``; the reference v2 model zoo plus the v1-only
+bloom/gptj/gptneox injection class).  Sources: a dict of tensors, an HF
 ``transformers`` model object, or a directory holding
 ``pytorch_model.bin`` / sharded ``pytorch_model-*.bin`` /
 ``model.safetensors``.
@@ -440,6 +441,52 @@ def _convert_gptj(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     return _nest(flat)
 
 
+def _convert_gptneox(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """GPT-NeoX (reference ``module_inject/containers/gptneox.py``
+    GPTNEOXLayerPolicy): fused per-head ``[q_h; k_h; v_h]``
+    query_key_value split into q/k/v, parallel residual, half-layout
+    partial rotary (no permutation needed), untied ``embed_out``."""
+    sd = _strip_prefix(sd, "gpt_neox.")
+    L, H, Dh = (cfg.num_hidden_layers, cfg.num_attention_heads,
+                cfg.head_dim)
+    layers = []
+    for i in range(L):
+        p = f"layers.{i}."
+        w4 = sd[p + "attention.query_key_value.weight"].reshape(
+            H, 3, Dh, -1)
+        b3 = sd[p + "attention.query_key_value.bias"].reshape(H, 3, Dh)
+        layers.append({
+            "input_layernorm/scale": sd[p + "input_layernorm.weight"],
+            "input_layernorm/bias": sd[p + "input_layernorm.bias"],
+            "post_attention_layernorm/scale":
+                sd[p + "post_attention_layernorm.weight"],
+            "post_attention_layernorm/bias":
+                sd[p + "post_attention_layernorm.bias"],
+            "attention/q_proj/kernel": w4[:, 0].reshape(H * Dh, -1).T,
+            "attention/q_proj/bias": b3[:, 0].reshape(-1),
+            "attention/k_proj/kernel": w4[:, 1].reshape(H * Dh, -1).T,
+            "attention/k_proj/bias": b3[:, 1].reshape(-1),
+            "attention/v_proj/kernel": w4[:, 2].reshape(H * Dh, -1).T,
+            "attention/v_proj/bias": b3[:, 2].reshape(-1),
+            "attention/o_proj/kernel": sd[p + "attention.dense.weight"].T,
+            "attention/o_proj/bias": sd[p + "attention.dense.bias"],
+            "mlp/dense_h_to_4h/kernel":
+                sd[p + "mlp.dense_h_to_4h.weight"].T,
+            "mlp/dense_h_to_4h/bias": sd[p + "mlp.dense_h_to_4h.bias"],
+            "mlp/dense_4h_to_h/kernel":
+                sd[p + "mlp.dense_4h_to_h.weight"].T,
+            "mlp/dense_4h_to_h/bias": sd[p + "mlp.dense_4h_to_h.bias"],
+        })
+    flat = {
+        "gpt_neox/embed_in/embedding": sd["embed_in.weight"],
+        "gpt_neox/final_layer_norm/scale": sd["final_layer_norm.weight"],
+        "gpt_neox/final_layer_norm/bias": sd["final_layer_norm.bias"],
+        "embed_out/kernel": sd["embed_out.weight"].T,
+    }
+    _place_layers(flat, layers, cfg, prefix="gpt_neox/layers")
+    return _nest(flat)
+
+
 def _convert_bloom(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     """BLOOM (reference ``module_inject/containers/bloom.py``
     BLOOMLayerPolicy): fused per-head ``[q_h; k_h; v_h]``
@@ -563,6 +610,9 @@ _CONVERTERS = {
     # class of the reference v1 injection zoo
     "GPTJConfig": _convert_gptj,
     "BloomConfig": _convert_bloom,
+    # GPT-NeoX: fused per-head qkv + parallel residual, half-layout
+    # rotary (reference containers/gptneox.py)
+    "GPTNeoXConfig": _convert_gptneox,
 }
 
 

@@ -172,6 +172,24 @@ def _write_item_file(dst: str, m, v) -> None:
     os.replace(tmp, dst)
 
 
+def _plan_buckets(meta, bucket_bytes: int):
+    """``(buckets, plan_keys, item_loc)`` for a flat-moment stream —
+    the ONE plan-construction path both swapped tiers share (so their
+    bucket layouts, and therefore their checkpoint item files, can never
+    drift apart).  Honors the ``DSTPU_SWAP_BUCKET_MB`` override."""
+    env_mb = os.environ.get("DSTPU_SWAP_BUCKET_MB")
+    if env_mb:
+        bucket_bytes = int(env_mb) << 20
+    buckets = _build_bucket_plan(meta, bucket_bytes)
+    plan_keys = {it["key"] for b in buckets for it in b["items"]}
+    item_loc = {}
+    for b in buckets:
+        for it in b["items"]:
+            item_loc[it["key"]] = (b["bid"], it["off"], it["tag"],
+                                   it["n"], b["n"])
+    return buckets, plan_keys, item_loc
+
+
 def _build_bucket_plan(meta, cap_bytes: int):
     """Pack the float leaves into contiguous flat-moment buckets.
 
@@ -387,19 +405,11 @@ class NvmeOptimizerSwapper:
         self._bucket_fns: Dict[tuple, Any] = {}
         self._read_bufs = None
         self._fallback_warned = False
-        env_mb = os.environ.get("DSTPU_SWAP_BUCKET_MB")
-        if env_mb:
-            bucket_bytes = int(env_mb) << 20
         self._item_loc: Dict[str, tuple] = {}
         self._items_dirty = False
         if jax.process_count() == 1 and self._meta:
-            self._buckets = _build_bucket_plan(self._meta, bucket_bytes)
-            self._plan_keys = {it["key"] for b in self._buckets
-                               for it in b["items"]}
-            for b in self._buckets:
-                for it in b["items"]:
-                    self._item_loc[it["key"]] = (
-                        b["bid"], it["off"], it["tag"], it["n"], b["n"])
+            self._buckets, self._plan_keys, self._item_loc = \
+                _plan_buckets(self._meta, bucket_bytes)
             self._plan_hash = hashlib.sha1(repr(
                 [(it["key"], it["shape"]) for b in self._buckets
                  for it in b["items"]]).encode()).hexdigest()[:8]
@@ -1056,9 +1066,6 @@ class HostMomentSwapper:
         self.adam_w_mode = bool(adam_w_mode)
         self.host_memory = bool(host_memory)
         self.count = 0
-        env_mb = os.environ.get("DSTPU_SWAP_BUCKET_MB")
-        if env_mb:
-            bucket_bytes = int(env_mb) << 20
         self._meta: Dict[str, Tuple[str, tuple, np.dtype]] = {}
         flat = jax.tree_util.tree_flatten_with_path(params)[0]
         total = 0
@@ -1068,21 +1075,15 @@ class HostMomentSwapper:
             key = path_str(kp)
             self._meta[key] = ("", tuple(leaf.shape), np.dtype(np.float32))
             total += 2 * int(np.prod(leaf.shape)) * 4
-        self._buckets = _build_bucket_plan(self._meta, bucket_bytes)
-        self._plan_keys = {it["key"] for b in self._buckets
-                           for it in b["items"]}
-        self._item_loc = {}
-        for b in self._buckets:
-            for it in b["items"]:
-                self._item_loc[it["key"]] = (
-                    b["bid"], it["off"], it["tag"], it["n"], b["n"])
+        self._buckets, self._plan_keys, self._item_loc = \
+            _plan_buckets(self._meta, bucket_bytes)
         self._mv: Dict[int, Any] = {}       # bid -> pinned_host [2, n]
         self._fns: Dict[tuple, Any] = {}
         log_dist(f"host-offload optimizer stream: {len(self._buckets)} "
                  f"buckets, {total / 1e9:.2f} GB of moments in pinned "
                  "host memory", ranks=[0])
 
-    def _host_sharding(self, like_leaf, n: int):
+    def _host_sharding(self, like_leaf):
         sh = like_leaf.sharding
         if isinstance(sh, jax.sharding.NamedSharding):
             sh = jax.sharding.NamedSharding(sh.mesh,
@@ -1099,7 +1100,7 @@ class HostMomentSwapper:
         host_gs = tuple(getattr(getattr(g, "sharding", None),
                                 "memory_kind", None) == "pinned_host"
                         for g in gs)
-        mv_sh = self._host_sharding(ps[0], bucket["n"])
+        mv_sh = self._host_sharding(ps[0])
         key = (shapes, out_sh, mv_sh, host_ps, host_gs, init)
         fn = self._fns.get(key)
         if fn is None:
@@ -1123,21 +1124,23 @@ class HostMomentSwapper:
         runtime pipelines bucket k+1's H2D against bucket k's compute."""
         from deepspeed_tpu.checkpoint.sharded import path_str
 
-        self.count += 1
-        count = np.float32(self.count)
-        lr = np.float32(lr)
-        gscale = np.float32(gscale)
         flat_p = jax.tree_util.tree_flatten_with_path(params)
         flat_g = jax.tree_util.tree_flatten(grads)[0]
         keys = [path_str(kp) for kp, _ in flat_p[0]]
         leaves = [leaf for _, leaf in flat_p[0]]
         idx = {k: i for i, k in enumerate(keys)}
         fkeys = {k for k, leaf in zip(keys, leaves) if _float_leaf(leaf)}
+        # validate BEFORE bumping count: a rejected call must not skew the
+        # Adam bias correction of every later step
         if fkeys != self._plan_keys:
             raise ValueError(
                 "host-offload optimizer: params tree does not match the "
                 "registered plan (build the swapper over the same tree "
                 "it updates)")
+        self.count += 1
+        count = np.float32(self.count)
+        lr = np.float32(lr)
+        gscale = np.float32(gscale)
         new_leaves = list(leaves)
         try:
             for kb, b in enumerate(self._buckets):
@@ -1262,7 +1265,7 @@ class HostMomentSwapper:
         if not hit:
             return None
         return jax.device_put(data.reshape(2, n),
-                              self._host_sharding(like_leaf, n))
+                              self._host_sharding(like_leaf))
 
     def close(self) -> None:
         self._mv.clear()

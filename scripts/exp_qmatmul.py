@@ -1,0 +1,144 @@
+"""Decode-regime quantized matmul strategies, measured on the real chip.
+
+The ragged quantized-serving path (fp8 KV + int8 weights) serves at half
+the unquantized rate (BENCH_MATRIX r4: 9.7k vs 19.3k tok/s).  Decode is
+weight-bandwidth-bound, so the QUANTIZED path should be FASTER, not
+slower: int8 weights are half the HBM bytes of bf16, and the MXU has a
+native int8 path.  This experiment times one decode-shaped matmul chain
+under a `lax.scan` (mimicking the on-device decode block) four ways:
+
+  a) bf16 weights, bf16 dot                          — the unquantized floor
+  b) stored int8+scale, dequantized OUTSIDE the scan — current engine path
+  c) stored int8+scale, dequantized INSIDE the body  — what XLA may lower b to
+  d) W8A8: per-channel int8 weights kept int8, activations dynamically
+     quantized per row, int8xint8 dot_general (int32 accum), rescale
+     — the reference's W8A8 inference GEMM (csrc/quantization) mapped to
+     the MXU's int8 path.
+
+Run:  python scripts/exp_qmatmul.py
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+S = 32            # decode batch (live sequences)
+HID = 768
+FF = 2048
+LAYERS = 12
+K = 16            # scan ticks per dispatch
+
+
+def _timeit(fn, *args, n=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def make_weights(key):
+    ws = []
+    for i in range(LAYERS):
+        k1, k2, key = jax.random.split(key, 3)
+        ws.append((jax.random.normal(k1, (HID, FF), jnp.bfloat16) * 0.02,
+                   jax.random.normal(k2, (FF, HID), jnp.bfloat16) * 0.02))
+    return ws
+
+
+def chan_quant(w):
+    """Per-output-channel symmetric int8 (scale constant along the
+    contraction axis, so it factors out of the dot)."""
+    s = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0, keepdims=True) / 127.0
+    q = jnp.round(w.astype(jnp.float32) / s).astype(jnp.int8)
+    return q, s
+
+
+def body_bf16(ws, x):
+    def tick(x, _):
+        for w1, w2 in ws:
+            x = jax.nn.gelu(x @ w1) @ w2
+        return x, ()
+    x, _ = jax.lax.scan(tick, x, None, length=K)
+    return x
+
+
+@jax.jit
+def run_bf16(ws, x):
+    return body_bf16(ws, x)
+
+
+@jax.jit
+def run_dequant_outside(qs, x):
+    ws = [(q1.astype(jnp.bfloat16) * s1.astype(jnp.bfloat16),
+           q2.astype(jnp.bfloat16) * s2.astype(jnp.bfloat16))
+          for (q1, s1), (q2, s2) in qs]
+    return body_bf16(ws, x)
+
+
+@jax.jit
+def run_dequant_inside(qs, x):
+    def tick(x, _):
+        for (q1, s1), (q2, s2) in qs:
+            w1 = q1.astype(jnp.bfloat16) * s1.astype(jnp.bfloat16)
+            w2 = q2.astype(jnp.bfloat16) * s2.astype(jnp.bfloat16)
+            x = jax.nn.gelu(x @ w1) @ w2
+        return x, ()
+    x, _ = jax.lax.scan(tick, x, None, length=K)
+    return x
+
+
+def w8a8(x, q, s):
+    sx = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                 keepdims=True) / 127.0
+    xq = jnp.round(x.astype(jnp.float32) / jnp.maximum(sx, 1e-12)
+                   ).astype(jnp.int8)
+    acc = jax.lax.dot_general(xq, q, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * sx * s).astype(jnp.bfloat16)
+
+
+@jax.jit
+def run_w8a8(qs, x):
+    def tick(x, _):
+        for (q1, s1), (q2, s2) in qs:
+            x = w8a8(jax.nn.gelu(w8a8(x, q1, s1).astype(jnp.float32)
+                                 ).astype(jnp.bfloat16), q2, s2)
+        return x, ()
+    x, _ = jax.lax.scan(tick, x, None, length=K)
+    return x
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    ws = make_weights(key)
+    qs = [(chan_quant(w1), chan_quant(w2)) for w1, w2 in ws]
+    qs = jax.tree_util.tree_map(jnp.asarray, qs)
+    x = jax.random.normal(jax.random.PRNGKey(1), (S, HID), jnp.bfloat16)
+
+    wbytes_bf16 = sum(w1.size * 2 + w2.size * 2 for w1, w2 in ws)
+    print(f"device={jax.devices()[0].device_kind} S={S} hid={HID} ff={FF} "
+          f"layers={LAYERS} K={K} weight_bytes={wbytes_bf16/1e6:.1f}MB bf16")
+    for name, fn, arg in [("a_bf16", run_bf16, ws),
+                          ("b_dequant_outside_scan", run_dequant_outside, qs),
+                          ("c_dequant_inside_scan", run_dequant_inside, qs),
+                          ("d_w8a8_int8_dot", run_w8a8, qs)]:
+        dt = _timeit(fn, arg, x)
+        # per tick the chain reads all layer weights once
+        gbps = wbytes_bf16 * K / dt / 1e9
+        print(f"{name:26s} {dt*1e3:8.3f} ms/dispatch  "
+              f"{dt*1e3/K:6.3f} ms/tick  (bf16-equiv {gbps:6.1f} GB/s)")
+
+    # numerics: w8a8 vs bf16 reference on one layer
+    ref = jax.nn.gelu((x @ ws[0][0]).astype(jnp.float32))
+    got = jax.nn.gelu(w8a8(x, *qs[0][0]).astype(jnp.float32))
+    err = jnp.max(jnp.abs(ref - got)) / (jnp.max(jnp.abs(ref)) + 1e-9)
+    print(f"w8a8 one-layer rel err: {float(err):.4f}")
+
+
+if __name__ == "__main__":
+    main()

