@@ -28,7 +28,7 @@ from deepspeed_tpu.ops.quantization import (FP6Tensor, FP8Tensor,
                                             quantize, quantize_fp6,
                                             quantize_fp8)
 
-WEIGHT_FORMATS = ("int8", "fp8", "fp6")
+WEIGHT_FORMATS = ("int8", "fp8", "fp6", "w8a8")
 
 # matmul-bearing leaf names — norms/biases/scales stay high precision
 # (the reference's policies quantize Linear/Embedding weights only)
@@ -80,6 +80,14 @@ def quantize_param_tree(params: Any, fmt: str, min_size: int = 1024,
     ``group_size`` is the int8/fp6 blockwise-scale granularity
     (reference ``QuantizationConfig.group_size``); fp8 scales per
     tensor.
+
+    ``fmt="w8a8"``: 2-D ``kernel`` leaves get PER-OUTPUT-CHANNEL
+    symmetric int8 (scale constant along the contraction axis, so it
+    factors out of an int8 x int8 MXU dot — the models consume these
+    leaves natively through :func:`w8a8_dot_general`, the reference's
+    W8A8 quantized-inference GEMM, ``csrc/quantization``); non-kernel
+    matmul leaves (embeddings, stacked MoE experts) fall back to
+    group-wise int8 with in-jit dequant.
     """
     assert fmt in WEIGHT_FORMATS, \
         f"quantize_weights={fmt!r}: expected one of {WEIGHT_FORMATS}"
@@ -93,7 +101,13 @@ def quantize_param_tree(params: Any, fmt: str, min_size: int = 1024,
                 name not in _QUANT_LEAVES):
             after += leaf.size * leaf.dtype.itemsize
             return leaf
-        if fmt == "int8":
+        if fmt == "w8a8" and name == "kernel" and leaf.ndim == 2:
+            s = jnp.max(jnp.abs(leaf.astype(jnp.float32)), axis=0)
+            s = jnp.maximum(s, 1e-12) / 127.0
+            v = jnp.clip(jnp.round(leaf.astype(jnp.float32) / s),
+                         -127, 127).astype(jnp.int8)
+            out = QuantizedWeight("w8a8", (v, s), leaf.shape, leaf.dtype)
+        elif fmt in ("int8", "w8a8"):
             t = quantize(leaf, num_bits=8, group_size=group_size)
             out = QuantizedWeight("int8", (t.values, t.scale, t.offset),
                                   t.shape, t.dtype)
@@ -111,14 +125,22 @@ def quantize_param_tree(params: Any, fmt: str, min_size: int = 1024,
     return (jax.tree_util.tree_map_with_path(q, params), before, after)
 
 
-def dequantize_param_tree(qtree: Any) -> Any:
+def dequantize_param_tree(qtree: Any, native_w8a8: bool = False) -> Any:
     """In-jit inverse of :func:`quantize_param_tree` (XLA fuses the
     expansion into consumers; quantized leaves never persist in HBM at
-    full precision)."""
+    full precision).  ``native_w8a8=True`` leaves "w8a8" leaves in place
+    for a model whose Dense layers consume them through
+    :func:`w8a8_dot_general` — the int8 payload then never expands to
+    full precision at all."""
 
     def dq(leaf):
         if not isinstance(leaf, QuantizedWeight):
             return leaf
+        if leaf.fmt == "w8a8":
+            if native_w8a8:
+                return leaf
+            v, s = leaf.arrays
+            return (v.astype(jnp.float32) * s).astype(leaf.dtype)
         if leaf.fmt == "int8":
             v, s, o = leaf.arrays
             return dequantize(QuantizedTensor(v, s, o, leaf.shape,
@@ -131,3 +153,60 @@ def dequantize_param_tree(qtree: Any) -> Any:
                                         leaf.group_size))
 
     return jax.tree_util.tree_map(dq, qtree, is_leaf=_is_q)
+
+
+# ---------------------------------------------------------------------------
+# Native W8A8 consumption (the reference's dequant-in-GEMM-prologue /
+# W8A8 inference GEMMs, ``csrc/quantization`` + ``cuda_linear``): the
+# model's Dense layers read the int8 payload DIRECTLY — activations
+# dynamically quantize per row, the dot runs on the MXU's int8 path
+# (int32 accumulation), and the two scales rescale the output.  Decode
+# is weight-bandwidth-bound, so halving the weight bytes halves the
+# decode floor — unlike tree-level dequant, which pays an extra
+# full-precision materialization per dispatch.
+# ---------------------------------------------------------------------------
+
+def quant_promote_dtype(*args, dtype=None, **kw):
+    """``nn.Dense.promote_dtype`` replacement: QuantizedWeight leaves
+    pass through untouched (flax's default would jnp.asarray them)."""
+    from flax.linen.dtypes import promote_dtype
+
+    qs = [a if isinstance(a, QuantizedWeight) else None for a in args]
+    proms = promote_dtype(*(None if q is not None else a
+                            for q, a in zip(qs, args)), dtype=dtype, **kw)
+    return [q if q is not None else p for q, p in zip(qs, proms)]
+
+
+def w8a8_dot_general(lhs, rhs, dimension_numbers, precision=None,
+                     preferred_element_type=None):
+    """``nn.Dense.dot_general`` replacement: int8 x int8 dot against a
+    "w8a8" :class:`QuantizedWeight` with dynamic per-row activation
+    scales; plain arrays fall through to ``lax.dot_general``."""
+    if not isinstance(rhs, QuantizedWeight):
+        return jax.lax.dot_general(
+            lhs, rhs, dimension_numbers, precision=precision,
+            preferred_element_type=preferred_element_type)
+    assert rhs.fmt == "w8a8", rhs.fmt
+    (lc, rc), (lb, rb) = dimension_numbers
+    assert tuple(rc) == (0,) and not lb and not rb, (
+        "w8a8 kernels only support Dense-style contractions")
+    v, s = rhs.arrays
+    sx = jnp.max(jnp.abs(lhs.astype(jnp.float32)), axis=-1,
+                 keepdims=True) / 127.0
+    xq = jnp.round(lhs.astype(jnp.float32) /
+                   jnp.maximum(sx, 1e-12)).astype(jnp.int8)
+    acc = jax.lax.dot_general(xq, v, dimension_numbers,
+                              preferred_element_type=jnp.int32)
+    return (acc.astype(jnp.float32) * sx * s).astype(
+        lhs.dtype if jnp.issubdtype(lhs.dtype, jnp.floating)
+        else rhs.dtype)
+
+
+def weight_quant_dense_kwargs(weight_quant: str):
+    """``nn.Dense`` kwargs wiring native quantized-weight consumption
+    into a model (the model zoo's ``cfg.weight_quant`` knob)."""
+    if weight_quant in (None, "none"):
+        return {}
+    assert weight_quant == "w8a8", weight_quant
+    return {"promote_dtype": quant_promote_dtype,
+            "dot_general": w8a8_dot_general}

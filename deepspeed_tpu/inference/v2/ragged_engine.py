@@ -118,9 +118,13 @@ class RaggedInferenceEngineV2:
                  kv_reserve: str = "on_demand"):
         """``kv_cache_dtype``: "none" | "fp8" | "int8" — paged KV pool
         storage format (reference fp_quantizer KV quantization).
-        ``quantize_weights``: None | "int8" | "fp8" | "fp6" — weights
-        persist quantized in HBM and dequantize in-jit at use (reference
-        FP6-LLM cuda_linear / int8 quantized inference).
+        ``quantize_weights``: None | "int8" | "fp8" | "fp6" | "w8a8" —
+        weights persist quantized in HBM and dequantize in-jit at use
+        (reference FP6-LLM cuda_linear / int8 quantized inference);
+        "w8a8" additionally quantizes activations per row and dots
+        int8 x int8 on the MXU (reference W8A8 GEMMs,
+        ``csrc/quantization``) — Llama-family models only, and the
+        faster choice whenever decode is weight-bandwidth-bound.
         ``kv_reserve``: "on_demand" (reference blocked-allocator model —
         admit on prompt-size pages, grow per decode block, evict +
         requeue as a continuation when the pool runs dry) or
@@ -179,6 +183,7 @@ class RaggedInferenceEngineV2:
             plain_model=type(model)(dataclasses.replace(mcfg,
                                                         decode=False)))
         self._wq = quantize_weights
+        self._wq_native = False
         if quantize_weights is not None:
             assert self.tp <= 1, (
                 "quantize_weights does not compose with tensor-parallel "
@@ -188,6 +193,24 @@ class RaggedInferenceEngineV2:
                 quantize_param_tree
             from deepspeed_tpu.parallel import tensor_parallel as tp_lib
 
+            # "w8a8" (explicit opt-in — it quantizes ACTIVATIONS too, so
+            # numerics differ from weight-only "int8") runs the NATIVE
+            # path on models whose Dense layers consume quantized
+            # kernels: int8 stays on the per-tick read path (decode is
+            # weight-bandwidth-bound — tree-level dequant reads 2x the
+            # bytes), dotted on the MXU's int8 path with dynamic
+            # per-row activation scales
+            if quantize_weights == "w8a8":
+                assert getattr(type(model), "w8a8_native", False), (
+                    f"quantize_weights='w8a8' needs a model whose Dense "
+                    f"layers consume w8a8 kernels natively (the Llama "
+                    f"family: llama/mistral/qwen2); "
+                    f"{type(model).__name__} does not — use weight-only "
+                    f"'int8' instead")
+                self._wq_native = True
+                self.cfg = dataclasses.replace(self.cfg,
+                                               weight_quant="w8a8")
+                self.model = type(model)(self.cfg)
             # unbox flax Partitioned metadata FIRST: the quantizer's
             # leaf-name check reads path tails, which inside a metadata
             # box are the box's own keys — boxed trees would silently
@@ -196,7 +219,8 @@ class RaggedInferenceEngineV2:
                 params = tp_lib.unbox_params(params)
             params, b0, b1 = quantize_param_tree(params, quantize_weights)
             params = jax.device_put(params)
-            log_dist(f"ragged engine weights -> {quantize_weights}: "
+            log_dist(f"ragged engine weights -> {quantize_weights}"
+                     f"{' (native int8 dots)' if self._wq_native else ''}: "
                      f"{b0 / 2**20:.1f} MiB -> {b1 / 2**20:.1f} MiB "
                      f"({b0 / max(b1, 1):.2f}x)", ranks=[0])
         self.params = self._place_params(params)
@@ -348,6 +372,7 @@ class RaggedInferenceEngineV2:
         model = self.model
         unroll = self._unroll_params
         wq = self._wq
+        native = self._wq_native
 
         def run(params, cache, token_ids, positions, kv_lens, page_indices,
                 cu_q_lens, num_seqs, new_kv_dest, sample_rows):
@@ -355,7 +380,7 @@ class RaggedInferenceEngineV2:
                 from deepspeed_tpu.inference.quantization import \
                     dequantize_param_tree
 
-                params = dequantize_param_tree(params)
+                params = dequantize_param_tree(params, native_w8a8=native)
             if unroll:
                 params = unroll_scan_params(params)
             meta = {"kv_lens": kv_lens, "page_indices": page_indices,
@@ -392,6 +417,7 @@ class RaggedInferenceEngineV2:
         max_len = self.max_seq_len
 
         wq = self._wq
+        native = self._wq_native
 
         def run(params, cache, last_tok, pos, active, remaining,
                 page_table, eos_ids, do_sample, temperature, top_k, top_p,
@@ -400,7 +426,7 @@ class RaggedInferenceEngineV2:
                 from deepspeed_tpu.inference.quantization import \
                     dequantize_param_tree
 
-                params = dequantize_param_tree(params)
+                params = dequantize_param_tree(params, native_w8a8=native)
             if unroll:
                 params = unroll_scan_params(params)
 

@@ -77,6 +77,10 @@ class LlamaConfig:
     # only the first partial_rotary_factor * head_dim dims (phi-2: 0.4)
     attention_out_bias: bool = False
     partial_rotary_factor: float = 1.0
+    # serving: "w8a8" makes every Dense consume per-channel int8 kernels
+    # natively (dynamic activation quant + int8 MXU dot) — set by the
+    # inference engines when quantize_weights engages, never for training
+    weight_quant: str = "none"
 
     def __post_init__(self):
         assert self.sequence_parallel in ("none", "ulysses", "ring"), (
@@ -129,6 +133,13 @@ def _tp_kwargs(cfg: LlamaConfig, kind: str):
     return tp_dense_kwargs(cfg.tensor_parallel, kind)
 
 
+def _wq_kwargs(cfg: LlamaConfig):
+    from deepspeed_tpu.inference.quantization import \
+        weight_quant_dense_kwargs
+
+    return weight_quant_dense_kwargs(getattr(cfg, "weight_quant", "none"))
+
+
 class RMSNorm(nn.Module):
     eps: float = 1e-5
     dtype: Any = jnp.bfloat16
@@ -171,7 +182,7 @@ class LlamaAttention(nn.Module):
         H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
                       cfg.head_dim)
         dense = dict(use_bias=False, dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype)
+                     param_dtype=cfg.param_dtype, **_wq_kwargs(cfg))
         # Qwen2: biases on q/k/v only, never on o_proj
         qkv = dict(dense, use_bias=cfg.attention_bias)
         q = nn.Dense(H * Dh, name="q_proj", **qkv,
@@ -289,7 +300,7 @@ class LlamaMLP(nn.Module):
     def __call__(self, x):
         cfg = self.config
         dense = dict(use_bias=False, dtype=cfg.dtype,
-                     param_dtype=cfg.param_dtype)
+                     param_dtype=cfg.param_dtype, **_wq_kwargs(cfg))
         gate = nn.Dense(cfg.intermediate_size, name="gate_proj", **dense,
                         **_tp_kwargs(cfg, "col"))(x)
         up = nn.Dense(cfg.intermediate_size, name="up_proj", **dense,
@@ -340,6 +351,10 @@ class ScanLlamaBlock(nn.Module):
 
 class LlamaModel(nn.Module):
     config: LlamaConfig
+    # every matmul kernel in this module tree consumes w8a8
+    # QuantizedWeight leaves natively (see _wq_kwargs) — serving engines
+    # key the int8-MXU path off this class flag
+    w8a8_native = True
 
     @nn.compact
     def __call__(self, input_ids, positions=None, deterministic: bool = True,
@@ -394,6 +409,7 @@ class LlamaModel(nn.Module):
 
 class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
+    w8a8_native = True
 
     @nn.compact
     def __call__(self, input_ids, positions=None, deterministic: bool = True,
@@ -403,7 +419,7 @@ class LlamaForCausalLM(nn.Module):
                                           ragged_meta)
         return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                         param_dtype=cfg.param_dtype, name="lm_head",
-                        **_tp_kwargs(cfg, "col"))(x)
+                        **_tp_kwargs(cfg, "col"), **_wq_kwargs(cfg))(x)
 
 
 class LlamaLMLoss(nn.Module):

@@ -134,6 +134,85 @@ def test_weight_quant_ragged_engine(params):
         assert np.isfinite(toks).all()
 
 
+def test_w8a8_native_int8_dots(params):
+    """quantize_weights="w8a8" (explicit opt-in: it quantizes
+    activations too) runs the NATIVE path on Llama-family models:
+    kernels stay int8 in the params tree (never re-expanded per tick)
+    and the traced program dots s8 x s8 — the MXU int8 path, reference
+    W8A8 inference GEMM semantics."""
+    from deepspeed_tpu.inference.quantization import QuantizedWeight
+
+    eng = RaggedInferenceEngineV2(LlamaForCausalLM(CFG), params=params,
+                                  max_seqs=2, max_seq_len=64,
+                                  prefill_chunk=8, decode_block_size=4,
+                                  quantize_weights="w8a8")
+    assert eng._wq_native and eng._wq == "w8a8"
+    # weight-only int8 keeps the documented dequant semantics (no
+    # silent activation quantization)
+    eng_i8 = RaggedInferenceEngineV2(LlamaForCausalLM(CFG), params=params,
+                                     max_seqs=2, max_seq_len=64,
+                                     prefill_chunk=8, decode_block_size=4,
+                                     quantize_weights="int8")
+    assert not eng_i8._wq_native and eng_i8._wq == "int8"
+    # and w8a8 on a model without native Dense consumption fails loudly
+    from deepspeed_tpu.models.gptneox import (GPTNeoXForCausalLM,
+                                              get_config as neox_config)
+    ncfg = neox_config("tinyneox", dtype=jnp.float32,
+                       param_dtype=jnp.float32, scan_layers=False,
+                       remat=False, use_flash_attention=False)
+    nparams = jax.jit(GPTNeoXForCausalLM(ncfg).init)(
+        jax.random.PRNGKey(0), np.zeros((1, 4), np.int32))
+    with pytest.raises(AssertionError, match="w8a8"):
+        RaggedInferenceEngineV2(GPTNeoXForCausalLM(ncfg), params=nparams,
+                                max_seqs=2, max_seq_len=64,
+                                prefill_chunk=8, quantize_weights="w8a8")
+    qleaves = [l for l in jax.tree_util.tree_leaves(
+        eng.params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
+        if isinstance(l, QuantizedWeight)]
+    # kernels carry the native format; the embedding (a gather, not a
+    # dot) keeps the group-wise int8 dequant fallback
+    fmts = {l.fmt for l in qleaves}
+    assert "w8a8" in fmts and fmts <= {"w8a8", "int8"}, fmts
+    assert all(l.arrays[0].dtype == jnp.int8 for l in qleaves)
+
+    # the decode-block program must contain an s8 x s8 dot (int32 accum)
+    import re
+
+    from deepspeed_tpu.inference.quantization import dequantize_param_tree
+
+    def fwd(p, x):
+        # exactly what the engine's step programs do: expand fallback
+        # leaves, keep w8a8 kernels int8 for the model's native dots
+        p = dequantize_param_tree(p, native_w8a8=True)
+        return eng.model.apply(
+            p if "params" in p else {"params": p}, x,
+            positions=jnp.zeros((1, 2), jnp.int32),
+            ragged_meta={"kv_lens": jnp.ones((2,), jnp.int32),
+                         "page_indices": jnp.zeros((2, 1), jnp.int32),
+                         "cu_q_lens": jnp.asarray([0, 1, 2], jnp.int32),
+                         "num_seqs": jnp.asarray([2], jnp.int32),
+                         "new_kv_dest": jnp.asarray([0, 1], jnp.int32)},
+            mutable=["cache"])[0]
+
+    jaxpr = str(jax.make_jaxpr(fwd)(eng.params, np.zeros((1, 2), np.int32)))
+    assert re.search(r"i32\[[\d,]*\] = dot_general\[", jaxpr), \
+        "no int32-accumulating int8 dot in the traced program"
+
+    # and it still generates sanely vs the unquantized engine
+    ref_eng = RaggedInferenceEngineV2(LlamaForCausalLM(CFG), params=params,
+                                      max_seqs=2, max_seq_len=64,
+                                      prefill_chunk=8, decode_block_size=4)
+    prompts = _prompts([5, 9], seed=5)
+    outs = eng.generate_all(prompts, max_new_tokens=6)
+    ref = ref_eng.generate_all(prompts, max_new_tokens=6)
+    assert len(outs) == 2
+    for (u, toks), (_, rtoks), prompt in zip(sorted(outs.items()),
+                                             sorted(ref.items()), prompts):
+        assert np.isfinite(toks).all()
+        np.testing.assert_array_equal(toks[:prompt.size], prompt)
+        assert toks.shape == rtoks.shape
+
+
 def test_weight_quant_generate_matches_forward_format(params):
     """v1 generate() under quantization produces tokens consistent with
     its own quantized forward (greedy argmax of the first step)."""
