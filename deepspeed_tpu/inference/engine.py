@@ -88,31 +88,40 @@ class InferenceEngine:
         mcfg = getattr(model, "config", None)
         if (dataclasses.is_dataclass(mcfg) and
                 any(f.name == "decode" for f in dataclasses.fields(mcfg))):
-            # learned/rotary position tables bound usable positions; clamp
-            # the cache so generate() can't run past them into silently
-            # clamped embedding gathers
-            pos_bound = (getattr(mcfg, "n_positions", None) or
-                         getattr(mcfg, "max_position_embeddings", None))
-            cache_len = getattr(mcfg, "max_cache_len", 0) or config.max_out_tokens
-            if pos_bound is not None and cache_len > pos_bound:
-                logger.warning(
-                    f"max_out_tokens={cache_len} exceeds the model's "
-                    f"position bound {pos_bound}; clamping the KV cache")
-                cache_len = pos_bound
             # decode twins unroll the layer scan: flax scan restacks the
             # mutable cache per step (full-cache copies); unrolled layers
             # alias each cache independently — 3.8x decode on v5e.
             # Scan-stacked params convert in-jit (common.unroll_scan_params)
             self._unroll_params = bool(getattr(mcfg, "scan_layers", False))
-            dcfg = dataclasses.replace(
-                mcfg, decode=True, dtype=self.dtype,
-                max_cache_len=cache_len, scan_layers=False)
-            self._decode_model = type(model)(dcfg)
             self._plain_model = (model if mcfg.dtype == self.dtype
                                  else type(model)(
                                      dataclasses.replace(mcfg,
                                                          dtype=self.dtype)))
-            self.max_cache_len = dcfg.max_cache_len
+            if any(f.name == "max_cache_len"
+                   for f in dataclasses.fields(mcfg)):
+                # learned/rotary position tables bound usable positions;
+                # clamp the cache so generate() can't run past them into
+                # silently clamped embedding gathers
+                pos_bound = (getattr(mcfg, "n_positions", None) or
+                             getattr(mcfg, "max_position_embeddings", None))
+                cache_len = (getattr(mcfg, "max_cache_len", 0) or
+                             config.max_out_tokens)
+                if pos_bound is not None and cache_len > pos_bound:
+                    logger.warning(
+                        f"max_out_tokens={cache_len} exceeds the model's "
+                        f"position bound {pos_bound}; clamping the KV cache")
+                    cache_len = pos_bound
+                dcfg = dataclasses.replace(
+                    mcfg, decode=True, dtype=self.dtype,
+                    max_cache_len=cache_len, scan_layers=False)
+                self._decode_model = type(model)(dcfg)
+                self.max_cache_len = dcfg.max_cache_len
+            else:
+                # encoder families (BERT): forward()-only serving, the
+                # reference's BertLayer injection scope — no KV cache,
+                # generate() refuses below
+                self._decode_model = None
+                self.max_cache_len = 0
         else:
             raise TypeError(
                 "init_inference needs a model whose config dataclass has a "
@@ -308,6 +317,10 @@ class InferenceEngine:
         """Autoregressive generation: prefill + ``max_new_tokens`` fused
         decode steps in one compiled program per (batch, prompt-len,
         max-new) shape.  Returns ``[B, P + max_new_tokens]`` token ids."""
+        if self._decode_model is None:
+            raise TypeError(
+                "generate() needs a decoder model; encoder families "
+                "(BERT) serve through forward() only")
         prompt = jnp.asarray(np.asarray(input_ids), jnp.int32)
         assert prompt.ndim == 2, "input_ids must be [batch, prompt_len]"
         B, P = prompt.shape

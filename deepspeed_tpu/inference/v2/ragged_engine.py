@@ -211,6 +211,19 @@ class RaggedInferenceEngineV2:
                 self.cfg = dataclasses.replace(self.cfg,
                                                weight_quant="w8a8")
                 self.model = type(model)(self.cfg)
+                if self._unroll_params:
+                    # unroll scan-stacked [L, ...] kernels NOW: the
+                    # per-channel w8a8 format is 2-D-kernel only, so a
+                    # stacked tree would silently fall back to the
+                    # dequant path for every block kernel
+                    from deepspeed_tpu.inference.common import \
+                        unroll_scan_params
+
+                    params = (
+                        {"params": unroll_scan_params(params["params"])}
+                        if isinstance(params, dict) and "params" in params
+                        else unroll_scan_params(params))
+                    self._unroll_params = False
             # unbox flax Partitioned metadata FIRST: the quantizer's
             # leaf-name check reads path tails, which inside a metadata
             # box are the box's own keys — boxed trees would silently

@@ -1,0 +1,260 @@
+"""BERT model family (the encoder class of the reference injection zoo).
+
+Reference injects BertLayer through its v1 policy
+(``module_inject/containers/bert.py`` HFBertLayerPolicy: fused qkv,
+post-LayerNorm transformer, triangular masking off) — the only ENCODER
+member of the injection zoo, serving embedding/classification workloads
+through ``init_inference``.  Architecture: learned absolute positions +
+token-type embeddings with an embedding LayerNorm, post-LN blocks
+(attention -> residual+LN -> GELU MLP -> residual+LN), bidirectional
+attention under an optional padding mask, and the MLM head (transform
+dense + LN, decoder tied to the word embeddings).
+
+TPU-first choices mirror the decoder families: ``nn.scan`` over blocks,
+bf16 MXU matmuls, Megatron TP via the shared name-rule kwargs
+(query/key/value/intermediate column-parallel, attention-output/output
+row-parallel).  Serving is v1 ``forward()`` (full-sequence logits /
+hidden states) — encoders have no autoregressive decode path, matching
+the reference (BERT never routes to FastGen).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = True
+    remat: bool = False
+    remat_policy: str = "none"
+    use_flash_attention: bool = False
+    tensor_parallel: bool = False
+    # engine-compat knobs (encoders never decode; asserted off)
+    decode: bool = False
+    sequence_parallel: str = "none"
+    pipeline_stages: int = 1
+
+    def __post_init__(self):
+        assert not self.decode, "BERT is an encoder: no decode path"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+
+PRESETS = {
+    "bert-base-uncased": dict(),
+    "bert-large-uncased": dict(hidden_size=1024, num_hidden_layers=24,
+                               num_attention_heads=16,
+                               intermediate_size=4096),
+    "tinybert": dict(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=64),
+}
+
+
+def get_config(preset: str, **overrides) -> BertConfig:
+    kw = dict(PRESETS[preset])
+    kw.update(overrides)
+    kw.setdefault("dtype", jnp.bfloat16)
+    return BertConfig(**kw)
+
+
+def _tp(cfg, kind: str):
+    from deepspeed_tpu.parallel.tensor_parallel import tp_dense_kwargs
+
+    return tp_dense_kwargs(cfg.tensor_parallel, kind)
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attn_bias):
+        cfg = self.config
+        B, S, E = x.shape
+        H, Dh = cfg.num_attention_heads, cfg.head_dim
+        dense = dict(use_bias=True, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+        q = nn.Dense(H * Dh, name="query", **dense, **_tp(cfg, "col"))(x)
+        k = nn.Dense(H * Dh, name="key", **dense, **_tp(cfg, "col"))(x)
+        v = nn.Dense(H * Dh, name="value", **dense, **_tp(cfg, "col"))(x)
+        q = q.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+        from deepspeed_tpu.ops.flash_attention import (flash_attention,
+                                                       mha_reference)
+
+        if cfg.use_flash_attention and attn_bias is None:
+            y = flash_attention(q, k, v, causal=False)
+        else:
+            y = mha_reference(q, k, v, causal=False, bias=attn_bias)
+        return y.transpose(0, 2, 1, 3).reshape(B, S, H * Dh)
+
+
+class BertBlock(nn.Module):
+    """Post-LN block (HF BertLayer): LN wraps residual SUMS, not inputs."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attn_bias=None):
+        cfg = self.config
+        dense = dict(use_bias=True, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+        ln = dict(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                  param_dtype=jnp.float32)
+        h = BertSelfAttention(cfg, name="attention")(x, attn_bias)
+        h = nn.Dense(cfg.hidden_size, name="attention_output", **dense,
+                     **_tp(cfg, "row"))(h)
+        x = nn.LayerNorm(name="attention_layernorm", **ln)(x + h)
+        i = nn.Dense(cfg.intermediate_size, name="intermediate", **dense,
+                     **_tp(cfg, "col"))(x)
+        i = jax.nn.gelu(i.astype(jnp.float32), approximate=False).astype(
+            cfg.dtype)
+        i = nn.Dense(cfg.hidden_size, name="output", **dense,
+                     **_tp(cfg, "row"))(i)
+        return nn.LayerNorm(name="output_layernorm", **ln)(x + i)
+
+
+class ScanBertBlock(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, bias = carry
+        x = BertBlock(self.config, name="block")(x, bias)
+        return (x, bias), None
+
+
+class BertModel(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 positions=None, deterministic: bool = True,
+                 ragged_meta=None):
+        from deepspeed_tpu.models.gpt2 import _maybe_remat
+
+        cfg = self.config
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.arange(S)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        emb = dict(dtype=cfg.dtype, param_dtype=cfg.param_dtype)
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                     name="word_embeddings", **emb)(input_ids)
+        x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                         name="position_embeddings", **emb)(positions)
+        x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                         name="token_type_embeddings", **emb)(token_type_ids)
+        x = nn.LayerNorm(name="embeddings_layernorm",
+                         epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=jnp.float32)(x)
+        # padding mask -> additive bias [B, 1, 1, S] (bidirectional
+        # attention: every query sees every non-pad key)
+        bias = None
+        if attention_mask is not None:
+            bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                             -1e30).astype(jnp.float32)
+        if cfg.scan_layers:
+            block_cls = _maybe_remat(ScanBertBlock, cfg)
+            (x, _), _ = nn.scan(
+                block_cls,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_hidden_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(cfg, name="layer")((x, bias), None)
+        else:
+            block_cls = _maybe_remat(BertBlock, cfg)
+            for i in range(cfg.num_hidden_layers):
+                x = block_cls(cfg, name=f"layer_{i}")(x, bias)
+        return x
+
+
+class BertForMaskedLM(nn.Module):
+    """BERT + MLM head (HF ``BertForMaskedLM``): transform dense + LN,
+    decoder tied to the word embeddings plus a free output bias."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 positions=None, deterministic: bool = True,
+                 ragged_meta=None):
+        cfg = self.config
+        x = BertModel(cfg, name="bert")(input_ids, attention_mask,
+                                        token_type_ids, positions,
+                                        deterministic)
+        dense = dict(use_bias=True, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+        x = nn.Dense(cfg.hidden_size, name="transform", **dense)(x)
+        x = jax.nn.gelu(x.astype(jnp.float32), approximate=False).astype(
+            cfg.dtype)
+        x = nn.LayerNorm(name="transform_layernorm",
+                         epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         param_dtype=jnp.float32)(x)
+        # HF ties the decoder to word_embeddings; here the converter
+        # copies the tied weights into an explicit Dense (a flax parent
+        # cannot cleanly read a child's params mid-apply) — numerically
+        # identical, costs one extra V x E tensor
+        return nn.Dense(cfg.vocab_size, name="decoder", **dense)(x)
+
+
+class BertMLMLoss(nn.Module):
+    """``module(batch) -> scalar`` masked-LM CE (engine contract).
+
+    ``batch``: ``{"input_ids", "labels"}`` — positions with label -100
+    are ignored (HF convention); without "labels" every position is
+    scored against ``input_ids`` (identity objective, smoke use)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, batch):
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        labels = (batch.get("labels", input_ids)
+                  if isinstance(batch, dict) else input_ids)
+        mask_arg = batch.get("attention_mask") if isinstance(batch, dict) \
+            else None
+        logits = BertForMaskedLM(self.config, name="mlm")(
+            input_ids, attention_mask=mask_arg)
+        logits = logits.astype(jnp.float32)
+        valid = labels != -100
+        safe = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        denom = jnp.maximum(valid.sum(), 1)
+        return (jnp.where(valid, nll, 0.0).sum() / denom).astype(jnp.float32)
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(p.shape))
+               for p in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_token(cfg: BertConfig, seq_len: Optional[int] = None) -> float:
+    E, I, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_hidden_layers
+    per_layer = 4 * E * E + 2 * E * I
+    n = L * per_layer + cfg.vocab_size * E
+    s = seq_len or cfg.max_position_embeddings
+    attn = 12 * L * E * s
+    return 6.0 * n + attn

@@ -587,6 +587,72 @@ def _place_layers(flat, layers, cfg, prefix: str,
                 flat[f"{stem}{pat.format(i=i)}/{k}"] = v
 
 
+def _convert_bert(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """BERT (reference ``module_inject/containers/bert.py``
+    HFBertLayerPolicy): post-LN encoder blocks, learned absolute +
+    token-type embeddings with embedding LN, MLM head with the decoder
+    tied to word_embeddings (copied into our explicit decoder Dense)."""
+    sd = {k: v for k, v in sd.items()}
+    L = cfg.num_hidden_layers
+    layers = []
+    for i in range(L):
+        p = f"bert.encoder.layer.{i}."
+        layers.append({
+            "attention/query/kernel":
+                sd[p + "attention.self.query.weight"].T,
+            "attention/query/bias": sd[p + "attention.self.query.bias"],
+            "attention/key/kernel": sd[p + "attention.self.key.weight"].T,
+            "attention/key/bias": sd[p + "attention.self.key.bias"],
+            "attention/value/kernel":
+                sd[p + "attention.self.value.weight"].T,
+            "attention/value/bias": sd[p + "attention.self.value.bias"],
+            "attention_output/kernel":
+                sd[p + "attention.output.dense.weight"].T,
+            "attention_output/bias": sd[p + "attention.output.dense.bias"],
+            "attention_layernorm/scale":
+                sd[p + "attention.output.LayerNorm.weight"],
+            "attention_layernorm/bias":
+                sd[p + "attention.output.LayerNorm.bias"],
+            "intermediate/kernel": sd[p + "intermediate.dense.weight"].T,
+            "intermediate/bias": sd[p + "intermediate.dense.bias"],
+            "output/kernel": sd[p + "output.dense.weight"].T,
+            "output/bias": sd[p + "output.dense.bias"],
+            "output_layernorm/scale": sd[p + "output.LayerNorm.weight"],
+            "output_layernorm/bias": sd[p + "output.LayerNorm.bias"],
+        })
+    wte = sd["bert.embeddings.word_embeddings.weight"]
+    flat = {
+        "bert/word_embeddings/embedding": wte,
+        "bert/position_embeddings/embedding":
+            sd["bert.embeddings.position_embeddings.weight"],
+        "bert/token_type_embeddings/embedding":
+            sd["bert.embeddings.token_type_embeddings.weight"],
+        "bert/embeddings_layernorm/scale":
+            sd["bert.embeddings.LayerNorm.weight"],
+        "bert/embeddings_layernorm/bias":
+            sd["bert.embeddings.LayerNorm.bias"],
+        "transform/kernel":
+            sd["cls.predictions.transform.dense.weight"].T,
+        "transform/bias": sd["cls.predictions.transform.dense.bias"],
+        "transform_layernorm/scale":
+            sd["cls.predictions.transform.LayerNorm.weight"],
+        "transform_layernorm/bias":
+            sd["cls.predictions.transform.LayerNorm.bias"],
+        # tied decoder: HF reuses word_embeddings + a free bias, and
+        # serializers routinely DROP the tied duplicate (safetensors
+        # dedup) — the transform.* keys above are always present in MLM
+        # checkpoints, so these .get fallbacks are the tied-dedup case,
+        # not dead code (encoder-only checkpoints fail loudly above)
+        "decoder/kernel": sd.get("cls.predictions.decoder.weight", wte).T,
+        "decoder/bias": sd.get(
+            "cls.predictions.decoder.bias",
+            sd.get("cls.predictions.bias",
+                   np.zeros(wte.shape[0], wte.dtype))),
+    }
+    _place_layers(flat, layers, cfg, prefix="bert/layer")
+    return _nest(flat)
+
+
 _CONVERTERS = {
     "GPT2Config": _convert_gpt2,
     "LlamaConfig": _convert_llama,
@@ -613,6 +679,8 @@ _CONVERTERS = {
     # GPT-NeoX: fused per-head qkv + parallel residual, half-layout
     # rotary (reference containers/gptneox.py)
     "GPTNeoXConfig": _convert_gptneox,
+    # BERT: the encoder class (reference containers/bert.py)
+    "BertConfig": _convert_bert,
 }
 
 

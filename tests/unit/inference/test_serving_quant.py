@@ -213,6 +213,36 @@ def test_w8a8_native_int8_dots(params):
         assert toks.shape == rtoks.shape
 
 
+def test_w8a8_scan_stacked_params_unroll_eagerly():
+    """Scan-trained checkpoints carry 3-D [L, K, N] kernels, which the
+    per-channel w8a8 format cannot represent — the engine must unroll
+    them at init so EVERY block kernel gets the native path (a stacked
+    tree would silently fall back to dequant for 99% of the weights)."""
+    from deepspeed_tpu.inference.quantization import QuantizedWeight
+
+    scfg = dataclasses.replace(CFG, scan_layers=True)
+    model = LlamaForCausalLM(scfg)
+    sparams = jax.jit(model.init)(jax.random.PRNGKey(3),
+                                  np.zeros((1, 8), np.int32))
+    eng = RaggedInferenceEngineV2(model, params=sparams, max_seqs=2,
+                                  max_seq_len=64, prefill_chunk=8,
+                                  decode_block_size=4,
+                                  quantize_weights="w8a8")
+    assert not eng._unroll_params      # consumed at init
+    qleaves = [l for l in jax.tree_util.tree_leaves(
+        eng.params, is_leaf=lambda x: isinstance(x, QuantizedWeight))
+        if isinstance(l, QuantizedWeight)]
+    n_w8a8 = sum(l.fmt == "w8a8" for l in qleaves)
+    # 2 layers x 5 min-size-eligible block kernels (q/o/gate/up/down;
+    # the tiny GQA k/v fall under min_size) + lm_head — all 2-D after
+    # the unroll.  A stacked tree would leave n_w8a8 == 1 (lm_head only)
+    assert n_w8a8 == 11, [l.fmt for l in qleaves]
+    outs = eng.generate_all(_prompts([5, 9], seed=6), max_new_tokens=5)
+    assert len(outs) == 2
+    for toks in outs.values():
+        assert np.isfinite(toks).all()
+
+
 def test_weight_quant_generate_matches_forward_format(params):
     """v1 generate() under quantization produces tokens consistent with
     its own quantized forward (greedy argmax of the first step)."""

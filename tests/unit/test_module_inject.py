@@ -504,6 +504,69 @@ class TestGPTNeoXConversion:
         np.testing.assert_array_equal(out, ref)
 
 
+class TestBertConversion:
+    """Reference bert.py HFBertLayerPolicy: the encoder class — post-LN
+    blocks, learned positions + token types, tied MLM decoder."""
+
+    def _pair(self, scan_layers=True):
+        hf_cfg = transformers.BertConfig(
+            vocab_size=96, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64, hidden_act="gelu",
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+        hf = transformers.BertForMaskedLM(hf_cfg).eval()
+
+        from deepspeed_tpu.models.bert import BertForMaskedLM, get_config
+
+        cfg = get_config("tinybert", dtype=jnp.float32,
+                         param_dtype=jnp.float32, scan_layers=scan_layers)
+        return hf, BertForMaskedLM(cfg)
+
+    @pytest.mark.parametrize("scan_layers", [True, False])
+    def test_logits_parity_with_transformers(self, scan_layers):
+        hf, ours = self._pair(scan_layers)
+        params = convert_hf_state_dict(ours, hf)
+        ids = np.random.default_rng(13).integers(0, 96, size=(2, 12),
+                                                 dtype=np.int64)
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(ids)).logits.numpy()
+        got = np.asarray(ours.apply(params, jnp.asarray(ids, jnp.int32)))
+        np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+    def test_padding_mask_parity(self):
+        """Bidirectional attention under an HF-style attention_mask."""
+        hf, ours = self._pair(scan_layers=True)
+        params = convert_hf_state_dict(ours, hf)
+        ids = np.random.default_rng(14).integers(0, 96, size=(2, 10),
+                                                 dtype=np.int64)
+        mask = np.ones((2, 10), np.int64)
+        mask[0, 7:] = 0
+        mask[1, 4:] = 0
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(ids),
+                     attention_mask=torch.from_numpy(mask)).logits.numpy()
+        got = np.asarray(ours.apply(params, jnp.asarray(ids, jnp.int32),
+                                    attention_mask=jnp.asarray(mask)))
+        # only non-pad rows are meaningful (HF also computes pads, with
+        # identical masking, so full comparison holds too)
+        np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+    def test_v1_forward_serves(self):
+        """init_inference forward() — the encoder serving path."""
+        import deepspeed_tpu
+
+        hf, ours = self._pair(scan_layers=True)
+        params = convert_hf_state_dict(ours, hf)
+        eng = deepspeed_tpu.init_inference(model=ours, params=params,
+                                           dtype="float32")
+        ids = np.random.default_rng(15).integers(0, 96, size=(1, 9),
+                                                 dtype=np.int64)
+        got = np.asarray(eng.forward(ids.astype(np.int32)))
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(ids)).logits.numpy()
+        np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
 class TestBloomConversion:
     """Reference bloom.py BLOOMLayerPolicy: fused per-head qkv split,
     ALiBi scores, embedding LayerNorm, tied lm_head."""
