@@ -406,8 +406,13 @@ def bench_moe_ep(args) -> None:
         import os as _os
 
         if _os.environ.get("DSTPU_MOE_DIMS"):
-            h, i_, a, kv, n_layers = map(
-                int, _os.environ["DSTPU_MOE_DIMS"].split(","))
+            assert args.size is None, (
+                "DSTPU_MOE_DIMS and --size both set: the preset branch "
+                "would silently discard the env dims — pick one")
+            parts = _os.environ["DSTPU_MOE_DIMS"].split(",")
+            assert len(parts) == 5, (
+                "DSTPU_MOE_DIMS=hidden,intermediate,heads,kv_heads,layers")
+            h, i_, a, kv, n_layers = map(int, parts)
             dims = dict(hidden_size=h, intermediate_size=i_,
                         num_attention_heads=a, num_key_value_heads=kv)
         cfg = get_config("tinymixtral", vocab_size=32000,

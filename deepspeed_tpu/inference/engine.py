@@ -20,6 +20,7 @@ captures a CUDA graph, and defers generation to HF ``generate``.  Here:
 from __future__ import annotations
 
 import dataclasses
+import inspect
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -97,8 +98,16 @@ class InferenceEngine:
                                  else type(model)(
                                      dataclasses.replace(mcfg,
                                                          dtype=self.dtype)))
-            if any(f.name == "max_cache_len"
-                   for f in dataclasses.fields(mcfg)):
+            is_encoder = bool(getattr(mcfg, "is_encoder", False))
+            has_cache = any(f.name == "max_cache_len"
+                            for f in dataclasses.fields(mcfg))
+            if not is_encoder and not has_cache:
+                raise TypeError(
+                    f"{type(mcfg).__name__} has a 'decode' field but no "
+                    "'max_cache_len' and is not marked is_encoder=True — "
+                    "decoder configs need max_cache_len for the KV "
+                    "cache; encoder configs must set is_encoder")
+            if has_cache and not is_encoder:
                 # learned/rotary position tables bound usable positions;
                 # clamp the cache so generate() can't run past them into
                 # silently clamped embedding gathers
@@ -220,24 +229,41 @@ class InferenceEngine:
                 init_cache(self._decode_model, np.zeros((B, S), np.int32)))
         return self._cache_shapes[B]
 
-    def forward(self, input_ids) -> jax.Array:
+    def forward(self, input_ids, attention_mask=None) -> jax.Array:
         """Full-sequence logits (reference ``InferenceEngine.forward``,
-        ``engine.py:554``) — no KV cache, one fused program."""
+        ``engine.py:554``) — no KV cache, one fused program.
+
+        ``attention_mask`` ([B, S], 1 = real token): padding mask for
+        encoder families serving mixed-length padded batches (BERT —
+        without it every query attends to pad keys); decoder models are
+        causal and ignore it."""
         if self._forward_fn is None:
             model = self._plain_model
             wq = getattr(self, "_wq", None)
+            takes_mask = "attention_mask" in inspect.signature(
+                model.__call__).parameters
 
-            def fwd(params, ids):
+            def fwd(params, ids, mask):
                 if wq:
                     from deepspeed_tpu.inference.quantization import \
                         dequantize_param_tree
 
                     params = dequantize_param_tree(params)
-                return self._logits(model.apply({"params": params}, ids))
+                kw = {"attention_mask": mask} if (takes_mask and
+                                                  mask is not None) else {}
+                return self._logits(model.apply({"params": params}, ids,
+                                                **kw))
 
-            self._forward_fn = jax.jit(fwd)
+            self._forward_fn = jax.jit(fwd, static_argnames=())
+            self._forward_takes_mask = takes_mask
+        if attention_mask is not None and not self._forward_takes_mask:
+            logger.warning("forward(): this model takes no "
+                           "attention_mask; ignoring it")
+            attention_mask = None
+        mask = (None if attention_mask is None
+                else jnp.asarray(attention_mask))
         return self._forward_fn(self._live_params(),
-                                jnp.asarray(input_ids))
+                                jnp.asarray(input_ids), mask)
 
     def _live_params(self):
         if self._param_source is not None:

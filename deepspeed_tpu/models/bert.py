@@ -45,7 +45,11 @@ class BertConfig:
     remat_policy: str = "none"
     use_flash_attention: bool = False
     tensor_parallel: bool = False
-    # engine-compat knobs (encoders never decode; asserted off)
+    # engine-compat knobs (encoders never decode; asserted off).
+    # is_encoder is the POSITIVE marker init_inference dispatches on —
+    # a decoder config merely lacking max_cache_len is a config bug,
+    # not an encoder
+    is_encoder: bool = True
     decode: bool = False
     sequence_parallel: str = "none"
     pipeline_stages: int = 1

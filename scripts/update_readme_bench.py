@@ -19,15 +19,16 @@ ROWS = [
     ("2", "2", "GPT-2 760M, ZeRO-2 + fused Adam"),
     ("3", "3", "Llama-1.1B (TinyLlama shape), ZeRO-3, pure-bf16, unrolled"),
     ("4", "4", "Llama ~500M, 8k-sequence (attention-heavy), full remat"),
-    ("5", "5", "Mixtral-style MoE 8x~80M, top-2, active-params MFU, "
-               "sorted dispatch"),
+    ("5", "5", "Mixtral-style MoE 8x~88M (128-dim heads), top-2, "
+               "active-params MFU, sorted dispatch"),
     ("infer", "infer", "GPT-2 125M fused decode loop, batch 32"),
     ("ragged", "ragged", "Continuous batching, paged KV, 64 mixed-length "
                          "requests over 32 slots"),
     ("io", "io", "Native AIO engine, read+write sweep winner"),
-    ("infinity", "infinity", "Llama-2-7B fwd+bwd on ONE 16GB chip "
-                             "(host-streamed params + grads, NVMe "
-                             "moments)"),
+    ("infinity", "infinity", "Llama-2-7B fwd+bwd TFLOPS on ONE 16GB chip "
+                             "(full MEASURED train step: host-streamed "
+                             "params/grads + host-moment buckets; see "
+                             "detail)"),
 ]
 
 START = "<!-- BENCH-TABLE:START (python bench.py --all; scripts/update_readme_bench.py) -->"

@@ -362,6 +362,29 @@ class TestModelBreadth:
                          max_position_embeddings=64)
         self._serve_matches_v1(PhiForCausalLM, cfg, seed=29)
 
+    def test_gptj_ragged_serving(self):
+        """GPT-J (interleaved->half partial rotary, parallel residual)
+        through the ragged paged path."""
+        from deepspeed_tpu.models.gptj import GPTJForCausalLM, get_config
+
+        cfg = get_config("tinygptj", vocab_size=64, dtype=jnp.float32,
+                         param_dtype=jnp.float32, scan_layers=False,
+                         remat=False, use_flash_attention=False,
+                         max_position_embeddings=64)
+        self._serve_matches_v1(GPTJForCausalLM, cfg, seed=31)
+
+    def test_gptneox_ragged_serving(self):
+        """GPT-NeoX (twin-LN parallel residual, qkv+out biases) through
+        the ragged paged path."""
+        from deepspeed_tpu.models.gptneox import (GPTNeoXForCausalLM,
+                                                  get_config)
+
+        cfg = get_config("tinyneox", vocab_size=64, dtype=jnp.float32,
+                         param_dtype=jnp.float32, scan_layers=False,
+                         remat=False, use_flash_attention=False,
+                         max_position_embeddings=64)
+        self._serve_matches_v1(GPTNeoXForCausalLM, cfg, seed=37)
+
 
 class TestOnDemandPaging:
     """Reference blocked-allocator semantics (blocked_allocator.py:1 +

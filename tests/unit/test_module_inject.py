@@ -566,6 +566,28 @@ class TestBertConversion:
             ref = hf(torch.from_numpy(ids)).logits.numpy()
         np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
 
+    def test_v1_forward_padded_batch(self):
+        """The standard encoder workload: mixed-length sequences padded
+        to one width, served with attention_mask through forward() —
+        non-pad logits must match HF under the same mask."""
+        import deepspeed_tpu
+
+        hf, ours = self._pair(scan_layers=True)
+        params = convert_hf_state_dict(ours, hf)
+        eng = deepspeed_tpu.init_inference(model=ours, params=params,
+                                           dtype="float32")
+        ids = np.random.default_rng(16).integers(0, 96, size=(2, 12),
+                                                 dtype=np.int64)
+        mask = np.ones((2, 12), np.int64)
+        mask[0, 8:] = 0
+        mask[1, 5:] = 0
+        got = np.asarray(eng.forward(ids.astype(np.int32),
+                                     attention_mask=mask))
+        with torch.no_grad():
+            ref = hf(torch.from_numpy(ids),
+                     attention_mask=torch.from_numpy(mask)).logits.numpy()
+        np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
 
 class TestBloomConversion:
     """Reference bloom.py BLOOMLayerPolicy: fused per-head qkv split,
