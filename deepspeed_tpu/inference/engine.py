@@ -257,8 +257,11 @@ class InferenceEngine:
             self._forward_fn = jax.jit(fwd, static_argnames=())
             self._forward_takes_mask = takes_mask
         if attention_mask is not None and not self._forward_takes_mask:
-            logger.warning("forward(): this model takes no "
-                           "attention_mask; ignoring it")
+            if not getattr(self, "_mask_warned", False):
+                self._mask_warned = True
+                logger.warning("forward(): this model takes no "
+                               "attention_mask; ignoring it "
+                               "(warning once)")
             attention_mask = None
         mask = (None if attention_mask is None
                 else jnp.asarray(attention_mask))
