@@ -761,7 +761,7 @@ def bench_infinity(args) -> None:
         # TFLOPS number is the link, not the framework (see
         # fwd_bwd_link_fraction in the detail)
         micro = int(os.environ.get("DSTPU_INFINITY_MICRO", "1"))
-        seq = 1024
+        seq = int(os.environ.get("DSTPU_INFINITY_SEQ", "1024"))
     else:
         cfg = get_config("tinyllama", dtype=jnp.float32, remat=False,
                          scan_layers=False)

@@ -91,7 +91,8 @@ def update_kv_cache(mdl, k: jax.Array, v: jax.Array, max_len: int,
 
 def cached_attention(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
                      q_positions: jax.Array, window=None,
-                     k_bias: jax.Array = None) -> jax.Array:
+                     k_bias: jax.Array = None,
+                     scale: float = None) -> jax.Array:
     """Attention of ``q`` [B, H, S, Dh] against the TIME-MAJOR cache
     buffers [L, B, Hkv, Dh], masking key slots beyond each query's
     absolute position.  ``q_positions``: [S] or [B, S] absolute
@@ -101,7 +102,8 @@ def cached_attention(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
     (BLOOM) reduces to this because its per-query shift is constant
     along each softmax row.  Used for decode steps (S=1) and ragged
     chunked prefill; full prefill attends within its chunk via the
-    normal causal kernels.
+    normal causal kernels.  ``scale``: score multiplier (default
+    1/sqrt(Dh); GPT-Neo passes 1.0 — that family trains UNscaled).
     """
     B, H, S, Dh = q.shape
     L, Hkv = k_full.shape[0], k_full.shape[2]
@@ -109,7 +111,9 @@ def cached_attention(q: jax.Array, k_full: jax.Array, v_full: jax.Array,
         rep = H // Hkv
         k_full = jnp.repeat(k_full, rep, axis=2)
         v_full = jnp.repeat(v_full, rep, axis=2)
-    att = jnp.einsum("bhsd,lbhd->bhsl", q, k_full) / np.sqrt(Dh)
+    if scale is None:
+        scale = 1.0 / np.sqrt(Dh)
+    att = jnp.einsum("bhsd,lbhd->bhsl", q, k_full) * scale
     if k_bias is not None:
         att = att + k_bias[None, :, None, :].astype(att.dtype)
     qpos = q_positions if q_positions.ndim == 2 else q_positions[None]

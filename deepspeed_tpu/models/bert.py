@@ -62,6 +62,16 @@ class BertConfig:
         return self.hidden_size // self.num_attention_heads
 
 
+@dataclasses.dataclass(frozen=True)
+class DistilBertConfig(BertConfig):
+    """DistilBERT: BERT-shaped minus token types (reference
+    ``module_inject/containers/distil_bert.py`` HFDistilBertLayerPolicy).
+    Served by the SAME modules — the converter zeroes the (size-1)
+    token-type table and maps ``distilbert.*``/``vocab_*`` names."""
+
+    type_vocab_size: int = 1
+
+
 PRESETS = {
     "bert-base-uncased": dict(),
     "bert-large-uncased": dict(hidden_size=1024, num_hidden_layers=24,
@@ -70,13 +80,21 @@ PRESETS = {
     "tinybert": dict(vocab_size=96, hidden_size=32, num_hidden_layers=2,
                      num_attention_heads=4, intermediate_size=64,
                      max_position_embeddings=64),
+    "distilbert-base": dict(num_hidden_layers=6, layer_norm_eps=1e-12),
+    "tinydistil": dict(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                       num_attention_heads=4, intermediate_size=64,
+                       max_position_embeddings=64),
 }
+
+_DISTIL = ("distilbert-base", "tinydistil")
 
 
 def get_config(preset: str, **overrides) -> BertConfig:
     kw = dict(PRESETS[preset])
     kw.update(overrides)
     kw.setdefault("dtype", jnp.bfloat16)
+    if preset in _DISTIL:
+        return DistilBertConfig(**kw)
     return BertConfig(**kw)
 
 

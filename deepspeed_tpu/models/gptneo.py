@@ -1,0 +1,245 @@
+"""GPT-Neo model family (EleutherAI 125M–2.7B lineage).
+
+Reference injects GPT-Neo through its v1 policy
+(``module_inject/containers/gptneo.py`` HFGPTNEOLayerPolicy: separate
+q/k/v linears, GPT-2-shaped block).  Architecture quirks this module
+reproduces exactly: attention scores are NOT scaled by 1/sqrt(d) (the
+models were trained that way), attention alternates GLOBAL and LOCAL
+(256-token sliding window) layers, q/k/v projections carry no bias
+while the out projection does, learned absolute positions, GELU(tanh)
+MLP, tied LM head.
+
+Layers alternate two attention types, so blocks are heterogeneous —
+this family runs UNROLLED (``scan_layers`` is rejected; the 125M–2.7B
+shapes unroll fine), pre-LN like GPT-2.  Serves through v1
+``init_inference`` (KV-cache decode honors the local window via the
+shared ``cached_attention`` window mask).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTNeoConfig:
+    vocab_size: int = 50257
+    max_position_embeddings: int = 2048
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 0             # 0 -> 4 * hidden
+    window_size: int = 256
+    # per-layer pattern, cycled over layers (HF attention_types)
+    attention_layers: Tuple[str, ...] = ("global", "local")
+    layer_norm_epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    scan_layers: bool = False
+    remat: bool = False
+    remat_policy: str = "none"
+    use_flash_attention: bool = False
+    tensor_parallel: bool = False
+    sequence_parallel: str = "none"
+    pipeline_stages: int = 1
+    decode: bool = False
+    max_cache_len: int = 0
+
+    def __post_init__(self):
+        assert not self.scan_layers, (
+            "GPT-Neo alternates global/local attention layers — blocks "
+            "are heterogeneous, so scan-over-layers cannot apply; use "
+            "scan_layers=False")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    def layer_kind(self, i: int) -> str:
+        return self.attention_layers[i % len(self.attention_layers)]
+
+
+PRESETS = {
+    "gpt-neo-125m": dict(hidden_size=768, num_hidden_layers=12,
+                         num_attention_heads=12),
+    "gpt-neo-1.3b": dict(hidden_size=2048, num_hidden_layers=24,
+                         num_attention_heads=16),
+    "gpt-neo-2.7b": dict(hidden_size=2560, num_hidden_layers=32,
+                         num_attention_heads=20),
+    "tinyneo": dict(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=64,
+                    window_size=8),
+}
+
+
+def get_config(preset: str, **overrides) -> GPTNeoConfig:
+    kw = dict(PRESETS[preset])
+    kw.update(overrides)
+    kw.setdefault("dtype", jnp.bfloat16)
+    return GPTNeoConfig(**kw)
+
+
+def _tp(cfg, kind: str):
+    from deepspeed_tpu.parallel.tensor_parallel import tp_dense_kwargs
+
+    return tp_dense_kwargs(cfg.tensor_parallel, kind)
+
+
+class GPTNeoAttention(nn.Module):
+    """Unscaled dot-product attention, global or 256-window local."""
+
+    config: GPTNeoConfig
+    kind: str = "global"
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        B, S, E = x.shape
+        H, Dh = cfg.num_attention_heads, cfg.head_dim
+        proj = dict(use_bias=False, dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype)
+        q = nn.Dense(E, name="q_proj", **proj, **_tp(cfg, "col"))(x)
+        k = nn.Dense(E, name="k_proj", **proj, **_tp(cfg, "col"))(x)
+        v = nn.Dense(E, name="v_proj", **proj, **_tp(cfg, "col"))(x)
+
+        def heads(t):
+            return t.reshape(B, S, H, Dh).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        window = cfg.window_size if self.kind == "local" else None
+        out = dict(use_bias=True, dtype=cfg.dtype,
+                   param_dtype=cfg.param_dtype)
+        if cfg.decode:
+            from deepspeed_tpu.inference.kv_cache import (cached_attention,
+                                                          update_kv_cache)
+
+            max_len = cfg.max_cache_len or cfg.max_position_embeddings
+            k_full, v_full, start = update_kv_cache(self, k, v, max_len)
+            if S == 1:
+                y = cached_attention(q, k_full, v_full,
+                                     (start + jnp.arange(S))[None],
+                                     window=window, scale=1.0)
+                y = y.transpose(0, 2, 1, 3).reshape(B, S, E)
+                return nn.Dense(E, name="out_proj", **out,
+                                **_tp(cfg, "row"))(y)
+            # prefill: cache written; attend within the chunk below
+        # scores deliberately UNscaled (scale=1): GPT-Neo trains without
+        # the 1/sqrt(d) factor, fp32 softmax
+        att = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+        pos = jnp.arange(S)
+        keep = pos[None, :] <= pos[:, None]
+        if window is not None:
+            keep &= pos[None, :] > pos[:, None] - window
+        att = jnp.where(keep[None, None], att, jnp.finfo(jnp.float32).min)
+        att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
+        y = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+        y = y.transpose(0, 2, 1, 3).reshape(B, S, E)
+        return nn.Dense(E, name="out_proj", **out, **_tp(cfg, "row"))(y)
+
+
+class GPTNeoMLP(nn.Module):
+    config: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dense = dict(use_bias=True, dtype=cfg.dtype,
+                     param_dtype=cfg.param_dtype)
+        h = nn.Dense(cfg.ffn_dim, name="c_fc", **dense,
+                     **_tp(cfg, "col"))(x)
+        h = jax.nn.gelu(h.astype(jnp.float32), approximate=True).astype(
+            cfg.dtype)
+        return nn.Dense(cfg.hidden_size, name="c_proj", **dense,
+                        **_tp(cfg, "row"))(h)
+
+
+class GPTNeoBlock(nn.Module):
+    config: GPTNeoConfig
+    kind: str = "global"
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        ln = dict(epsilon=cfg.layer_norm_epsilon, dtype=cfg.dtype,
+                  param_dtype=jnp.float32)
+        x = x + GPTNeoAttention(cfg, self.kind, name="attn")(
+            nn.LayerNorm(name="ln_1", **ln)(x), deterministic)
+        return x + GPTNeoMLP(cfg, name="mlp")(
+            nn.LayerNorm(name="ln_2", **ln)(x))
+
+
+class GPTNeoModel(nn.Module):
+    config: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, deterministic: bool = True,
+                 ragged_meta=None):
+        from deepspeed_tpu.models.gpt2 import _maybe_remat
+        from deepspeed_tpu.parallel.tensor_parallel import tp_embed_kwargs
+
+        cfg = self.config
+        B, S = input_ids.shape
+        emb = tp_embed_kwargs(cfg.tensor_parallel)
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       param_dtype=cfg.param_dtype, name="wte", **emb)
+        wpe = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                       dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                       name="wpe", **emb)
+        if positions is None:
+            positions = jnp.arange(S)[None, :]
+        x = wte(input_ids) + wpe(positions)
+        block_cls = _maybe_remat(GPTNeoBlock, cfg)
+        for i in range(cfg.num_hidden_layers):
+            x = block_cls(cfg, cfg.layer_kind(i), name=f"h_{i}")(
+                x, deterministic)
+        x = nn.LayerNorm(name="ln_f", epsilon=cfg.layer_norm_epsilon,
+                         dtype=cfg.dtype, param_dtype=jnp.float32)(x)
+        return wte.attend(x)                        # tied head
+
+
+class GPTNeoForCausalLM(nn.Module):
+    config: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, deterministic: bool = True,
+                 ragged_meta=None):
+        return GPTNeoModel(self.config, name="transformer")(
+            input_ids, positions, deterministic, ragged_meta)
+
+
+class GPTNeoLMLoss(nn.Module):
+    """``module(batch) -> scalar`` next-token CE (engine contract)."""
+
+    config: GPTNeoConfig
+
+    @nn.compact
+    def __call__(self, batch):
+        from deepspeed_tpu.models.gpt2 import next_token_loss
+
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        logits = GPTNeoForCausalLM(self.config, name="lm")(input_ids)
+        return next_token_loss(logits, input_ids)
+
+
+def count_params(params) -> int:
+    return sum(int(np.prod(p.shape))
+               for p in jax.tree_util.tree_leaves(params))
+
+
+def flops_per_token(cfg: GPTNeoConfig,
+                    seq_len: Optional[int] = None) -> float:
+    E, I, L = cfg.hidden_size, cfg.ffn_dim, cfg.num_hidden_layers
+    per_layer = 4 * E * E + 2 * E * I
+    n = L * per_layer + cfg.vocab_size * E
+    s = seq_len or cfg.max_position_embeddings
+    attn = 12 * L * E * s
+    return 6.0 * n + attn

@@ -13,9 +13,10 @@ AutoTP sharding places them across the mesh (the TP half of the
 reference's injection policies).
 
 Supported families: GPT-2, Llama, Mistral, Qwen2, Mixtral, Phi,
-Phi-3, Qwen2-MoE, Falcon, OPT, GPT-J, BLOOM, GPT-NeoX (matching
-``models/*.py``; the reference v2 model zoo plus the v1-only
-bloom/gptj/gptneox injection class).  Sources: a dict of tensors, an HF
+Phi-3, Qwen2-MoE, Falcon, OPT, GPT-J, BLOOM, GPT-NeoX, GPT-Neo,
+BERT, DistilBERT (matching ``models/*.py``; the reference v2 model
+zoo plus the v1 injection zoo's decoder AND encoder classes —
+bloom/gptj/gptneo/gptneox and bert/distil_bert).  Sources: a dict of tensors, an HF
 ``transformers`` model object, or a directory holding
 ``pytorch_model.bin`` / sharded ``pytorch_model-*.bin`` /
 ``model.safetensors``.
@@ -653,6 +654,103 @@ def _convert_bert(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     return _nest(flat)
 
 
+def _convert_gptneo(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """GPT-Neo (reference ``module_inject/containers/gptneo.py``
+    HFGPTNEOLayerPolicy): separate biasless q/k/v + biased out_proj,
+    GPT-2-shaped pre-LN block, tied head (no separate lm_head param —
+    our module attends the embedding)."""
+    sd = _strip_prefix(sd, "transformer.")
+    L = cfg.num_hidden_layers
+    layers = []
+    for i in range(L):
+        p = f"h.{i}."
+        a = p + "attn.attention."
+        layers.append({
+            "ln_1/scale": sd[p + "ln_1.weight"],
+            "ln_1/bias": sd[p + "ln_1.bias"],
+            "attn/q_proj/kernel": sd[a + "q_proj.weight"].T,
+            "attn/k_proj/kernel": sd[a + "k_proj.weight"].T,
+            "attn/v_proj/kernel": sd[a + "v_proj.weight"].T,
+            "attn/out_proj/kernel": sd[a + "out_proj.weight"].T,
+            "attn/out_proj/bias": sd[a + "out_proj.bias"],
+            "ln_2/scale": sd[p + "ln_2.weight"],
+            "ln_2/bias": sd[p + "ln_2.bias"],
+            "mlp/c_fc/kernel": sd[p + "mlp.c_fc.weight"].T,
+            "mlp/c_fc/bias": sd[p + "mlp.c_fc.bias"],
+            "mlp/c_proj/kernel": sd[p + "mlp.c_proj.weight"].T,
+            "mlp/c_proj/bias": sd[p + "mlp.c_proj.bias"],
+        })
+    head = sd.get("lm_head.weight")
+    if head is not None and not np.allclose(head, sd["wte.weight"],
+                                            atol=1e-6):
+        # our module always ties (wte.attend); converting an untied
+        # fine-tune silently would serve wrong logits
+        raise ValueError(
+            "GPT-Neo checkpoint carries an UNTIED lm_head.weight; this "
+            "module only represents the tied head (every released "
+            "EleutherAI GPT-Neo ties) — retie the head or extend "
+            "GPTNeoModel with an untied lm_head first")
+    flat = {
+        "transformer/wte/embedding": sd["wte.weight"],
+        "transformer/wpe/embedding": sd["wpe.weight"],
+        "transformer/ln_f/scale": sd["ln_f.weight"],
+        "transformer/ln_f/bias": sd["ln_f.bias"],
+    }
+    _place_layers(flat, layers, cfg, prefix="transformer/h")
+    return _nest(flat)
+
+
+def _convert_distilbert(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+    """DistilBERT (reference ``containers/distil_bert.py``): BERT-shaped
+    minus token types — maps onto the BERT modules with a zeroed
+    size-1 token-type table; ``vocab_*`` MLM head, projector tied to
+    word_embeddings (dedup-safe .get)."""
+    L, E = cfg.num_hidden_layers, cfg.hidden_size
+    layers = []
+    for i in range(L):
+        p = f"distilbert.transformer.layer.{i}."
+        layers.append({
+            "attention/query/kernel": sd[p + "attention.q_lin.weight"].T,
+            "attention/query/bias": sd[p + "attention.q_lin.bias"],
+            "attention/key/kernel": sd[p + "attention.k_lin.weight"].T,
+            "attention/key/bias": sd[p + "attention.k_lin.bias"],
+            "attention/value/kernel": sd[p + "attention.v_lin.weight"].T,
+            "attention/value/bias": sd[p + "attention.v_lin.bias"],
+            "attention_output/kernel":
+                sd[p + "attention.out_lin.weight"].T,
+            "attention_output/bias": sd[p + "attention.out_lin.bias"],
+            "attention_layernorm/scale": sd[p + "sa_layer_norm.weight"],
+            "attention_layernorm/bias": sd[p + "sa_layer_norm.bias"],
+            "intermediate/kernel": sd[p + "ffn.lin1.weight"].T,
+            "intermediate/bias": sd[p + "ffn.lin1.bias"],
+            "output/kernel": sd[p + "ffn.lin2.weight"].T,
+            "output/bias": sd[p + "ffn.lin2.bias"],
+            "output_layernorm/scale":
+                sd[p + "output_layer_norm.weight"],
+            "output_layernorm/bias": sd[p + "output_layer_norm.bias"],
+        })
+    wte = sd["distilbert.embeddings.word_embeddings.weight"]
+    flat = {
+        "bert/word_embeddings/embedding": wte,
+        "bert/position_embeddings/embedding":
+            sd["distilbert.embeddings.position_embeddings.weight"],
+        "bert/token_type_embeddings/embedding":
+            np.zeros((cfg.type_vocab_size, E), wte.dtype),
+        "bert/embeddings_layernorm/scale":
+            sd["distilbert.embeddings.LayerNorm.weight"],
+        "bert/embeddings_layernorm/bias":
+            sd["distilbert.embeddings.LayerNorm.bias"],
+        "transform/kernel": sd["vocab_transform.weight"].T,
+        "transform/bias": sd["vocab_transform.bias"],
+        "transform_layernorm/scale": sd["vocab_layer_norm.weight"],
+        "transform_layernorm/bias": sd["vocab_layer_norm.bias"],
+        "decoder/kernel": sd.get("vocab_projector.weight", wte).T,
+        "decoder/bias": sd["vocab_projector.bias"],
+    }
+    _place_layers(flat, layers, cfg, prefix="bert/layer")
+    return _nest(flat)
+
+
 _CONVERTERS = {
     "GPT2Config": _convert_gpt2,
     "LlamaConfig": _convert_llama,
@@ -679,8 +777,13 @@ _CONVERTERS = {
     # GPT-NeoX: fused per-head qkv + parallel residual, half-layout
     # rotary (reference containers/gptneox.py)
     "GPTNeoXConfig": _convert_gptneox,
-    # BERT: the encoder class (reference containers/bert.py)
+    # BERT: the encoder class (reference containers/bert.py);
+    # DistilBERT maps onto the same modules (containers/distil_bert.py);
+    # GPT-Neo: unscaled attention + global/local alternation
+    # (containers/gptneo.py)
     "BertConfig": _convert_bert,
+    "DistilBertConfig": _convert_distilbert,
+    "GPTNeoConfig": _convert_gptneo,
 }
 
 
