@@ -55,6 +55,16 @@ class GPTNeoConfig:
             "GPT-Neo alternates global/local attention layers — blocks "
             "are heterogeneous, so scan-over-layers cannot apply; use "
             "scan_layers=False")
+        # accept-and-ignore would silently change perf/memory behavior:
+        # the flash kernel hardcodes 1/sqrt(d) scaling (GPT-Neo is
+        # UNscaled) and neither SP nor pipeline is wired for this family
+        assert not self.use_flash_attention, (
+            "GPT-Neo attention is unscaled; the flash kernel applies "
+            "1/sqrt(d) — unsupported for this family")
+        assert self.sequence_parallel == "none", (
+            "sequence parallelism is not wired for GPT-Neo")
+        assert self.pipeline_stages <= 1, (
+            "pipeline parallelism is not wired for GPT-Neo")
 
     @property
     def head_dim(self) -> int:
