@@ -56,11 +56,11 @@ class GPTNeoConfig:
             "are heterogeneous, so scan-over-layers cannot apply; use "
             "scan_layers=False")
         # accept-and-ignore would silently change perf/memory behavior:
-        # the flash kernel hardcodes 1/sqrt(d) scaling (GPT-Neo is
-        # UNscaled) and neither SP nor pipeline is wired for this family
-        assert not self.use_flash_attention, (
-            "GPT-Neo attention is unscaled; the flash kernel applies "
-            "1/sqrt(d) — unsupported for this family")
+        # neither SP nor pipeline is wired for this family.  Flash IS
+        # supported on the GLOBAL layers (the kernel takes sm_scale=1.0
+        # for the family's unscaled scores); the 256-token LOCAL layers
+        # keep the dense windowed mask — the kernel has no window
+        # support
         assert self.sequence_parallel == "none", (
             "sequence parallelism is not wired for GPT-Neo")
         assert self.pipeline_stages <= 1, (
@@ -143,15 +143,25 @@ class GPTNeoAttention(nn.Module):
                                 **_tp(cfg, "row"))(y)
             # prefill: cache written; attend within the chunk below
         # scores deliberately UNscaled (scale=1): GPT-Neo trains without
-        # the 1/sqrt(d) factor, fp32 softmax
-        att = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
-        pos = jnp.arange(S)
-        keep = pos[None, :] <= pos[:, None]
-        if window is not None:
-            keep &= pos[None, :] > pos[:, None] - window
-        att = jnp.where(keep[None, None], att, jnp.finfo(jnp.float32).min)
-        att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
-        y = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+        # the 1/sqrt(d) factor, fp32 softmax.  Local layers whose window
+        # does not bind (S <= window: windowed mask == plain causal) use
+        # flash too — the llama.py sliding-window precedent
+        if cfg.use_flash_attention and (window is None or S <= window):
+            from deepspeed_tpu.ops.flash_attention import flash_attention
+
+            y = flash_attention(q, k, v, causal=True, sm_scale=1.0)
+        else:
+            att = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+            pos = jnp.arange(S)
+            keep = pos[None, :] <= pos[:, None]
+            if window is not None:
+                # local layers always take the dense windowed mask (the
+                # flash kernel has no sliding-window support)
+                keep &= pos[None, :] > pos[:, None] - window
+            att = jnp.where(keep[None, None], att,
+                            jnp.finfo(jnp.float32).min)
+            att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
+            y = jnp.einsum("bhqk,bhkd->bhqd", att, v)
         y = y.transpose(0, 2, 1, 3).reshape(B, S, E)
         return nn.Dense(E, name="out_proj", **out, **_tp(cfg, "row"))(y)
 

@@ -608,9 +608,19 @@ class TestGPTNeoConversion:
                          param_dtype=jnp.float32)
         return hf, GPTNeoForCausalLM(cfg)
 
-    def test_logits_parity_with_transformers(self):
+    @pytest.mark.parametrize("flash", [False, True])
+    def test_logits_parity_with_transformers(self, flash):
+        """flash=True runs the kernel (sm_scale=1.0, unscaled scores) on
+        the GLOBAL layers; local-window layers keep the dense mask."""
         hf, ours = self._pair()
         params = convert_hf_state_dict(ours, hf)
+        if flash:
+            import dataclasses
+
+            from deepspeed_tpu.models.gptneo import GPTNeoForCausalLM
+
+            ours = GPTNeoForCausalLM(dataclasses.replace(
+                ours.config, use_flash_attention=True))
         # long enough that the local layer's window=8 actually clips
         ids = np.random.default_rng(17).integers(0, 96, size=(2, 16),
                                                  dtype=np.int64)
