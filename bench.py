@@ -493,7 +493,9 @@ def bench_inference(args) -> None:
         cfg = get_config(args.size or "gpt2-125m", n_positions=1024,
                          dtype=jnp.bfloat16, scan_layers=True, remat=False,
                          use_flash_attention=True, decode=True)
-        bsz, prompt, new = 32, 128, 128
+        # bs=64 measured 20.6k vs 19.3k tok/s at bs=32 on v5e (decode
+        # tick cost is nearly flat in batch — concurrency is pure win)
+        bsz, prompt, new = 64, 128, 128
     else:
         cfg = get_config("gpt2-125m", n_positions=128, n_embd=256,
                          n_layer=4, n_head=4, dtype=jnp.float32,
@@ -517,9 +519,11 @@ def bench_inference(args) -> None:
         "metric": "gpt2_125m_decode_tokens_per_sec",
         "value": round(tps, 1),
         "unit": "tokens/s",
-        # floor = this config's round-4 result (BENCH_MATRIX r4: 19305.7
-        # tok/s device) — serving must not regress round over round
-        "vs_baseline": round(tps / 19305.7, 3) if on_tpu else 0.0,
+        # floor = this config's round-5 result AT batch 64 (20552.8
+        # tok/s device; the old 19305.7 floor was measured at batch 32
+        # and no longer compares like-for-like) — serving must not
+        # regress round over round
+        "vs_baseline": round(tps / 20552.8, 3) if on_tpu else 0.0,
         "detail": {"batch": bsz, "prompt": prompt, "new_tokens": new,
                    "tokens_per_sec_per_chip": round(tps / n_chips, 1),
                    "wall_tokens_per_sec": round(bsz * new / wall_dt, 1),

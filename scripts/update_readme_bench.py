@@ -21,7 +21,7 @@ ROWS = [
     ("4", "4", "Llama ~500M, 8k-sequence (attention-heavy), full remat"),
     ("5", "5", "Mixtral-style MoE 8x~88M (128-dim heads), top-2, "
                "active-params MFU, sorted dispatch"),
-    ("infer", "infer", "GPT-2 125M fused decode loop, batch 32"),
+    ("infer", "infer", "GPT-2 125M fused decode loop, batch {infer_batch}"),
     ("ragged", "ragged", "Continuous batching, paged KV, 64 mixed-length "
                          "requests over 32 slots"),
     ("io", "io", "Native AIO engine, read+write sweep winner"),
@@ -56,7 +56,10 @@ def main() -> None:
              + (" (SMOKE — not representative)" if matrix.get("smoke")
                 else "") + ":", "",
              "| Config | Model / mode | Result |", "|---|---|---|"]
+    infer_batch = (cfgs.get("infer", {}).get("detail", {})
+                   .get("batch", "?"))
     for label, key, desc in ROWS:
+        desc = desc.format(infer_batch=infer_batch)
         lines.append(f"| {label} | {desc} | {fmt(cfgs.get(key))} |")
     lines.append(END)
     block = "\n".join(lines)

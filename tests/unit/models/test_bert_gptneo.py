@@ -1,0 +1,103 @@
+"""Engine-training coverage for the round-5 families: BERT's masked-LM
+loss module and GPT-Neo's heterogeneous (global/local) blocks both
+train through ``deepspeed_tpu.initialize`` on a sharded mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+
+DS = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 2,
+      "zero_optimization": {"stage": 2},
+      "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+      "steps_per_print": 1000000}
+
+
+@pytest.fixture
+def mesh(devices):
+    from deepspeed_tpu.comm import comm as _comm
+    _comm._state.topology = None
+    return dist.initialize_mesh(dp=4, tp=2, devices=devices)
+
+
+def test_bert_mlm_trains_on_mesh(mesh):
+    """Masked-LM objective: only label!=-100 positions contribute; the
+    loss falls over steps on a dp=4 x tp=2 mesh."""
+    from deepspeed_tpu.models.bert import BertMLMLoss, get_config
+
+    cfg = get_config("tinybert", dtype=jnp.float32, param_dtype=jnp.float32,
+                     scan_layers=True, tensor_parallel=True)
+    r = np.random.default_rng(0)
+    ids = r.integers(0, 96, (8, 16), dtype=np.int32)
+    labels = ids.copy()
+    labels[~(r.random((8, 16)) < 0.2)] = -100
+    assert (labels != -100).any()
+    batch = {"input_ids": ids, "labels": labels}
+    eng, *_ = deepspeed_tpu.initialize(
+        model=BertMLMLoss(cfg), config=DS, topology=mesh,
+        example_batch={"input_ids": ids[:1], "labels": labels[:1]},
+        rng=jax.random.PRNGKey(0))
+    losses = [float(eng.train_batch(batch=batch)) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_bert_mlm_loss_is_masked_ce():
+    """The MLM loss equals hand-computed mean CE over EXACTLY the
+    label!=-100 positions (the HF masking convention)."""
+    from deepspeed_tpu.models.bert import (BertForMaskedLM, BertMLMLoss,
+                                           get_config)
+
+    cfg = get_config("tinybert", dtype=jnp.float32, param_dtype=jnp.float32,
+                     scan_layers=True)
+    model = BertMLMLoss(cfg)
+    r = np.random.default_rng(1)
+    ids = r.integers(0, 96, (2, 12), dtype=np.int32)
+    labels = np.full_like(ids, -100)
+    labels[0, 3] = ids[0, 3]
+    labels[1, 7] = (ids[1, 7] + 1) % 96          # a wrong label counts too
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 {"input_ids": ids, "labels": labels})
+    got = float(model.apply(params, {"input_ids": ids, "labels": labels}))
+
+    logits = np.asarray(BertForMaskedLM(cfg).apply(
+        {"params": params["params"]["mlm"]}, ids))
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    want = -(logp[0, 3, labels[0, 3]] + logp[1, 7, labels[1, 7]]) / 2
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_gptneo_trains_on_mesh(mesh):
+    """Heterogeneous global/local blocks (unrolled) through ZeRO-2 + TP."""
+    from deepspeed_tpu.models.gptneo import GPTNeoLMLoss, get_config
+
+    cfg = get_config("tinyneo", dtype=jnp.float32, param_dtype=jnp.float32,
+                     tensor_parallel=True)
+    r = np.random.default_rng(2)
+    batch = {"input_ids": r.integers(0, 96, (8, 16), dtype=np.int32)}
+    eng, *_ = deepspeed_tpu.initialize(
+        model=GPTNeoLMLoss(cfg), config=DS, topology=mesh,
+        example_batch={"input_ids": batch["input_ids"][:1]},
+        rng=jax.random.PRNGKey(0))
+    losses = [float(eng.train_batch(batch=batch)) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_gptneo_local_window_differs_from_global():
+    """The local layers' window must actually bind: logits at positions
+    beyond the window differ when the window is widened (same params)."""
+    import dataclasses
+
+    from deepspeed_tpu.models.gptneo import GPTNeoForCausalLM, get_config
+
+    cfg = get_config("tinyneo", dtype=jnp.float32, param_dtype=jnp.float32)
+    model = GPTNeoForCausalLM(cfg)
+    ids = np.arange(2, 18, dtype=np.int32)[None]        # 16 > window 8
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), ids)
+    wide = GPTNeoForCausalLM(dataclasses.replace(cfg, window_size=64))
+    a = np.asarray(model.apply(params, ids))
+    b = np.asarray(wide.apply(params, ids))
+    # early positions (within window) agree; late positions diverge
+    np.testing.assert_allclose(a[0, :8], b[0, :8], atol=1e-5)
+    assert np.abs(a[0, -1] - b[0, -1]).max() > 1e-6
