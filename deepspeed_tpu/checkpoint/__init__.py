@@ -2,6 +2,7 @@ from deepspeed_tpu.checkpoint.engine import (load_checkpoint,
                                               save_16bit_model,
                                               save_checkpoint,
                                               wait_checkpoint, zero_to_fp32)
+from deepspeed_tpu.checkpoint.sharded import verify_tag
 
 __all__ = ["save_checkpoint", "load_checkpoint", "wait_checkpoint",
-           "save_16bit_model", "zero_to_fp32"]
+           "save_16bit_model", "zero_to_fp32", "verify_tag"]

@@ -18,13 +18,16 @@ from __future__ import annotations
 import json
 import os
 import pickle
-from typing import Any, Dict, Optional, Tuple
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.checkpoint import sharded
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.resilience.retry import retriable
 from deepspeed_tpu.runtime.train_state import TrainState
 from deepspeed_tpu.utils.logging import log_dist, logger
 
@@ -32,6 +35,8 @@ MODEL_FILE = "model_states.pt"          # legacy consolidated format
 EXTRA_FILE = "extra_states.pt"          # scalars + lr scheduler + client
 META_FILE = "ds_meta.json"
 LATEST_FILE = "latest"
+STAGING_PREFIX = "tmp."                 # uncommitted tag being written
+CORRUPT_SUFFIX = ".corrupt"             # quarantined tag
 
 
 def _tag_of(engine, tag: Optional[str]) -> str:
@@ -51,27 +56,34 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     """Sharded save.  Each process writes only its addressable shards
     (never the consolidated state); with ``async_save`` (default from
     ``checkpoint.async_save`` config) file IO runs on a background thread
-    and :func:`wait_checkpoint` / the next save joins it."""
+    and :func:`wait_checkpoint` / the next save joins it.
+
+    Hardened commit protocol (resilience/): everything is written into a
+    ``tmp.<tag>`` staging directory and the tag becomes visible only via
+    an atomic ``os.rename`` once every process's shards are down — a
+    crash at ANY point leaves either the previous state or a complete
+    new tag, never a partially-written visible one."""
     if async_save is None:
         async_save = engine.config.checkpoint.async_save
     tag = _tag_of(engine, tag)
     path = os.path.join(save_dir, tag)
-    os.makedirs(path, exist_ok=True)
+    stage = os.path.join(save_dir, STAGING_PREFIX + tag)
 
     _saver(engine).wait()                     # one in-flight save at a time
-    legacy = os.path.join(path, MODEL_FILE)
-    if os.path.exists(legacy) and jax.process_index() == 0:
-        os.remove(legacy)                     # would shadow the new format
+    if jax.process_index() == 0 and os.path.isdir(stage):
+        # leftover staging from a crashed save of the same tag
+        shutil.rmtree(stage, ignore_errors=True)
+    os.makedirs(stage, exist_ok=True)
     # async: copy shards to host up front (training mutates/donates the
     # state buffers); sync: stream shard-by-shard, bounded host memory
     snap = sharded.save_tree(
         {"module": engine.state.params, "optimizer": engine.state.opt_state},
-        path, materialize=bool(async_save))
+        stage, materialize=bool(async_save))
     if getattr(engine, "nvme_swapper", None) is not None:
         # NVMe-swapped moments already live on disk: checkpointing them is
         # a file copy (reference engine.py:3277 copies offloaded state
         # alongside)
-        engine.nvme_swapper.save_to(path)
+        engine.nvme_swapper.save_to(stage)
     extra = {
         "loss_scale": jax.device_get(engine.state.scale),
         "step": int(jax.device_get(engine.state.step)),
@@ -92,19 +104,21 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "dtype": str(engine.compute_dtype.__name__),
     }
 
+    keep_last_k = engine.config.resilience.keep_last_k
+    process_count = jax.process_count()
+
     def finish():
         sharded.write_snapshot(snap)
         if jax.process_index() == 0:
-            with open(os.path.join(path, EXTRA_FILE), "wb") as f:
-                pickle.dump(extra, f)
-            with open(os.path.join(path, META_FILE), "w") as f:
-                json.dump(meta, f, indent=2)
-            if save_latest:
-                # completeness is signalled by per-process done markers
-                # (sharded.is_complete), not by this pointer: other
-                # processes may still be writing their shards
-                with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                    f.write(tag)
+            _write_pickle(os.path.join(stage, EXTRA_FILE), extra)
+            _write_json(os.path.join(stage, META_FILE), meta)
+            _commit_tag(save_dir, tag, process_count,
+                        save_latest=save_latest, keep_last_k=keep_last_k)
+        else:
+            # a save only "returns" once the tag is VISIBLE: without
+            # this barrier a non-zero process could try to resume before
+            # process 0's commit rename lands
+            _await_commit(save_dir, tag)
 
     if async_save:
         _saver(engine).submit(finish)
@@ -116,9 +130,203 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     return path
 
 
+@retriable(retry_on=(OSError,))
+def _write_pickle(path: str, obj) -> None:
+    with open(path, "wb") as f:
+        pickle.dump(obj, f)
+        sharded._fsync_file(f)
+
+
+@retriable(retry_on=(OSError,))
+def _write_json(path: str, obj) -> None:
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=2)
+        sharded._fsync_file(f)
+
+
+def _commit_tag(save_dir: str, tag: str, process_count: int,
+                save_latest: bool, keep_last_k: int) -> None:
+    """Atomically publish ``tmp.<tag>`` as ``<tag>`` (process 0 only),
+    update ``latest``, and garbage-collect old tags.  Waits for every
+    process's done marker first — the rename is the commit point."""
+    stage = os.path.join(save_dir, STAGING_PREFIX + tag)
+    final = os.path.join(save_dir, tag)
+    from deepspeed_tpu.resilience.retry import _sleep
+
+    for _ in range(10_000):                  # bounded multi-host wait
+        if sharded.is_complete(stage, process_count):
+            break
+        _sleep(0.05)
+    else:
+        raise RuntimeError(
+            f"commit of {tag!r}: not all {process_count} processes "
+            "finished writing their shards (crashed peer?)")
+    faults.hook("ckpt.commit", tag=tag)
+    if os.path.isdir(final):
+        # re-saving an existing tag: replace it.  (Not crash-atomic for
+        # the overwrite case — new-tag saves, the training-loop path,
+        # are.)
+        shutil.rmtree(final)
+    os.rename(stage, final)
+    sharded.fsync_dir(save_dir)
+    if save_latest:
+        # the pointer is written AFTER the commit and via rename, so it
+        # never names a tag that does not fully exist
+        tmp = os.path.join(save_dir, LATEST_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            f.write(tag)
+            sharded._fsync_file(f)
+        os.replace(tmp, os.path.join(save_dir, LATEST_FILE))
+    if keep_last_k > 0:
+        _gc_tags(save_dir, keep_last_k)
+
+
+def _await_commit(save_dir: str, tag: str, attempts: int = 10_000) -> None:
+    """Non-zero processes: block until process 0's commit rename makes
+    ``tag`` visible (bounded — a dead process 0 must not hang peers
+    forever)."""
+    from deepspeed_tpu.resilience.retry import _sleep
+
+    final = os.path.join(save_dir, tag)
+    for _ in range(attempts):
+        if os.path.isdir(final):
+            return
+        _sleep(0.05)
+    raise RuntimeError(
+        f"save of {tag!r}: process 0 never committed the tag "
+        "(crashed before the rename?)")
+
+
+def _committed_tags(ckpt_dir: str) -> List[str]:
+    """Visible (committed) tag names under ``ckpt_dir``, newest first.
+    Staging dirs and quarantined tags are excluded."""
+    out = []
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return []
+    for name in names:
+        if name.startswith(STAGING_PREFIX) or name.endswith(CORRUPT_SUFFIX):
+            continue
+        p = os.path.join(ckpt_dir, name)
+        if not os.path.isdir(p):
+            continue
+        if os.path.exists(os.path.join(p, EXTRA_FILE)) or \
+                os.path.exists(os.path.join(p, MODEL_FILE)):
+            out.append((os.stat(p).st_mtime_ns, name))
+    return [name for _, name in sorted(out, reverse=True)]
+
+
+def _gc_tags(ckpt_dir: str, keep_last_k: int) -> None:
+    """Delete committed tags beyond the newest ``keep_last_k`` — but
+    never the only structurally-verified tag (a disk full of corrupt
+    checkpoints must not lose its one good resume point)."""
+    tags = _committed_tags(ckpt_dir)
+    keep, candidates = tags[:keep_last_k], tags[keep_last_k:]
+
+    def ok(name):
+        return sharded.verify_tag(os.path.join(ckpt_dir, name),
+                                  deep=False)[0]
+
+    survivor_verified = any(ok(t) for t in keep)
+    for t in candidates:
+        if not survivor_verified and ok(t):
+            survivor_verified = True
+            continue                         # spared: the only good tag
+        shutil.rmtree(os.path.join(ckpt_dir, t), ignore_errors=True)
+        logger.info(f"checkpoint GC: removed old tag {t!r} "
+                    f"(keep_last_k={keep_last_k})")
+
+
+def _quarantine_tag(ckpt_dir: str, tag: str, reason: str) -> str:
+    """Move a corrupt tag aside as ``<tag>.corrupt`` (never delete —
+    the bytes may matter for postmortem) and return the new path."""
+    src = os.path.join(ckpt_dir, tag)
+    dst = src + CORRUPT_SUFFIX
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = f"{src}{CORRUPT_SUFFIX}.{n}"
+    os.rename(src, dst)
+    logger.error(f"checkpoint {tag!r} FAILED verification ({reason}); "
+                 f"quarantined to {os.path.basename(dst)}")
+    return dst
+
+
 def wait_checkpoint(engine) -> None:
     """Join an in-flight async save (no-op otherwise)."""
     _saver(engine).wait()
+
+
+def _resolve_verified_tag(engine, load_dir: str, tag: Optional[str]
+                          ) -> Optional[str]:
+    """Tag-selection half of a hardened load: resolve ``latest`` (or the
+    newest committed tag when the pointer is gone), verify manifests +
+    checksums, quarantine corrupt tags, and fall back to the newest tag
+    that DOES verify.  An explicitly-requested corrupt tag raises —
+    silently loading a different tag than asked would be worse than
+    failing."""
+    explicit = tag is not None
+    verify = engine.config.resilience.verify_on_load
+    if tag is None:
+        latest = os.path.join(load_dir, LATEST_FILE)
+        if os.path.exists(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+        else:
+            committed = _committed_tags(load_dir)
+            if not committed:
+                logger.warning(f"no 'latest' file in {load_dir}; "
+                               "nothing loaded")
+                return None
+            # crash between tag commit and pointer write: the newest
+            # committed tag is still a valid resume point
+            tag = committed[0]
+            logger.warning(f"no 'latest' pointer in {load_dir}; using "
+                           f"newest committed tag {tag!r}")
+    tried = set()
+    while True:
+        tried.add(tag)
+        path = os.path.join(load_dir, tag)
+        if os.path.exists(os.path.join(path, MODEL_FILE)) and \
+                not os.path.exists(os.path.join(path, EXTRA_FILE)):
+            return tag                     # legacy pickle: no manifests
+        if not os.path.exists(os.path.join(path, EXTRA_FILE)):
+            if explicit or not os.path.isdir(path):
+                logger.warning(f"checkpoint {path} missing; "
+                               "nothing loaded")
+                return None
+            ok, reason = False, "no extra_states (interrupted pre-" \
+                                "hardening save?)"
+        elif verify:
+            saved_procs = None
+            meta_path = os.path.join(path, META_FILE)
+            if os.path.exists(meta_path):
+                try:
+                    with open(meta_path) as f:
+                        saved_procs = json.load(f).get("process_count", 1)
+                except (OSError, ValueError):
+                    saved_procs = None
+            ok, reason = sharded.verify_tag(path, process_count=saved_procs,
+                                            deep=True)
+        else:
+            ok, reason = True, "ok"
+        if ok:
+            return tag
+        _quarantine_tag(load_dir, tag, reason)
+        if explicit:
+            raise RuntimeError(
+                f"checkpoint {path} failed verification ({reason}) and "
+                "was quarantined; pass tag=None to fall back to the "
+                "newest verified tag")
+        remaining = [t for t in _committed_tags(load_dir)
+                     if t not in tried]
+        if not remaining:
+            logger.warning(f"no verified checkpoint remains in "
+                           f"{load_dir}; nothing loaded")
+            return None
+        tag = remaining[0]
+        logger.warning(f"falling back to tag {tag!r}")
 
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
@@ -126,24 +334,19 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_lr_scheduler_states: bool = True
                     ) -> Tuple[Optional[str], Optional[Dict]]:
     _saver(engine).wait()
+    requested = tag
+    tag = _resolve_verified_tag(engine, load_dir, tag)
     if tag is None:
-        latest = os.path.join(load_dir, LATEST_FILE)
-        if not os.path.exists(latest):
-            logger.warning(f"no 'latest' file in {load_dir}; nothing loaded")
-            return None, None
-        with open(latest) as f:
-            tag = f.read().strip()
+        return None, None
     path = os.path.join(load_dir, tag)
     if not os.path.exists(os.path.join(path, EXTRA_FILE)):
         # not the sharded format; fall back to the round-1 pickle
-        if os.path.exists(os.path.join(path, MODEL_FILE)):
-            return _load_legacy(engine, path, load_optimizer_states,
-                                load_lr_scheduler_states)
-        logger.warning(f"checkpoint {path} missing; nothing loaded")
-        return None, None
+        return _load_legacy(engine, path, load_optimizer_states,
+                            load_lr_scheduler_states)
 
     meta_path = os.path.join(path, META_FILE)
-    if os.path.exists(meta_path):
+    if not engine.config.resilience.verify_on_load and \
+            os.path.exists(meta_path):
         with open(meta_path) as f:
             saved_procs = json.load(f).get("process_count", 1)
         if not sharded.is_complete(path, saved_procs):
@@ -151,6 +354,23 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                 f"checkpoint {path} is incomplete: not all of its "
                 f"{saved_procs} processes finished writing (crashed or "
                 "still-running save?)")
+
+    if requested is None and jax.process_index() == 0:
+        # a fallback may have landed on a different tag than 'latest'
+        # named; repoint it so the next resume skips the scan
+        latest = os.path.join(load_dir, LATEST_FILE)
+        try:
+            stale = True
+            if os.path.exists(latest):
+                with open(latest) as f:
+                    stale = f.read().strip() != tag
+            if stale:
+                tmp = latest + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(tag)
+                os.replace(tmp, latest)
+        except OSError:
+            pass                            # read-only checkpoint mount
 
     with open(os.path.join(path, EXTRA_FILE), "rb") as f:
         extra = pickle.load(f)

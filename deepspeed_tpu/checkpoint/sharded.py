@@ -31,12 +31,15 @@ from __future__ import annotations
 import json
 import os
 import threading
+import zlib
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.resilience.retry import retriable
 from deepspeed_tpu.utils.logging import logger
 
 INDEX_FILE = "index_p{proc}.json"
@@ -121,16 +124,68 @@ _UNSET = object()
 _AIO = _UNSET
 
 
+def _fsync_file(f) -> None:
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def fsync_dir(path: str) -> None:
+    """Durably record directory entries (the rename that commits a tag
+    is only crash-safe once its parent directory is synced)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - platform without dir-open
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+@retriable(retry_on=(OSError,))
+def _write_blob_python(blob: str, buffers, records) -> None:
+    """Buffered-python blob write: one contiguous record stream, crc32
+    recorded per record, fsync'd before the manifest is written.
+    Idempotent (rewrites from the start), so transient OSErrors retry
+    with backoff."""
+    faults.hook("ckpt.write_blob", path=blob)
+    with open(blob, "wb") as f:
+        for i, (buf, rec) in enumerate(zip(buffers, records)):
+            data = np.ascontiguousarray(np.asarray(buf)).tobytes()
+            action = faults.hook("ckpt.write_record", path=blob, index=i,
+                                 nbytes=len(data))
+            if action is not None and action[0] == "torn":
+                f.write(data[:max(1, int(len(data) * action[1]))])
+                _fsync_file(f)
+                raise faults.SimulatedCrash(
+                    f"torn write: record {i} of {blob} cut short")
+            rec["crc32"] = zlib.crc32(data)
+            f.write(data)
+        _fsync_file(f)
+
+
+@retriable(retry_on=(OSError,))
+def _write_index(index: str, records) -> None:
+    faults.hook("ckpt.write_index", path=index)
+    with open(index, "w") as f:
+        json.dump({"records": records}, f)
+        _fsync_file(f)
+
+
 def write_snapshot(snap: Dict[str, Any]) -> None:
     """File IO half of a save (runs on the async thread).  Writes the blob
     + index, then a per-process ``done`` marker — readers treat a
     checkpoint as complete only when every process's marker exists.
-    The blob write goes through the native chunk-parallel aio engine
-    (``deepspeed_tpu/io/csrc/aio.cpp``) when available."""
+    Each record's byte-length and crc32 go into the manifest so loads
+    can verify integrity; blob and manifest are fsync'd.  The blob write
+    goes through the native chunk-parallel aio engine
+    (``deepspeed_tpu/io/csrc/aio.cpp``) when available (the buffered
+    python path when a fault injector is active — injection points are
+    per-record)."""
     proc = snap["proc"]
     os.makedirs(snap["dir"], exist_ok=True)
     blob = os.path.join(snap["dir"], BLOB_FILE.format(proc=proc))
-    aio = _aio_handle()
+    aio = None if faults.active() is not None else _aio_handle()
     if aio is not None:
         offset = 0
         ops = []
@@ -140,23 +195,24 @@ def write_snapshot(snap: Dict[str, Any]) -> None:
         from deepspeed_tpu.io.aio import _pretruncate
 
         _pretruncate(blob, total)
-        for buf in bufs:
+        for buf, rec in zip(bufs, snap["records"]):
+            rec["crc32"] = zlib.crc32(buf)
             if buf.nbytes:
                 ops.append(aio.async_pwrite(buf, blob, offset,
                                             _truncate=False))
             offset += buf.nbytes
         for op in ops:
             aio.wait(op)
+        with open(blob, "rb+") as f:
+            _fsync_file(f)
     else:
-        with open(blob, "wb") as f:
-            for buf in snap["buffers"]:
-                f.write(np.ascontiguousarray(np.asarray(buf)).tobytes())
+        _write_blob_python(blob, snap["buffers"], snap["records"])
     index = os.path.join(snap["dir"], INDEX_FILE.format(proc=proc))
-    with open(index, "w") as f:
-        json.dump({"records": snap["records"]}, f)
+    _write_index(index, snap["records"])
     with open(os.path.join(snap["dir"], DONE_FILE.format(proc=proc)),
               "w") as f:
         f.write("ok")
+        _fsync_file(f)
 
 
 def is_complete(path: str, process_count: int) -> bool:
@@ -164,6 +220,60 @@ def is_complete(path: str, process_count: int) -> bool:
     markers live on the shared checkpoint filesystem.)"""
     return all(os.path.exists(os.path.join(path, DONE_FILE.format(proc=p)))
                for p in range(process_count))
+
+
+def verify_tag(path: str, process_count: Optional[int] = None,
+               deep: bool = True) -> Tuple[bool, str]:
+    """Integrity check of one tag directory: every process's manifest
+    parses, its done marker exists, the blob holds exactly the bytes the
+    manifest claims, and (``deep``) every record's crc32 matches.
+
+    Returns ``(ok, reason)`` — never raises.  Pre-hardening checkpoints
+    (no crc32 in the manifest) pass the structural checks only.
+    ``deep=False`` is the cheap structural variant GC uses."""
+    if not os.path.isdir(path):
+        return False, "tag directory missing"
+    try:
+        idx_files = sorted(f for f in os.listdir(path)
+                           if f.startswith("index_p") and
+                           f.endswith(".json"))
+    except OSError as e:
+        return False, f"unreadable tag directory ({e})"
+    if not idx_files:
+        return False, "no shard manifests"
+    if process_count is not None and len(idx_files) != process_count:
+        return False, (f"{len(idx_files)} of {process_count} process "
+                       "manifests present")
+    for fname in idx_files:
+        proc = int(fname[len("index_p"):-len(".json")])
+        if not os.path.exists(os.path.join(path, DONE_FILE.format(proc=proc))):
+            return False, f"process {proc} never finished writing"
+        try:
+            with open(os.path.join(path, fname)) as f:
+                records = json.load(f)["records"]
+        except (OSError, ValueError, KeyError) as e:
+            return False, f"manifest {fname} unreadable ({e})"
+        blob = os.path.join(path, BLOB_FILE.format(proc=proc))
+        try:
+            size = os.path.getsize(blob)
+        except OSError:
+            return False, f"blob for process {proc} missing"
+        total = sum(int(r["nbytes"]) for r in records)
+        if total != size:
+            return False, (f"blob for process {proc} holds {size} bytes, "
+                           f"manifest claims {total} (torn write?)")
+        if deep:
+            with open(blob, "rb") as f:
+                for r in records:
+                    if "crc32" not in r:
+                        continue          # pre-hardening record
+                    f.seek(int(r["offset"]))
+                    data = f.read(int(r["nbytes"]))
+                    if len(data) != int(r["nbytes"]) or \
+                            zlib.crc32(data) != int(r["crc32"]):
+                        return False, (f"crc mismatch in {r['path']!r} "
+                                       f"(process {proc})")
+    return True, "ok"
 
 
 class _Reader:
@@ -201,12 +311,7 @@ class _Reader:
         with self._lock:
             if key in self._cache:
                 return self._cache[key]
-            f = self._files.get(rec["proc"])
-            if f is None:
-                f = open(self.blobs[rec["proc"]], "rb")
-                self._files[rec["proc"]] = f
-            f.seek(rec["offset"])
-            raw = f.read(rec["nbytes"])
+            raw = self._pread(rec)
             shape = [b - a for a, b in rec["slices"]]
             arr = np.frombuffer(raw,
                                 dtype=np.dtype(rec["dtype"])).reshape(shape)
@@ -214,6 +319,28 @@ class _Reader:
             while len(self._cache) > 4:
                 self._cache.pop(next(iter(self._cache)))
             return arr
+
+    @retriable(retry_on=(OSError,))
+    def _pread(self, rec: Dict) -> bytes:
+        """Raw record read; transient OSErrors (flaky network mount)
+        retry with backoff after dropping the cached file handle."""
+        faults.hook("ckpt.read_record", path=rec["path"],
+                    proc=rec["proc"])
+        f = self._files.get(rec["proc"])
+        try:
+            if f is None:
+                f = open(self.blobs[rec["proc"]], "rb")
+                self._files[rec["proc"]] = f
+            f.seek(rec["offset"])
+            return f.read(rec["nbytes"])
+        except OSError:
+            if f is not None:
+                self._files.pop(rec["proc"], None)
+                try:
+                    f.close()
+                except OSError:
+                    pass
+            raise
 
     def read_slice(self, path: str, index: Tuple[slice, ...]) -> np.ndarray:
         """Global-slice read: union of overlapping saved records."""

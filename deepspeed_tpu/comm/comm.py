@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deepspeed_tpu.utils.compat import shard_map
 
 from deepspeed_tpu.comm.comms_logging import CommsLogger
 from deepspeed_tpu.parallel.topology import MeshTopology, AXIS_ORDER

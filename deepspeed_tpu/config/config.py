@@ -290,6 +290,35 @@ class CompressionConfig(ConfigModel):
     layer_reduction: Dict[str, Any] = Field(default_factory=dict)
 
 
+class ResilienceConfig(ConfigModel):
+    """``resilience`` subtree (deepspeed_tpu/resilience/): fault-tolerance
+    knobs for checkpoint hardening, restart supervision, and training
+    guards."""
+
+    # elastic-agent restart budget + backoff between hard-failure restarts
+    max_restarts: int = 10
+    backoff_base_s: float = 1.0
+    backoff_cap_s: float = 60.0
+    # checkpoint GC: keep the newest k committed tags (0 = keep all).
+    # GC never deletes the only structurally-verified tag.
+    keep_last_k: int = 0
+    # abort after this many CONSECUTIVE overflow-skipped steps (0 = off;
+    # enabling costs one scalar device sync per step)
+    max_consecutive_skips: int = 0
+    # verify manifest byte-lengths + crc32 checksums at load; corrupt tags
+    # quarantine to <tag>.corrupt and load falls back to the newest
+    # verified tag
+    verify_on_load: bool = True
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.max_restarts < 0:
+            raise ValueError("resilience.max_restarts must be >= 0")
+        if self.keep_last_k < 0:
+            raise ValueError("resilience.keep_last_k must be >= 0")
+        return self
+
+
 class ElasticityConfig(ConfigModel):
     enabled: bool = False
     max_train_batch_size: int = 2000
@@ -378,6 +407,7 @@ class DeepSpeedConfig(ConfigModel):
     data_types: DataTypesConfig = Field(default_factory=DataTypesConfig)
     compression_training: CompressionConfig = Field(default_factory=CompressionConfig)
     elasticity: ElasticityConfig = Field(default_factory=ElasticityConfig)
+    resilience: ResilienceConfig = Field(default_factory=ResilienceConfig)
     curriculum_learning: CurriculumParams = Field(default_factory=CurriculumParams)
     data_efficiency: DataEfficiencyConfig = Field(default_factory=DataEfficiencyConfig)
 

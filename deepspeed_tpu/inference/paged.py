@@ -29,6 +29,7 @@ from typing import Dict, List
 
 import jax
 import jax.numpy as jnp
+from deepspeed_tpu.utils.compat import shard_map as _shard_map_compat
 import numpy as np
 
 TRASH_PAGE = 0
@@ -277,7 +278,7 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
             f"TP serving requires heads divisible by tp={tp} "
             f"(H={H}, Hkv={Hkv})")
         mesh = resolve_mesh(None, "tensor")
-        y = jax.shard_map(
+        y = _shard_map_compat(
             attend, mesh=mesh,
             in_specs=(P(None, "tensor", None),
                       P(None, None, "tensor", None), P(), P(), P(), P()),

@@ -193,13 +193,49 @@ def w8a8_dot_general(lhs, rhs, dimension_numbers, precision=None,
     v, s = rhs.arrays
     sx = jnp.max(jnp.abs(lhs.astype(jnp.float32)), axis=-1,
                  keepdims=True) / 127.0
-    xq = jnp.round(lhs.astype(jnp.float32) /
-                   jnp.maximum(sx, 1e-12)).astype(jnp.int8)
+    # clip before the int8 cast (matching the weight branch): a NaN/inf
+    # activation row would otherwise cast to undefined int8 values
+    xq = jnp.clip(jnp.round(lhs.astype(jnp.float32) /
+                            jnp.maximum(sx, 1e-12)),
+                  -127, 127).astype(jnp.int8)
     acc = jax.lax.dot_general(xq, v, dimension_numbers,
                               preferred_element_type=jnp.int32)
     return (acc.astype(jnp.float32) * sx * s).astype(
         lhs.dtype if jnp.issubdtype(lhs.dtype, jnp.floating)
         else rhs.dtype)
+
+
+def _dense_supports_promote_dtype() -> bool:
+    import inspect
+
+    import flax.linen as nn
+
+    return "promote_dtype" in inspect.signature(nn.Dense).parameters
+
+
+def _patch_flax_promote_dtype() -> None:
+    """flax < 0.10.2 compat: ``nn.Dense`` has no ``promote_dtype``
+    attribute there, and its module-level ``promote_dtype`` would
+    ``jnp.asarray`` a :class:`QuantizedWeight` kernel.  Wrap that one
+    function (idempotently) to pass quantized leaves through — plain
+    arrays take the original path unchanged."""
+    from flax.linen import dtypes as _dtypes
+    from flax.linen import linear as _linear
+
+    if getattr(_linear.promote_dtype, "_dstpu_quant_aware", False):
+        return
+    orig = _dtypes.promote_dtype
+
+    def promote(*args, dtype=None, **kw):
+        qs = [a if isinstance(a, QuantizedWeight) else None for a in args]
+        if not any(q is not None for q in qs):
+            return orig(*args, dtype=dtype, **kw)
+        proms = orig(*(None if q is not None else a
+                       for q, a in zip(qs, args)), dtype=dtype, **kw)
+        return [q if q is not None else p for q, p in zip(qs, proms)]
+
+    promote._dstpu_quant_aware = True
+    _linear.promote_dtype = promote
 
 
 def weight_quant_dense_kwargs(weight_quant: str):
@@ -208,5 +244,8 @@ def weight_quant_dense_kwargs(weight_quant: str):
     if weight_quant in (None, "none"):
         return {}
     assert weight_quant == "w8a8", weight_quant
+    if not _dense_supports_promote_dtype():
+        _patch_flax_promote_dtype()
+        return {"dot_general": w8a8_dot_general}
     return {"promote_dtype": quant_promote_dtype,
             "dot_general": w8a8_dot_general}

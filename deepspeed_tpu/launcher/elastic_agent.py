@@ -23,8 +23,9 @@ reference's checkpoint-based recovery story.
 """
 from __future__ import annotations
 
+import random
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
 
@@ -93,20 +94,59 @@ class DSElasticAgent:
         granularity).
     max_restarts:
         Supervision budget; exceeded -> the last error re-raises.
+        Default from the config's ``resilience.max_restarts``.
+    backoff_base_s / backoff_cap_s:
+        Jittered exponential backoff between HARD-failure restarts
+        (device failures, rebuild failures) — graceful membership-notice
+        restarts re-slice immediately.  Defaults from the config's
+        ``resilience`` block.
+    sleep_fn:
+        The backoff clock; injectable so tests never really sleep.
     """
 
     def __init__(self, build_engine: Callable[[Any, Dict], Any],
                  ds_config: Dict, ckpt_dir: str,
                  device_provider: Optional[
                      Callable[[], Sequence[jax.Device]]] = None,
-                 save_interval: int = 10, max_restarts: int = 10):
+                 save_interval: int = 10,
+                 max_restarts: Optional[int] = None,
+                 backoff_base_s: Optional[float] = None,
+                 backoff_cap_s: Optional[float] = None,
+                 sleep_fn: Optional[Callable[[float], None]] = None):
         self.build_engine = build_engine
         self.ds_config = dict(ds_config)
         self.ckpt_dir = ckpt_dir
         self.device_provider = device_provider or jax.devices
         self.save_interval = int(save_interval)
-        self.max_restarts = int(max_restarts)
+        rcfg = self.ds_config.get("resilience") or {}
+        self.max_restarts = int(max_restarts if max_restarts is not None
+                                else rcfg.get("max_restarts", 10))
+        self.backoff_base_s = float(
+            backoff_base_s if backoff_base_s is not None
+            else rcfg.get("backoff_base_s", 1.0))
+        self.backoff_cap_s = float(
+            backoff_cap_s if backoff_cap_s is not None
+            else rcfg.get("backoff_cap_s", 60.0))
+        self._sleep = sleep_fn or time.sleep
+        self._rng = random.Random(int(self.ds_config.get("seed", 1234)))
         self.restarts = 0
+        self.hard_failures = 0
+        self.backoff_history: list = []
+
+    def _backoff(self) -> None:
+        """Jittered exponential delay before retrying after a HARD
+        failure — a dying pod must not hot-loop rebuild attempts
+        against infrastructure that needs time to recover."""
+        self.hard_failures += 1
+        delay = min(self.backoff_cap_s,
+                    self.backoff_base_s * (2.0 ** (self.hard_failures - 1)))
+        delay *= 1.0 + 0.5 * self._rng.random()
+        self.backoff_history.append(delay)
+        if delay > 0:
+            logger.warning(f"elastic agent: backing off {delay:.1f}s "
+                           f"before restart (hard failure "
+                           f"#{self.hard_failures})")
+            self._sleep(delay)
 
     # -- helpers ----------------------------------------------------------
 
@@ -157,6 +197,8 @@ class DSElasticAgent:
                 logger.warning(
                     f"elastic agent: engine rebuild failed, restart "
                     f"{self.restarts}/{self.max_restarts} ({e})")
+                if self.restarts <= self.max_restarts:
+                    self._backoff()
                 continue
             step = int(engine.global_steps)
             # read the SOLVED batch size off the engine (elastic mode
@@ -187,12 +229,15 @@ class DSElasticAgent:
                     f"{self.max_restarts} ({e})")
             except jax.errors.JaxRuntimeError as e:
                 # hard device failure: resume from the last periodic save
+                # (load_checkpoint verifies and falls back to the newest
+                # VERIFIED tag if the last save was torn)
                 last_err = e
                 self.restarts += 1
                 logger.warning(
                     f"elastic agent: device failure, restart "
                     f"{self.restarts}/{self.max_restarts} ({e})")
-                time.sleep(0)                  # yield; real pods backoff
+                if self.restarts <= self.max_restarts:
+                    self._backoff()
         raise RuntimeError(
             f"elastic agent: exceeded {self.max_restarts} restarts"
         ) from last_err

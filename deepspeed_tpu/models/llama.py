@@ -17,7 +17,7 @@ classification the reference's AutoTP applies by name).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any, ClassVar, Optional
 
 import flax.linen as nn
 import jax
@@ -353,8 +353,9 @@ class LlamaModel(nn.Module):
     config: LlamaConfig
     # every matmul kernel in this module tree consumes w8a8
     # QuantizedWeight leaves natively (see _wq_kwargs) — serving engines
-    # key the int8-MXU path off this class flag
-    w8a8_native = True
+    # key the int8-MXU path off this class flag.  ClassVar keeps flax's
+    # dataclass transform from turning it into a constructor field
+    w8a8_native: ClassVar[bool] = True
 
     @nn.compact
     def __call__(self, input_ids, positions=None, deterministic: bool = True,
@@ -409,7 +410,8 @@ class LlamaModel(nn.Module):
 
 class LlamaForCausalLM(nn.Module):
     config: LlamaConfig
-    w8a8_native = True
+    # class flag, not a dataclass field (see LlamaModel)
+    w8a8_native: ClassVar[bool] = True
 
     @nn.compact
     def __call__(self, input_ids, positions=None, deterministic: bool = True,

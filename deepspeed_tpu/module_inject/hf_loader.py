@@ -654,11 +654,48 @@ def _convert_bert(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
     return _nest(flat)
 
 
-def _convert_gptneo(sd: Dict[str, np.ndarray], cfg) -> Dict[str, Any]:
+def _gptneo_check_attention(hf_config, cfg) -> None:
+    """The state dict carries no trace of the attention schedule — a
+    checkpoint trained with a different global/local pattern or window
+    would convert cleanly and serve wrong logits silently (the same
+    failure class as an untied head).  When the source exposes its HF
+    config, validate it against the target's cycled pattern."""
+    if hf_config is None:
+        return
+    L = cfg.num_hidden_layers
+    layers = getattr(hf_config, "attention_layers", None)
+    if layers is None:
+        # config.json form: attention_types = [[["global","local"], N]]
+        at = getattr(hf_config, "attention_types", None)
+        if at:
+            layers = [kind for pattern, n in at
+                      for _ in range(n) for kind in pattern]
+    if layers is not None:
+        expect = [cfg.layer_kind(i) for i in range(L)]
+        got = list(layers)[:L]
+        if got != expect:
+            raise ValueError(
+                f"GPT-Neo checkpoint's attention schedule {got} does not "
+                f"match the target config's cycled pattern {expect} "
+                f"(attention_layers={cfg.attention_layers}); converting "
+                "would serve wrong logits — build the target GPTNeoConfig "
+                "with the checkpoint's attention_types")
+    hf_window = getattr(hf_config, "window_size", None)
+    if hf_window is not None and int(hf_window) != int(cfg.window_size):
+        raise ValueError(
+            f"GPT-Neo checkpoint was trained with window_size="
+            f"{hf_window}, target config has {cfg.window_size}; local "
+            "layers would attend over the wrong span — set window_size="
+            f"{hf_window} on the target GPTNeoConfig")
+
+
+def _convert_gptneo(sd: Dict[str, np.ndarray], cfg,
+                    hf_config=None) -> Dict[str, Any]:
     """GPT-Neo (reference ``module_inject/containers/gptneo.py``
     HFGPTNEOLayerPolicy): separate biasless q/k/v + biased out_proj,
     GPT-2-shaped pre-LN block, tied head (no separate lm_head param —
     our module attends the embedding)."""
+    _gptneo_check_attention(hf_config, cfg)
     sd = _strip_prefix(sd, "transformer.")
     L = cfg.num_hidden_layers
     layers = []
@@ -802,7 +839,29 @@ def convert_hf_state_dict(model_or_config, source) -> Dict[str, Any]:
         raise TypeError(f"no HF converter for config {type(cfg).__name__}; "
                         f"supported: {sorted(_CONVERTERS)}")
     sd = _read_state_dict(source)
+    if name == "GPTNeoConfig":
+        # the only family whose architecture (attention schedule) is
+        # invisible in the weights — validate it from the source config
+        return {"params": _CONVERTERS[name](
+            sd, cfg, hf_config=_source_hf_config(source))}
     return {"params": _CONVERTERS[name](sd, cfg)}
+
+
+def _source_hf_config(source):
+    """The HF config riding along with ``source``: the model object's
+    ``.config``, or a ``config.json`` next to directory checkpoints."""
+    hf_cfg = getattr(source, "config", None)
+    if hf_cfg is not None:
+        return hf_cfg
+    if isinstance(source, str) and os.path.isdir(source):
+        p = os.path.join(source, "config.json")
+        if os.path.exists(p):
+            import json
+            from types import SimpleNamespace
+
+            with open(p) as f:
+                return SimpleNamespace(**json.load(f))
+    return None
 
 
 def load_hf_checkpoint(model, source):

@@ -36,6 +36,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
+from deepspeed_tpu.utils.compat import shard_map as _shard_map_compat
 
 from deepspeed_tpu.moe.sharded_moe import (moe_combine, moe_combine_gather,
                                            moe_dispatch, moe_dispatch_gather,
@@ -295,7 +296,7 @@ class MoE(nn.Module):
         tok_spec = P(token_axes, None)
         rng_args = (noise_rng,) if has_rng else ()
         rng_specs = (P(),) if has_rng else ()
-        sm = jax.shard_map(
+        sm = _shard_map_compat(
             body, mesh=mesh,
             in_specs=(tok_spec, P()) + rng_specs +
                      tuple(wspec(v) for v in w_vals),

@@ -30,6 +30,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax 0.4.x spells it TPUCompilerParams; modern jax CompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    pltpu.TPUCompilerParams
+
 DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 _LANE = 128  # TPU lane width; scratch row-stat buffers are (bq, _LANE)
 
@@ -338,7 +342,7 @@ def _flash_fwd_pallas(q, k, v, *, sm_scale: float, causal: bool,
             pltpu.VMEM((block_q, _LANE), jnp.float32),
             pltpu.VMEM((block_q, _LANE), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -518,7 +522,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, sm_scale: float, causal: bool,
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct((B, H, nq * block_q, D), jnp.float32)],
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -545,7 +549,7 @@ def _flash_bwd_pallas(q, k, v, o, lse, do, *, sm_scale: float, causal: bool,
         ],
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,

@@ -1,0 +1,20 @@
+"""Fault-tolerance layer: hardened checkpoint I/O helpers, retry/backoff,
+training guards, and the deterministic fault-injection harness.
+
+Wired through ``checkpoint/`` (staged atomic commits, crc32-verified
+manifests, quarantine + fallback on load), ``runtime/engine.py``
+(preemption hook, gradient-anomaly guard), and
+``launcher/elastic_agent.py`` (restart budget with exponential
+backoff).  Config knobs live in the ``resilience`` block of the
+DeepSpeed config (``config/config.py ResilienceConfig``).
+"""
+from deepspeed_tpu.resilience.faults import (FaultInjector, SimulatedCrash,
+                                             torn_write_file)
+from deepspeed_tpu.resilience.guards import (GradientAnomalyError,
+                                             SkippedStepGuard)
+from deepspeed_tpu.resilience.retry import (backoff_delays,
+                                            call_with_retries, retriable)
+
+__all__ = ["FaultInjector", "SimulatedCrash", "torn_write_file",
+           "GradientAnomalyError", "SkippedStepGuard",
+           "backoff_delays", "call_with_retries", "retriable"]

@@ -1,0 +1,161 @@
+"""Deterministic fault injection.
+
+The same seeded injector drives the unit tests under
+``tests/unit/checkpoint/test_resilience.py`` and the
+``scripts/chaos_train.py`` soak: production code is instrumented with
+cheap :func:`hook` calls (no-ops when no injector is active), and an
+active :class:`FaultInjector` turns specific hook firings into torn
+writes, transient ``OSError`` s, simulated process death, or SIGTERM
+delivery — reproducibly, keyed only on the per-site call count and the
+injector's seed.
+
+Instrumented sites (the stable surface; grep for ``faults.hook``):
+
+========================  ==================================================
+``ckpt.write_blob``       once per blob-write attempt (retry target)
+``ckpt.write_record``     before each record buffer is written (torn/crash)
+``ckpt.write_index``      before the manifest JSON is written
+``ckpt.commit``           just before the atomic staging->tag rename
+``ckpt.read_record``      before each shard-record read (retry target)
+``swap.write_item``       before each NVMe moment-file write
+========================  ==================================================
+
+A fault is scheduled with ``inject(site, kind, ...)`` (or the named
+helpers); ``after`` skips that many firings first and ``count`` bounds
+how many firings trigger.  Only one injector may be active per process
+(they install into a module global — the hooks must stay free when
+disarmed).
+"""
+from __future__ import annotations
+
+import random
+import signal as _signal
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["FaultInjector", "SimulatedCrash", "hook", "active",
+           "torn_write_file"]
+
+
+class SimulatedCrash(BaseException):
+    """Emulates process death mid-operation.  Derives from
+    ``BaseException`` so ordinary ``except Exception`` recovery/retry
+    paths cannot swallow it — a real SIGKILL would not run them
+    either."""
+
+
+class _Fault:
+    __slots__ = ("site", "kind", "count", "after", "fraction")
+
+    def __init__(self, site: str, kind: str, count: int, after: int,
+                 fraction: float):
+        self.site = site
+        self.kind = kind
+        self.count = count          # remaining firings that trigger
+        self.after = after          # firings to skip before arming
+        self.fraction = fraction    # torn writes: fraction of bytes kept
+
+
+class FaultInjector:
+    """Seeded, deterministic injector; use as a context manager.
+
+    ``fired`` records every triggered fault as ``(site, kind, call#)``
+    — assert on it for determinism, or to check a fault actually
+    landed."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self.faults: List[_Fault] = []
+        self.calls: Dict[str, int] = {}
+        self.fired: List[Tuple[str, str, int]] = []
+
+    # -- scheduling -------------------------------------------------------
+
+    def inject(self, site: str, kind: str, count: int = 1, after: int = 0,
+               fraction: float = 0.5) -> "FaultInjector":
+        assert kind in ("oserror", "torn", "crash", "sigterm"), kind
+        self.faults.append(_Fault(site, kind, count, after, fraction))
+        return self
+
+    def transient_oserror(self, site: str, count: int,
+                          after: int = 0) -> "FaultInjector":
+        """Raise ``OSError`` at the next ``count`` firings of ``site``
+        (then heal) — the transient-I/O-failure retry scenario."""
+        return self.inject(site, "oserror", count=count, after=after)
+
+    def torn_write(self, site: str = "ckpt.write_record", after: int = 0,
+                   fraction: float = 0.5) -> "FaultInjector":
+        """Write only ``fraction`` of one record's bytes, then die
+        (SimulatedCrash) — a kill mid-flush."""
+        return self.inject(site, "torn", after=after, fraction=fraction)
+
+    def crash(self, site: str, after: int = 0) -> "FaultInjector":
+        """Simulated process death at ``site`` (kill mid-async-save)."""
+        return self.inject(site, "crash", after=after)
+
+    def sigterm(self, site: str, after: int = 0) -> "FaultInjector":
+        """Deliver a real SIGTERM to this process when ``site`` fires
+        (exercises an installed preemption handler)."""
+        return self.inject(site, "sigterm", after=after)
+
+    # -- firing -----------------------------------------------------------
+
+    def fire(self, site: str, **ctx: Any) -> Optional[Tuple[str, float]]:
+        n = self.calls[site] = self.calls.get(site, 0) + 1
+        for f in self.faults:
+            if f.site != site or f.count <= 0:
+                continue
+            if f.after > 0:
+                f.after -= 1
+                continue
+            f.count -= 1
+            self.fired.append((site, f.kind, n))
+            if f.kind == "oserror":
+                raise OSError(f"[fault-injection] transient I/O error at "
+                              f"{site} (call {n})")
+            if f.kind == "crash":
+                raise SimulatedCrash(f"[fault-injection] crash at {site} "
+                                     f"(call {n})")
+            if f.kind == "sigterm":
+                _signal.raise_signal(_signal.SIGTERM)
+                return None
+            return ("torn", f.fraction)
+        return None
+
+    # -- install ----------------------------------------------------------
+
+    def __enter__(self) -> "FaultInjector":
+        global _ACTIVE
+        assert _ACTIVE is None, "a FaultInjector is already active"
+        _ACTIVE = self
+        return self
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        _ACTIVE = None
+
+
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+def hook(site: str, **ctx: Any) -> Optional[Tuple[str, float]]:
+    """Instrumentation point.  Returns ``None`` (the overwhelmingly
+    common disarmed case), raises an injected failure, or returns a
+    ``("torn", fraction)`` directive the write site must honor."""
+    if _ACTIVE is None:
+        return None
+    return _ACTIVE.fire(site, **ctx)
+
+
+def torn_write_file(path: str, fraction: float = 0.5) -> int:
+    """Truncate ``path`` to ``fraction`` of its bytes in place —
+    simulates a torn write surfacing AFTER commit (power loss eating
+    un-synced pages, storage-layer corruption).  Returns the new
+    size."""
+    size = max(1, int(__import__("os").path.getsize(path) * fraction))
+    with open(path, "rb+") as f:
+        f.truncate(size)
+    return size

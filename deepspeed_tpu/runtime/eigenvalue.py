@@ -65,6 +65,7 @@ class Eigenvalue:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         grad_fn = jax.grad(loss_fn)
 
+        @jax.jit
         def hvp(p, v):
             return jax.jvp(grad_fn, (p,), (v,))[1]
 

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
+from deepspeed_tpu.utils.compat import shard_map as _shard_map_compat
 
 import deepspeed_tpu.comm as dist
 from deepspeed_tpu.config import DeepSpeedConfig, load_config
@@ -424,7 +425,7 @@ class DeepSpeedEngine:
                 is_fused_optimizer(
                 self.optimizer_name, opt_cfg.params if opt_cfg else {}):
             moment_specs = self.plan.moment_specs(params, self.base_specs)
-            self._tx_update = jax.shard_map(
+            self._tx_update = _shard_map_compat(
                 self.tx.update, mesh=self.mesh,
                 in_specs=(moment_specs, opt_specs, moment_specs),
                 out_specs=(moment_specs, opt_specs),
@@ -502,6 +503,16 @@ class DeepSpeedEngine:
                      f"{config.curriculum_learning.max_difficulty} "
                      f"({config.curriculum_learning.schedule_type})",
                      ranks=[0])
+
+        # -- resilience guards (resilience/guards.py) ---------------------
+        self._skip_guard = None
+        if config.resilience.max_consecutive_skips > 0:
+            from deepspeed_tpu.resilience import SkippedStepGuard
+
+            self._skip_guard = SkippedStepGuard(
+                config.resilience.max_consecutive_skips)
+        self._preemption_prev_handlers = None
+        self.preempted = False
 
         self.optimizer = OptimizerHandle(self)
         log_dist(
@@ -775,7 +786,7 @@ class DeepSpeedEngine:
                        "loss_scale": state.scale.loss_scale}
             return new_state, metrics
 
-        sharded = jax.shard_map(
+        sharded = _shard_map_compat(
             member_step, mesh=mesh,
             in_specs=(state_specs, batch_specs, P()),
             out_specs=(state_specs, metric_specs), check_vma=False)
@@ -1303,6 +1314,12 @@ class DeepSpeedEngine:
         self.global_samples += self.config.train_batch_size
         self.lr_scheduler.step()
         self.tput_timer.stop(global_step=True)
+        if self._skip_guard is not None:
+            # costs one scalar sync per step; built only when
+            # resilience.max_consecutive_skips > 0
+            self._skip_guard.update(
+                bool(jax.device_get(metrics["overflow"])),
+                self.global_steps)
 
         if self.global_steps % self.config.steps_per_print == 0:
             m = jax.device_get(metrics)
@@ -1456,6 +1473,10 @@ class DeepSpeedEngine:
         self._pending_grads = None
         self.global_steps += 1
         self.lr_scheduler.step()
+        if self._skip_guard is not None:
+            self._skip_guard.update(
+                bool(jax.device_get(self._last_metrics["overflow"])),
+                self.global_steps)
 
     def _profile_imperative_step(self, lr) -> None:
         """Flops profile for the imperative fwd/bwd/step path: cost the
@@ -1601,6 +1622,65 @@ class DeepSpeedEngine:
         from deepspeed_tpu.checkpoint.engine import wait_checkpoint as _wait
 
         _wait(self)
+
+    # -- preemption / fault tolerance (resilience/) -----------------------
+
+    def emergency_checkpoint(self, save_dir: str) -> str:
+        """Drain any in-flight async save, then take a synchronous
+        checkpoint — the last-gasp save a preemption notice triggers.
+        A failed in-flight save is logged and superseded (this snapshot
+        is strictly newer), never allowed to block the emergency
+        write."""
+        try:
+            self.wait_checkpoint()
+        except BaseException as e:
+            logger.error(f"emergency checkpoint: in-flight async save "
+                         f"failed ({e!r}); writing a fresh synchronous "
+                         "checkpoint")
+            self._ckpt_saver = None           # drop the poisoned saver
+        return self.save_checkpoint(
+            save_dir, tag=f"emergency_step{self.global_steps}",
+            async_save=False)
+
+    def install_preemption_handler(self, save_dir: str, signals=None,
+                                   exit_after: bool = True) -> None:
+        """SIGTERM hook (TPU preemption notice): drains the async saver,
+        takes an emergency synchronous checkpoint, then re-delivers the
+        signal to the previous disposition (``exit_after=False`` returns
+        to the interrupted code instead — tests, or jobs that drain
+        work themselves).  Call :meth:`uninstall_preemption_handler` to
+        restore the prior handlers."""
+        import signal as _signal
+
+        signals = tuple(signals or (_signal.SIGTERM,))
+        prev = {}
+
+        def _handler(signum, frame):
+            self.preempted = True
+            logger.error(f"signal {signum}: preemption notice — taking "
+                         "emergency checkpoint")
+            path = self.emergency_checkpoint(save_dir)
+            logger.error(f"emergency checkpoint committed at {path}")
+            if not exit_after:
+                return
+            old = prev.get(signum, _signal.SIG_DFL)
+            if callable(old):
+                old(signum, frame)
+            else:
+                _signal.signal(signum, old)
+                _signal.raise_signal(signum)
+
+        for s in signals:
+            prev[s] = _signal.signal(s, _handler)
+        self._preemption_prev_handlers = prev
+
+    def uninstall_preemption_handler(self) -> None:
+        import signal as _signal
+
+        if self._preemption_prev_handlers:
+            for s, old in self._preemption_prev_handlers.items():
+                _signal.signal(s, old)
+            self._preemption_prev_handlers = None
 
     # -- misc -------------------------------------------------------------
 

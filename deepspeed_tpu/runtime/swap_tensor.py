@@ -164,12 +164,37 @@ def _item_mv(data: np.ndarray, item: dict, n_total: int):
 
 def _write_item_file(dst: str, m, v) -> None:
     """Atomically write one item's ``[m; v]`` file (fp32, m then v —
-    the shared checkpoint/leafwise layout)."""
-    tmp = f"{dst}.tmp.p{jax.process_index()}"
-    with open(tmp, "wb") as f:
-        f.write(np.ascontiguousarray(m, np.float32).tobytes())
-        f.write(np.ascontiguousarray(v, np.float32).tobytes())
-    os.replace(tmp, dst)
+    the shared checkpoint/leafwise layout).  Transient OSErrors (the
+    NVMe mount hiccuping under checkpoint load) retry with jittered
+    backoff; the tmp+rename makes every retry idempotent."""
+    from deepspeed_tpu.resilience import faults
+    from deepspeed_tpu.resilience.retry import retriable
+
+    @retriable(retry_on=(OSError,))
+    def _write():
+        faults.hook("swap.write_item", path=dst)
+        tmp = f"{dst}.tmp.p{jax.process_index()}"
+        with open(tmp, "wb") as f:
+            f.write(np.ascontiguousarray(m, np.float32).tobytes())
+            f.write(np.ascontiguousarray(v, np.float32).tobytes())
+        os.replace(tmp, dst)
+
+    _write()
+
+
+def _copy_atomic(src: str, dst: str) -> None:
+    """Per-process tmp + atomic rename copy (concurrent multi-host
+    saves never interleave writes to one destination path — fragile on
+    e.g. NFS), retried on transient OSError."""
+    from deepspeed_tpu.resilience.retry import retriable
+
+    @retriable(retry_on=(OSError,))
+    def _copy():
+        tmp = f"{dst}.tmp.p{jax.process_index()}"
+        shutil.copy2(src, tmp)
+        os.replace(tmp, dst)
+
+    _copy()
 
 
 def _plan_buckets(meta, bucket_bytes: int):
@@ -892,20 +917,14 @@ class NvmeOptimizerSwapper:
                 if not os.path.exists(fname):
                     continue
                 dst = os.path.join(out, os.path.basename(fname))
-                tmp = f"{dst}.tmp.p{jax.process_index()}"
-                shutil.copy2(fname, tmp)
-                os.replace(tmp, dst)
+                _copy_atomic(fname, dst)
         else:
             for key, tag in self._initialized:
                 fname = self._shard_fname(key, tag)
                 dst = os.path.join(out, os.path.basename(fname))
-                # replicated leaves carry the same full-extent tag in every
-                # process; copy via a per-process temp + atomic rename so
-                # concurrent multi-host saves never interleave writes to one
-                # destination path (fragile on e.g. NFS)
-                tmp = f"{dst}.tmp.p{jax.process_index()}"
-                shutil.copy2(fname, tmp)
-                os.replace(tmp, dst)
+                # replicated leaves carry the same full-extent tag in
+                # every process
+                _copy_atomic(fname, dst)
         # one meta file per process: each process's shard set is disjoint
         # (multi-host swap — reference rank-local partition semantics)
         meta_name = f"swap_meta.p{jax.process_index()}.json"
@@ -1207,9 +1226,7 @@ class HostMomentSwapper:
                         continue
                     dst = os.path.join(out, os.path.basename(fname))
                     if os.path.abspath(fname) != os.path.abspath(dst):
-                        tmp = f"{dst}.tmp.p{jax.process_index()}"
-                        shutil.copy2(fname, tmp)
-                        os.replace(tmp, dst)
+                        _copy_atomic(fname, dst)
                     initialized.append([it["key"], it["tag"]])
                 continue
             data = np.asarray(mv).reshape(-1)

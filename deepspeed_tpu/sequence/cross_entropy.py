@@ -16,6 +16,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from deepspeed_tpu.utils.compat import shard_map as _shard_map_compat
 
 from deepspeed_tpu.parallel.topology import SEQ_AXIS, TENSOR_AXIS
 
@@ -66,6 +67,6 @@ def vocab_sequence_parallel_cross_entropy(
                 P(None, seq_axis))
     # both axes stay manual even at size 1 — in_specs may only name manual
     # axes, and size-1 manual axes are legal
-    return jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=P(),
+    return _shard_map_compat(body, mesh=mesh, in_specs=in_specs, out_specs=P(),
                          axis_names={seq_axis, vocab_axis},
                          check_vma=False)(logits, targets)

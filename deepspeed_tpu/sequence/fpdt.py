@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
+from deepspeed_tpu.utils.compat import shard_map as _shard_map_compat
 
 from deepspeed_tpu.ops.flash_attention import _blockwise_fwd
 from deepspeed_tpu.parallel.topology import SEQ_AXIS
@@ -235,6 +236,6 @@ def fpdt_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return gather_heads(out)
 
     spec = P(None, None, axis, None)
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    return _shard_map_compat(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, axis_names={axis},
                          check_vma=False)(q, k, v)

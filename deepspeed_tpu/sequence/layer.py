@@ -24,6 +24,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from deepspeed_tpu.utils.compat import shard_map as _shard_map_compat
 
 from deepspeed_tpu.parallel.topology import SEQ_AXIS
 from deepspeed_tpu.ops.flash_attention import flash_attention
@@ -38,7 +39,9 @@ def resolve_mesh(mesh: Optional[Mesh], axis: str) -> Mesh:
     the process-global topology (deepspeed_tpu.comm)."""
     if mesh is not None:
         return mesh
-    am = jax.sharding.get_abstract_mesh()
+    from deepspeed_tpu.utils.compat import get_abstract_mesh
+
+    am = get_abstract_mesh()
     if am is not None and axis in (am.axis_names or ()):
         return am
     import deepspeed_tpu.comm as dist
@@ -88,7 +91,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return gather_heads(out)
 
     spec = P(None, None, axis, None)
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    return _shard_map_compat(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, axis_names={axis},
                          check_vma=False)(q, k, v)
 

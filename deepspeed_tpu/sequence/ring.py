@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
+from deepspeed_tpu.utils.compat import shard_map as _shard_map_compat
 
 from deepspeed_tpu.parallel.topology import SEQ_AXIS
 from deepspeed_tpu.ops.flash_attention import DEFAULT_MASK_VALUE
@@ -105,6 +106,6 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return out.reshape(B, H, Sl, D).astype(q.dtype)
 
     spec = P(None, None, axis, None)
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    return _shard_map_compat(body, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec, axis_names={axis},
                          check_vma=False)(q, k, v)

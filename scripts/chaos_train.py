@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Chaos soak: train a tiny model under a seeded random fault schedule.
+
+Drives the SAME fault-injection hooks the unit tests use
+(``deepspeed_tpu/resilience/faults.py``) over an N-step run, simulating
+the failures large jobs actually hit — torn checkpoint writes, kills
+mid-async-save, transient I/O errors, SIGTERM preemption — and checks
+the run RECOVERS from every one of them: training reaches the target
+step count and the final checkpoint verifies and reloads.  Exits
+nonzero on any unrecovered failure.
+
+Deterministic: the schedule is a pure function of ``--seed``.
+
+Usage::
+
+    python scripts/chaos_train.py --steps 30 --seed 0
+    python scripts/chaos_train.py --steps 50 --faults 8 --seed 3
+"""
+import argparse
+import os
+import signal
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests",
+                                "unit"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+import deepspeed_tpu.comm as dist  # noqa: E402
+from deepspeed_tpu.checkpoint import sharded  # noqa: E402
+from deepspeed_tpu.resilience import FaultInjector, SimulatedCrash  # noqa: E402
+from deepspeed_tpu.resilience import faults as faults_mod  # noqa: E402
+
+FAULT_KINDS = ("torn", "crash", "oserror", "sigterm")
+
+
+def build_schedule(seed: int, steps: int, n_faults: int,
+                   save_interval: int):
+    """Deterministic fault schedule: ``{save_step: fault_kind}``.
+    Faults attach to save boundaries — that is where checkpoint
+    integrity is on the line."""
+    rng = np.random.default_rng(seed)
+    save_steps = list(range(save_interval, steps + 1, save_interval))
+    picks = rng.choice(len(save_steps), size=min(n_faults, len(save_steps)),
+                       replace=False)
+    return {save_steps[i]: FAULT_KINDS[int(rng.integers(len(FAULT_KINDS)))]
+            for i in sorted(picks)}
+
+
+def make_engine(ckpt_dir: str):
+    from simple_model import tiny_gpt2
+
+    topo = dist.initialize_mesh(dp=1, devices=jax.devices()[:1])
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), topology=topo,
+        config={"train_batch_size": 8,
+                "steps_per_print": 1_000_000,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "resilience": {"keep_last_k": 3, "verify_on_load": True}},
+        example_batch={"input_ids": np.zeros((8, 16), np.int32)},
+        rng=jax.random.PRNGKey(0))
+    engine.load_checkpoint(ckpt_dir)
+    return engine
+
+
+def data_fn(step: int):
+    rng = np.random.default_rng(1000 + step)
+    return {"input_ids": rng.integers(0, 128, size=(8, 16),
+                                      dtype=np.int32)}
+
+
+def injector_for(kind: str, seed: int) -> FaultInjector:
+    inj = FaultInjector(seed=seed)
+    if kind == "torn":
+        inj.torn_write("ckpt.write_record", after=1, fraction=0.5)
+    elif kind == "crash":
+        inj.crash("ckpt.write_record", after=2)
+    elif kind == "oserror":
+        inj.transient_oserror("ckpt.write_blob", count=2)
+    elif kind == "sigterm":
+        inj.sigterm("ckpt.commit")
+    return inj
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--faults", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--save-interval", type=int, default=5)
+    ap.add_argument("--dir", default=None,
+                    help="checkpoint dir (default: fresh tmpdir)")
+    args = ap.parse_args(argv)
+
+    ckpt_dir = args.dir or tempfile.mkdtemp(prefix="chaos_ckpt_")
+    schedule = build_schedule(args.seed, args.steps, args.faults,
+                              args.save_interval)
+    print(f"chaos_train: {args.steps} steps, schedule={schedule}, "
+          f"ckpt_dir={ckpt_dir}")
+
+    engine = make_engine(ckpt_dir)
+    engine.install_preemption_handler(ckpt_dir, exit_after=False)
+    n_scheduled = len(schedule)
+    recovered = 0
+    while engine.global_steps < args.steps:
+        step = engine.global_steps
+        engine.train_batch(batch=data_fn(step))
+        step = engine.global_steps
+        if step % args.save_interval != 0 and step != args.steps:
+            continue
+        # pop: after a crash-restart the run re-reaches this step and
+        # must not re-inject the same fault forever
+        kind = schedule.pop(step, None)
+        try:
+            if kind is None:
+                engine.save_checkpoint(ckpt_dir, async_save=(step % 2 == 0))
+                engine.wait_checkpoint()
+            else:
+                print(f"  step {step}: injecting {kind!r}")
+                with injector_for(kind, args.seed + step):
+                    engine.save_checkpoint(ckpt_dir,
+                                           async_save=(kind == "crash"))
+                    engine.wait_checkpoint()
+        except SimulatedCrash:
+            # the "process" died mid-save: restart from the last verified
+            # tag, exactly what the elastic agent would do
+            print(f"  step {step}: simulated crash; restarting from last "
+                  "verified checkpoint")
+            engine.uninstall_preemption_handler()
+            engine = make_engine(ckpt_dir)
+            engine.install_preemption_handler(ckpt_dir, exit_after=False)
+            recovered += 1
+        else:
+            if kind is not None:
+                recovered += 1
+    engine.uninstall_preemption_handler()
+
+    # final checkpoint must verify and reload at the final step
+    engine.save_checkpoint(ckpt_dir, tag="final", async_save=False)
+    ok, reason = sharded.verify_tag(os.path.join(ckpt_dir, "final"))
+    if not ok:
+        print(f"FAIL: final checkpoint does not verify: {reason}")
+        return 1
+    check = make_engine(ckpt_dir)
+    if check.global_steps != args.steps:
+        print(f"FAIL: resumed at step {check.global_steps}, "
+              f"expected {args.steps}")
+        return 1
+    if faults_mod.active() is not None:
+        print("FAIL: a FaultInjector leaked past its context")
+        return 1
+    print(f"OK: {args.steps} steps, {n_scheduled} faults injected, "
+          f"{recovered} recoveries, final checkpoint verified")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,29 @@ import pytest  # noqa: E402
 if not os.environ.get("DSTPU_TEST_ON_TPU"):
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # pre-0.5 jax: the option doesn't exist; the XLA flag does the
+        # same as long as backends haven't initialized yet
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=8")
+        # modern jax defaults this on; without it, params initialized
+        # under different shardings draw different random values, which
+        # breaks every dp-vs-tp parity test
+        jax.config.update("jax_threefry_partitionable", True)
+    # opt-in persistent XLA compile cache (DSTPU_XLA_CACHE=<dir>): warm
+    # runs halve suite time, but old-jax cache writes are not reliably
+    # concurrent-safe with the subprocess-spawning tests — so never on
+    # by default
+    if os.environ.get("DSTPU_XLA_CACHE"):
+        try:
+            jax.config.update("jax_compilation_cache_dir",
+                              os.environ["DSTPU_XLA_CACHE"])
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5)
+        except AttributeError:  # pragma: no cover - jax without the cache
+            pass
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,417 @@
+"""Fault-tolerance tests (resilience/): hardened checkpoints, preemption
+handling, restart budgets, and training guards — all driven by the
+deterministic fault-injection harness (``resilience/faults.py``), the
+same hooks ``scripts/chaos_train.py`` soaks.
+
+Everything is tier-1-fast: tmpdir checkpoints, and every backoff path
+runs against an injected fake clock (the autouse ``fake_sleep`` fixture
+fails the test if anything tries to really sleep).
+"""
+import os
+import signal
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.checkpoint import engine as ckpt_engine
+from deepspeed_tpu.checkpoint import sharded
+from deepspeed_tpu.resilience import (FaultInjector, GradientAnomalyError,
+                                      SimulatedCrash, retriable,
+                                      torn_write_file)
+from deepspeed_tpu.resilience import retry as retry_mod
+from simple_model import random_tokens, tiny_gpt2
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def fake_sleep(monkeypatch):
+    """Injectable clock: records requested delays, never really sleeps."""
+    delays = []
+    monkeypatch.setattr(retry_mod, "_sleep", delays.append)
+    return delays
+
+
+def _cfg(**over):
+    cfg = {
+        "train_batch_size": 8,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 100000,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _engine(cfg_over=None):
+    topo = dist.initialize_mesh(dp=8)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=_cfg(**(cfg_over or {})), topology=topo,
+        example_batch=random_tokens(8), rng=jax.random.PRNGKey(0))
+    return engine
+
+
+def _blob(ckpt_dir, tag):
+    return os.path.join(str(ckpt_dir), tag, "shards_p0.bin")
+
+
+# ---------------------------------------------------------------------------
+# retry.py
+# ---------------------------------------------------------------------------
+
+
+def test_retry_succeeds_after_transient_failures(fake_sleep):
+    calls = {"n": 0}
+
+    @retriable(attempts=4, base_s=0.1, jitter=0.5)
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("transient")
+        return "ok"
+
+    assert flaky() == "ok"
+    assert calls["n"] == 3
+    # two backoffs, exponential floor with additive-only jitter
+    assert len(fake_sleep) == 2
+    assert 0.1 <= fake_sleep[0] <= 0.15
+    assert 0.2 <= fake_sleep[1] <= 0.30
+
+
+def test_retry_exhausts_and_reraises(fake_sleep):
+    @retriable(attempts=3, base_s=0.1)
+    def always_failing():
+        raise OSError("persistent")
+
+    with pytest.raises(OSError, match="persistent"):
+        always_failing()
+    assert len(fake_sleep) == 2        # attempts-1 waits, then re-raise
+
+
+# ---------------------------------------------------------------------------
+# torn writes: detection, quarantine, fallback
+# ---------------------------------------------------------------------------
+
+
+def test_torn_write_quarantined_and_falls_back(tmp_path, devices):
+    """A tag corrupted after commit (truncated blob — power loss eating
+    unsynced pages) is detected at load, quarantined to <tag>.corrupt,
+    and the load falls back to the previous verified tag."""
+    engine = _engine()
+    batch = random_tokens(8, seed=1)
+    engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    steps_t1 = engine.global_steps
+    engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path), tag="t2")
+
+    torn_write_file(_blob(tmp_path, "t2"), fraction=0.5)
+
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path == str(tmp_path / "t1")
+    assert engine.global_steps == steps_t1
+    assert os.path.isdir(tmp_path / "t2.corrupt")
+    assert not os.path.isdir(tmp_path / "t2")
+    # the pointer was repaired to the verified tag
+    assert (tmp_path / "latest").read_text().strip() == "t1"
+    # training continues from the fallback
+    engine.train_batch(batch=batch)
+
+
+def test_single_bitflip_caught_by_crc(tmp_path, devices):
+    """Size-preserving corruption passes the structural check — only the
+    per-record crc32 catches it."""
+    engine = _engine()
+    engine.train_batch(batch=random_tokens(8, seed=2))
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    engine.train_batch(batch=random_tokens(8, seed=2))
+    engine.save_checkpoint(str(tmp_path), tag="t2")
+
+    blob = _blob(tmp_path, "t2")
+    with open(blob, "rb+") as f:
+        f.seek(os.path.getsize(blob) // 2)
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    ok, reason = sharded.verify_tag(str(tmp_path / "t2"), deep=False)
+    assert ok                                   # structurally intact...
+    ok, reason = sharded.verify_tag(str(tmp_path / "t2"), deep=True)
+    assert not ok and "crc" in reason           # ...but the crc knows
+
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path == str(tmp_path / "t1")
+
+
+def test_explicit_corrupt_tag_raises(tmp_path, devices):
+    """Asking for a specific corrupt tag must fail loudly, not silently
+    load some other tag."""
+    engine = _engine()
+    engine.train_batch(batch=random_tokens(8))
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    torn_write_file(_blob(tmp_path, "t1"), fraction=0.3)
+    with pytest.raises(RuntimeError, match="failed verification"):
+        engine.load_checkpoint(str(tmp_path), tag="t1")
+    assert os.path.isdir(tmp_path / "t1.corrupt")
+
+
+# ---------------------------------------------------------------------------
+# atomic commit: a kill mid-save leaves no visible partial tag
+# ---------------------------------------------------------------------------
+
+
+def test_kill_mid_async_save_leaves_no_visible_tag(tmp_path, devices):
+    engine = _engine()
+    batch = random_tokens(8, seed=3)
+    engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path), tag="good")
+    engine.train_batch(batch=batch)
+
+    with FaultInjector(seed=0) as inj:
+        inj.crash("ckpt.write_record", after=1)   # die mid-blob
+        engine.save_checkpoint(str(tmp_path), tag="doomed",
+                               async_save=True)
+        with pytest.raises(SimulatedCrash):
+            engine.wait_checkpoint()
+    assert inj.fired == [("ckpt.write_record", "crash", 2)]
+
+    # the commit rename never ran: no visible partial tag, pointer intact
+    assert not os.path.isdir(tmp_path / "doomed")
+    assert os.path.isdir(tmp_path / "tmp.doomed")
+    assert (tmp_path / "latest").read_text().strip() == "good"
+
+    engine._ckpt_saver = None                  # crashed "process" restarts
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path == str(tmp_path / "good")
+    # a retried save of the same tag clears the stale staging dir
+    engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path), tag="doomed")
+    assert os.path.isdir(tmp_path / "doomed")
+    assert not os.path.isdir(tmp_path / "tmp.doomed")
+
+
+def test_torn_write_mid_save_never_commits(tmp_path, devices):
+    """The injected kill-mid-flush variant: partial bytes hit the
+    staging dir, the tag never becomes visible."""
+    engine = _engine()
+    engine.train_batch(batch=random_tokens(8))
+    with FaultInjector(seed=0) as inj:
+        inj.torn_write("ckpt.write_record", after=2, fraction=0.25)
+        with pytest.raises(SimulatedCrash, match="torn write"):
+            engine.save_checkpoint(str(tmp_path), tag="t",
+                                   async_save=False)
+    assert not os.path.isdir(tmp_path / "t")
+    assert not os.path.exists(tmp_path / "latest")
+    ok, reason = sharded.verify_tag(str(tmp_path / "tmp.t"))
+    assert not ok                              # staging is visibly torn
+
+
+# ---------------------------------------------------------------------------
+# transient I/O errors retry
+# ---------------------------------------------------------------------------
+
+
+def test_transient_oserror_save_retries(tmp_path, devices, fake_sleep):
+    engine = _engine()
+    batch = random_tokens(8, seed=4)
+    engine.train_batch(batch=batch)
+    with FaultInjector(seed=0) as inj:
+        inj.transient_oserror("ckpt.write_blob", count=2)
+        engine.save_checkpoint(str(tmp_path), tag="t", async_save=False)
+    assert [k for _, k, _ in inj.fired] == ["oserror", "oserror"]
+    assert len(fake_sleep) == 2                # backed off twice, no sleep
+    ok, reason = sharded.verify_tag(str(tmp_path / "t"))
+    assert ok, reason
+
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path == str(tmp_path / "t")
+
+
+def test_transient_oserror_read_retries(tmp_path, devices, fake_sleep):
+    engine = _engine()
+    batch = random_tokens(8, seed=5)
+    engine.train_batch(batch=batch)
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    with FaultInjector(seed=0) as inj:
+        inj.transient_oserror("ckpt.read_record", count=2)
+        path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path == str(tmp_path / "t")
+    assert len(inj.fired) == 2
+
+
+# ---------------------------------------------------------------------------
+# preemption: SIGTERM -> emergency checkpoint
+# ---------------------------------------------------------------------------
+
+
+def test_sigterm_takes_loadable_emergency_checkpoint(tmp_path, devices):
+    engine = _engine()
+    batch = random_tokens(8, seed=6)
+    engine.train_batch(batch=batch)
+    # park an async save in flight: the handler must drain it first
+    engine.save_checkpoint(str(tmp_path), tag="periodic", async_save=True)
+    engine.install_preemption_handler(str(tmp_path), exit_after=False)
+    try:
+        signal.raise_signal(signal.SIGTERM)
+    finally:
+        engine.uninstall_preemption_handler()
+    assert engine.preempted
+    tag = f"emergency_step{engine.global_steps}"
+    ok, reason = sharded.verify_tag(str(tmp_path / tag))
+    assert ok, reason
+
+    steps = engine.global_steps
+    w_a = np.asarray(jax.tree_util.tree_leaves(
+        jax.device_get(engine.state.params))[0]).copy()
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path == str(tmp_path / tag)
+    assert engine.global_steps == steps
+    w_b = jax.tree_util.tree_leaves(jax.device_get(engine.state.params))[0]
+    np.testing.assert_array_equal(w_a, np.asarray(w_b))
+
+
+def test_fault_injector_can_deliver_sigterm(tmp_path, devices):
+    """The injector's sigterm fault exercises the real signal path at a
+    deterministic hook firing (here: just before a commit)."""
+    engine = _engine()
+    engine.train_batch(batch=random_tokens(8))
+    engine.install_preemption_handler(str(tmp_path), exit_after=False)
+    try:
+        with FaultInjector(seed=0) as inj:
+            inj.sigterm("ckpt.commit")
+            engine.save_checkpoint(str(tmp_path), tag="t", async_save=False)
+    finally:
+        engine.uninstall_preemption_handler()
+    assert engine.preempted
+    assert ("ckpt.commit", "sigterm", 1) in inj.fired
+    # both the interrupted tag and the emergency tag committed
+    assert sharded.verify_tag(str(tmp_path / "t"))[0]
+
+
+# ---------------------------------------------------------------------------
+# restart budget + backoff (elastic agent)
+# ---------------------------------------------------------------------------
+
+
+def test_agent_restart_budget_exhausts_with_backoff(tmp_path, devices):
+    from deepspeed_tpu.launcher import DSElasticAgent
+
+    delays = []
+
+    def build_engine(topo, cfg):
+        raise jax.errors.JaxRuntimeError("chip fell over")
+
+    agent = DSElasticAgent(
+        build_engine, {"train_batch_size": 8,
+                       "resilience": {"max_restarts": 3,
+                                      "backoff_base_s": 0.5}},
+        str(tmp_path), device_provider=lambda: jax.devices(),
+        sleep_fn=delays.append)
+    with pytest.raises(RuntimeError, match="exceeded 3 restarts") as ei:
+        agent.run(lambda step, gbs: None, 4)
+    assert isinstance(ei.value.__cause__, jax.errors.JaxRuntimeError)
+    # one jittered-exponential delay per hard failure within budget
+    assert len(delays) == 3
+    assert 0.5 <= delays[0] <= 0.75
+    assert 1.0 <= delays[1] <= 1.5
+    assert 2.0 <= delays[2] <= 3.0
+
+
+# ---------------------------------------------------------------------------
+# gradient-anomaly guard
+# ---------------------------------------------------------------------------
+
+
+def test_consecutive_skip_abort_at_bound(tmp_path, devices):
+    """An fp16 run whose every step overflows must abort at the
+    configured bound instead of spinning the loss scaler forever."""
+    import jax.numpy as jnp
+
+    topo = dist.initialize_mesh(dp=8)
+
+    def nan_loss(params, batch, rng):
+        return jnp.log(jnp.asarray(-1.0)) * jnp.sum(params["w"]) + \
+            jnp.mean(batch["x"])
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=nan_loss,
+        model_parameters={"w": np.ones((4,), np.float32)},
+        config=_cfg(fp16={"enabled": True},
+                    resilience={"max_consecutive_skips": 3}),
+        topology=topo)
+    batch = {"x": np.ones((8, 4), np.float32)}
+    engine.train_batch(batch=batch)
+    engine.train_batch(batch=batch)
+    assert engine.skipped_steps == 2
+    with pytest.raises(GradientAnomalyError, match="3 consecutive"):
+        engine.train_batch(batch=batch)
+
+
+# ---------------------------------------------------------------------------
+# keep-last-k GC
+# ---------------------------------------------------------------------------
+
+
+def test_keep_last_k_gc(tmp_path, devices):
+    engine = _engine(cfg_over={"resilience": {"keep_last_k": 2}})
+    batch = random_tokens(8, seed=7)
+    for i in range(4):
+        engine.train_batch(batch=batch)
+        engine.save_checkpoint(str(tmp_path), tag=f"t{i}")
+    tags = sorted(d for d in os.listdir(tmp_path)
+                  if os.path.isdir(tmp_path / d))
+    assert tags == ["t2", "t3"]
+    assert (tmp_path / "latest").read_text().strip() == "t3"
+
+
+def test_gc_never_deletes_only_verified_tag(tmp_path, devices):
+    """With every newer tag corrupt, GC must spare the one old tag that
+    still verifies — it is the job's only resume point."""
+    engine = _engine()
+    batch = random_tokens(8, seed=8)
+    for i in range(3):
+        engine.train_batch(batch=batch)
+        engine.save_checkpoint(str(tmp_path), tag=f"t{i}")
+    torn_write_file(_blob(tmp_path, "t1"), 0.5)
+    torn_write_file(_blob(tmp_path, "t2"), 0.5)
+
+    ckpt_engine._gc_tags(str(tmp_path), keep_last_k=1)
+    remaining = sorted(d for d in os.listdir(tmp_path)
+                       if os.path.isdir(tmp_path / d))
+    assert "t0" in remaining                   # spared: only verified tag
+    assert "t2" in remaining                   # within keep_last_k
+    assert "t1" not in remaining               # corrupt AND old -> gone
+
+    # and the load walks back to the verified survivor
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path == str(tmp_path / "t0")
+
+
+# ---------------------------------------------------------------------------
+# injector determinism
+# ---------------------------------------------------------------------------
+
+
+def test_fault_injector_is_deterministic():
+    def drive(inj):
+        with inj:
+            from deepspeed_tpu.resilience import faults as F
+            fired = []
+            for i in range(6):
+                try:
+                    F.hook("site.a", i=i)
+                except OSError:
+                    fired.append(i)
+        return fired, list(inj.fired)
+
+    a = drive(FaultInjector(seed=7).transient_oserror("site.a", count=2,
+                                                      after=1))
+    b = drive(FaultInjector(seed=7).transient_oserror("site.a", count=2,
+                                                      after=1))
+    assert a == b
+    assert a[0] == [1, 2]                      # armed after 1 call, twice

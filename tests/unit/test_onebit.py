@@ -62,6 +62,7 @@ class TestCompressedAllreduce:
         true_mean = xs.mean(axis=0)
         wn, sn = error_shapes(numel, n)
 
+        @jax.jit
         @functools.partial(
             shard_map, mesh=topo.mesh,
             in_specs=(P(("data", "data_sub")), P(("data", "data_sub")),

@@ -5,6 +5,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from deepspeed_tpu.utils.compat import shard_map as _shard_map_compat
 import numpy as np
 import pytest
 
@@ -114,7 +115,7 @@ class TestLoCo:
                               jnp.float32) * 0.01
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P("data"),
+            _shard_map_compat, mesh=mesh, in_specs=P("data"),
             out_specs=(P("data"), P("data")), axis_names={"data"},
             check_vma=False)
         def steps_loco(xs):
@@ -127,7 +128,7 @@ class TestLoCo:
                 acc = acc + out
             return acc / K, err[0]
 
-        @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("data"),
+        @functools.partial(_shard_map_compat, mesh=mesh, in_specs=P("data"),
                            out_specs=P("data"), axis_names={"data"},
                            check_vma=False)
         def exact(xs):
@@ -140,7 +141,7 @@ class TestLoCo:
         ref = jax.jit(exact)(x)
         loco_err = float(jnp.abs(avg_loco - ref).max())
 
-        @functools.partial(jax.shard_map, mesh=mesh, in_specs=P("data"),
+        @functools.partial(_shard_map_compat, mesh=mesh, in_specs=P("data"),
                            out_specs=P("data"), axis_names={"data"},
                            check_vma=False)
         def plain(xs):
@@ -165,7 +166,7 @@ class TestLoCo:
                               jnp.float32)
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P("data"),
+            _shard_map_compat, mesh=mesh, in_specs=P("data"),
             out_specs=(P("data"), P("data")), axis_names={"data"},
             check_vma=False)
         def one(xs):

@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from deepspeed_tpu.utils.compat import shard_map as _shard_map_compat
 
 import deepspeed_tpu.comm as dist
 from deepspeed_tpu.comm.quantized import (quantized_all_gather,
@@ -25,7 +26,7 @@ def test_quantized_all_gather_matches_all_gather(devices):
     def f(x):
         return quantized_all_gather(x, group="data", group_size=128)
 
-    out = jax.jit(jax.shard_map(f, mesh=topo.mesh,
+    out = jax.jit(_shard_map_compat(f, mesh=topo.mesh,
                                 in_specs=P("data"), out_specs=P("data"),
                                 check_vma=False))(full)
     # every member reconstructs the full array up to int8 group error
@@ -59,7 +60,7 @@ def test_quantized_reduce_scatter_approximates_psum_scatter(devices, axes,
         return out
 
     got, want = [
-        jax.jit(jax.shard_map(f, mesh=topo.mesh, in_specs=P(axes),
+        jax.jit(_shard_map_compat(f, mesh=topo.mesh, in_specs=P(axes),
                               out_specs=P(axes), check_vma=False))(
             contrib.reshape(-1, 16))
         for f in (quant, exact)
@@ -101,7 +102,7 @@ def test_quantized_dp_training_tracks_full_precision(devices):
                 g = jax.lax.pmean(g, "data")
             return w - 0.3 * g, loss
 
-        return jax.jit(jax.shard_map(
+        return jax.jit(_shard_map_compat(
             step, mesh=topo.mesh,
             in_specs=(P(), P("data"), P("data")),
             out_specs=(P(), P()), check_vma=False))
@@ -132,7 +133,7 @@ def test_multi_axis_roundtrip_preserves_layout(devices):
                                          group_size=8)
         return quantized_all_gather(shard, group=axes, group_size=8)
 
-    out = jax.jit(jax.shard_map(f, mesh=topo.mesh, in_specs=P(axes),
+    out = jax.jit(_shard_map_compat(f, mesh=topo.mesh, in_specs=P(axes),
                                 out_specs=P(axes), check_vma=False))(x)
     # every member contributed identical slices? No: in_specs=P(axes)
     # shards x, so the sum reduces 8 distinct slices; the reconstruction
@@ -154,7 +155,7 @@ def test_int4_packing_halves_payload(devices):
 
     topo = _mesh8()
     full = rng.normal(size=(64, 32)).astype(np.float32)
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(_shard_map_compat(
         lambda x: quantized_all_gather(x, group="data", num_bits=4,
                                        group_size=64),
         mesh=topo.mesh, in_specs=P("data"), out_specs=P("data"),
