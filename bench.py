@@ -515,16 +515,26 @@ def bench_inference(args) -> None:
         lambda: jnp.asarray(engine.generate(ids, max_new_tokens=new)), n=3)
     n_chips = len(jax.devices())
     tps = bsz * new / dev_dt
+    # Two floors, both FIXED (VERDICT Weak #5: a floor re-based to the
+    # current round's result makes vs_baseline 1.0 by construction and
+    # measures nothing).  The ORIGINAL floor (19305.7, the r4 batch-32
+    # result this config first regressed against) is the headline
+    # vs_baseline; the r5 batch-64 re-measure (20552.8) is reported
+    # alongside as vs_baseline_current for the like-for-like batch-64
+    # comparison.  Neither may ever move with the round's own result.
+    floor_orig = 19305.7                  # r4, batch 32
+    floor_batch64 = 20552.8               # r5, batch 64
     print(json.dumps({
         "metric": "gpt2_125m_decode_tokens_per_sec",
         "value": round(tps, 1),
         "unit": "tokens/s",
-        # floor = this config's round-5 result AT batch 64 (20552.8
-        # tok/s device; the old 19305.7 floor was measured at batch 32
-        # and no longer compares like-for-like) — serving must not
-        # regress round over round
-        "vs_baseline": round(tps / 20552.8, 3) if on_tpu else 0.0,
+        "vs_baseline": round(tps / floor_orig, 3) if on_tpu else 0.0,
+        "vs_baseline_orig": round(tps / floor_orig, 3) if on_tpu else 0.0,
+        "vs_baseline_current": (round(tps / floor_batch64, 3)
+                                if on_tpu else 0.0),
         "detail": {"batch": bsz, "prompt": prompt, "new_tokens": new,
+                   "floor_orig_batch32": floor_orig,
+                   "floor_current_batch64": floor_batch64,
                    "tokens_per_sec_per_chip": round(tps / n_chips, 1),
                    "wall_tokens_per_sec": round(bsz * new / wall_dt, 1),
                    "device_call_ms": round(dev_dt * 1e3, 1),
@@ -929,8 +939,13 @@ def bench_infinity(args) -> None:
         swapper.apply(sub_params, sub_grads, lr=1e-4, gscale=1.0)
         nbytes = sum(v.size * 8 for v in sub_params.values())
         t0 = time.perf_counter()
+        swapper.start_prefetch()          # as the engine does, post-bwd
         swapper.apply(sub_params, sub_grads, lr=1e-4, gscale=1.0)
+        swapper.drain()                   # charge deferred write-back here
         swap_s = time.perf_counter() - t0
+        # per-stage pipeline waits: the evidence that the stream is
+        # overlap-bound or bandwidth-bound, not an asserted property
+        detail["nvme_swap_stages"] = dict(swapper.stage_stats)
     finally:
         swapper.close()
     stream_gbps = 2 * nbytes / swap_s / 1e9

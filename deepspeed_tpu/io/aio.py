@@ -97,8 +97,25 @@ def _ensure_built() -> ctypes.CDLL:
             fn.argtypes = [ctypes.c_void_p]
         lib.aio_file_size.restype = ctypes.c_int64
         lib.aio_file_size.argtypes = [ctypes.c_char_p]
+        lib.aio_prealloc.restype = ctypes.c_int
+        lib.aio_prealloc.argtypes = [ctypes.c_char_p, ctypes.c_int64]
         _lib = lib
         return lib
+
+
+_DIRECT_ALIGN = 4096
+
+
+def aligned_empty(n: int, dtype=np.uint8) -> np.ndarray:
+    """Uninitialized 1-D array of ``n`` elements whose data pointer is
+    kDirectAlign(4096)-aligned — the O_DIRECT eligibility requirement
+    the native engine checks per chunk.  numpy's allocator only
+    guarantees 16-byte alignment, so buffers meant for O_DIRECT
+    streaming (swap bucket buffers, bench buffers) come from here."""
+    dt = np.dtype(dtype)
+    raw = np.empty(n * dt.itemsize + _DIRECT_ALIGN, np.uint8)
+    off = (-raw.ctypes.data) % _DIRECT_ALIGN
+    return raw[off:off + n * dt.itemsize].view(dt)
 
 
 def _buf_ptr(arr: np.ndarray):
@@ -110,7 +127,7 @@ class aio_handle:
     """Reference ``aio_handle`` surface (``deepspeed_py_io_handle.cpp``):
     thread-pooled, chunk-parallel file I/O with sync and async calls."""
 
-    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 64,
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 128,
                  single_submit: bool = False, overlap_events: bool = True,
                  thread_count: int = 8, use_odirect: bool = False,
                  backend: str = "auto"):
@@ -206,14 +223,22 @@ class aio_handle:
 def _pretruncate(path: str, size: int, exact: bool = True) -> None:
     """Size the file before parallel chunk writes (chunk opens use
     O_CREAT without O_TRUNC — truncating per-chunk would race).
-    ``exact=False`` only ever EXTENDS, safe around in-flight writes."""
+    ``exact=False`` only ever EXTENDS, safe around in-flight writes.
+    Extensions go through the native ``aio_prealloc`` (fallocate), so
+    the extents exist before the parallel writers hit them — extent
+    allocation mid-stream is one of the two things that held the write
+    path below the read path (the other is the page cache; O_DIRECT)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     with open(path, "ab"):
         pass
     cur = os.path.getsize(path)
-    if cur != size and (exact or cur < size):
+    if cur < size:
+        st = _ensure_built().aio_prealloc(path.encode(), size)
+        if st != 0:
+            raise OSError(-st, os.strerror(-st), path)
+    elif cur > size and exact:
         os.truncate(path, size)
 
 

@@ -13,9 +13,19 @@
 // the TPU-host equivalent of the reference's libaio queue_depth: device
 // parallelism comes from ring depth, not thread count, so one core
 // saturates an NVMe.  Falls back to pread/pwrite loops when the kernel
-// lacks io_uring.  O_DIRECT is honored when pointer/offset/length meet
-// alignment (per-chunk check; falls back to buffered I/O like the
-// reference's bounce-buffer path).
+// lacks io_uring.
+//
+// Write path is built for READ PARITY (the reference's ds_io target):
+// files are preallocated (fallocate) before parallel chunk writes so no
+// worker stalls on extent allocation, chunk boundaries are
+// kDirectAlign-aligned, and O_DIRECT is honored whenever pointer+offset
+// are aligned — an unaligned LENGTH splits into an aligned O_DIRECT
+// main body plus a small buffered tail (disjoint byte ranges, so the
+// mixed-mode coherence caveat doesn't bite), instead of silently
+// degrading the whole chunk to buffered I/O like the old per-chunk
+// all-or-nothing check.  A fully unaligned pointer falls back to
+// buffered I/O (the reference's bounce-buffer path; callers that want
+// O_DIRECT allocate via the Python-side aligned_empty()).
 //
 // Exposed as a plain C ABI for ctypes (no pybind11 in this image).
 
@@ -274,62 +284,86 @@ int open_file(const std::string& path, bool write, bool odirect) {
     return ::open(path.c_str(), flags, 0644);
 }
 
+// pread/pwrite loop over [off, off+len) of the user buffer
+int plain_rw(int fd, bool write, char* buf, size_t len, size_t file_off) {
+    size_t done = 0;
+    while (done < len) {
+        ssize_t n = write
+            ? ::pwrite(fd, buf + done, len - done, (off_t)(file_off + done))
+            : ::pread(fd, buf + done, len - done, (off_t)(file_off + done));
+        if (n < 0) { if (errno == EINTR) continue; return -errno; }
+        if (n == 0) return -EIO;            // short read / no space
+        done += (size_t)n;
+    }
+    return 0;
+}
+
+// drive [0, len) of c through this worker's ring (falling back to the
+// pread/pwrite loop where the kernel lacks usable io_uring), against fd
+int engine_rw(Handle* h, int fd, const Chunk& c, char* buf, size_t len,
+              size_t file_off) {
+    int status = -ENOSYS;
+    if (h->backend == 1) {
+        thread_local Ring ring;
+        thread_local unsigned ring_depth = 0;
+        if (ring.fd < 0 || ring_depth != h->queue_depth) {
+            ring.close();
+            if (ring.init(h->queue_depth)) ring_depth = h->queue_depth;
+        }
+        if (ring.fd >= 0)
+            status = uring_rw(ring, fd, c.write, buf, len, file_off,
+                              h->block_size, h->queue_depth);
+    }
+    // -EINVAL / -EOPNOTSUPP: kernels 5.1-5.5 pass the io_uring_setup
+    // probe but lack IORING_OP_READ/WRITE (5.6+) and fail per-op —
+    // fall back to the pread/pwrite loop on the SAME fd (alignment
+    // constraints are identical; O_DIRECT refusal is handled one level
+    // up with a buffered reopen)
+    if (status == -ENOSYS || status == -EOPNOTSUPP)
+        status = plain_rw(fd, c.write, buf, len, file_off);
+    return status;
+}
+
 void run_chunk(Handle* h, Chunk& c) {
-    // O_DIRECT requires aligned pointer/offset/length; check per chunk
-    bool aligned = ((uintptr_t)c.buf % kDirectAlign == 0) &&
-                   (c.offset % kDirectAlign == 0) &&
-                   (c.nbytes % kDirectAlign == 0);
-    bool odirect = c.use_odirect && aligned;
+    // O_DIRECT needs aligned pointer/offset/length.  Pointer+offset
+    // alignment is required up front; an unaligned length only demotes
+    // the TAIL (the sub-kDirectAlign remainder) to buffered I/O — the
+    // aligned main body still bypasses the page cache, which is where
+    // write parity with the read path comes from on NVMe.
+    bool head_ok = ((uintptr_t)c.buf % kDirectAlign == 0) &&
+                   (c.offset % kDirectAlign == 0);
+    size_t main_len = c.nbytes & ~(kDirectAlign - 1);
+    bool odirect = c.use_odirect && head_ok && main_len > 0;
+    size_t tail = odirect ? c.nbytes - main_len : 0;
     int fd = open_file(c.path, c.write, odirect);
     int status = 0;
     if (fd < 0) {
         status = -errno;
     } else {
-        if (h->backend == 1) {
-            thread_local Ring ring;
-            thread_local unsigned ring_depth = 0;
-            if (ring.fd < 0 || ring_depth != h->queue_depth) {
-                ring.close();
-                if (!ring.init(h->queue_depth)) {
-                    status = -ENOSYS;
-                } else {
-                    ring_depth = h->queue_depth;
-                }
-            }
-            if (status == 0) {
-                status = uring_rw(ring, fd, c.write, c.buf, c.nbytes,
-                                  c.offset, h->block_size,
-                                  h->queue_depth);
-                // O_DIRECT EINVAL (fs refuses) -> buffered retry
-                if (status == -EINVAL && odirect) {
-                    ::close(fd);
-                    fd = open_file(c.path, c.write, false);
-                    status = fd < 0 ? -errno
-                        : uring_rw(ring, fd, c.write, c.buf, c.nbytes,
-                                   c.offset, h->block_size,
-                                   h->queue_depth);
-                }
-            }
+        size_t drive_len = odirect ? main_len : c.nbytes;
+        status = engine_rw(h, fd, c, c.buf, drive_len, c.offset);
+        if (status == -EINVAL && odirect) {
+            // the fs accepted O_DIRECT at open but refuses the ops
+            // (e.g. tmpfs quirks, fs-specific alignment > 4096):
+            // buffered retry of the WHOLE chunk, tail included
+            ::close(fd);
+            tail = 0;
+            fd = open_file(c.path, c.write, false);
+            status = fd < 0 ? -errno
+                : engine_rw(h, fd, c, c.buf, c.nbytes, c.offset);
         }
-        // -EINVAL / -EOPNOTSUPP also reach here: kernels 5.1-5.5 pass
-        // the io_uring_setup probe but lack IORING_OP_READ/WRITE (5.6+)
-        // and fail per-op — fall back to the pread/pwrite loop
-        if (h->backend == 0 || status == -ENOSYS || status == -EINVAL ||
-            status == -EOPNOTSUPP) {
-            status = 0;
-            size_t done = 0;
-            while (done < c.nbytes) {
-                ssize_t n = c.write
-                    ? ::pwrite(fd, c.buf + done, c.nbytes - done,
-                               (off_t)(c.offset + done))
-                    : ::pread(fd, c.buf + done, c.nbytes - done,
-                              (off_t)(c.offset + done));
-                if (n < 0) { status = -errno; break; }
-                if (n == 0) { status = -EIO; break; }   // short read
-                done += (size_t)n;
-            }
+        if (status == 0 && tail > 0) {
+            // buffered tail on a separate fd: its byte range is
+            // disjoint from every O_DIRECT range in this job (chunk
+            // boundaries are kDirectAlign-aligned), so page-cache vs
+            // direct coherence never overlaps
+            int tfd = open_file(c.path, c.write, false);
+            status = tfd < 0 ? -errno
+                : plain_rw(tfd, c.write, c.buf + main_len, tail,
+                           c.offset + main_len);
+            if (tfd >= 0) ::close(tfd);
         }
-        ::close(fd);
+        if (fd >= 0) ::close(fd);
         if (status == 0) {
             if (c.write) h->bytes_written += (int64_t)c.nbytes;
             else         h->bytes_read    += (int64_t)c.nbytes;
@@ -369,8 +403,11 @@ int64_t submit(Handle* h, bool write, const char* path, void* buf,
         if (nchunks > (size_t)h->nthreads) nchunks = (size_t)h->nthreads;
     }
     size_t per = (nbytes + nchunks - 1) / nchunks;
-    // O_DIRECT needs 512-aligned chunk boundaries
-    if (h->use_odirect && per % 512) per += 512 - per % 512;
+    // O_DIRECT needs kDirectAlign-aligned chunk boundaries (offsets are
+    // base + k*per, so aligning per keeps every non-tail chunk eligible
+    // for the direct path, not just 512-sector-aligned ones)
+    if (h->use_odirect && per % kDirectAlign)
+        per += kDirectAlign - per % kDirectAlign;
     std::vector<Chunk> chunks;
     for (size_t off = 0; off < nbytes; off += per) {
         Chunk c;
@@ -496,6 +533,28 @@ int64_t aio_file_size(const char* path) {
     struct stat st;
     if (::stat(path, &st) != 0) return -errno;
     return (int64_t)st.st_size;
+}
+
+// Extend-only preallocation: size the file AND reserve its extents
+// before parallel chunk writes, so no worker stalls inside the fs
+// allocator mid-stream (the reference preallocates its swap buffers the
+// same way).  Never shrinks.  Returns 0 or -errno.
+int aio_prealloc(const char* path, int64_t size) {
+    int fd = ::open(path, O_WRONLY | O_CREAT, 0644);
+    if (fd < 0) return -errno;
+    int status = 0;
+    struct stat st;
+    if (::fstat(fd, &st) != 0) {
+        status = -errno;
+    } else if (st.st_size < size) {
+        int rc = ::posix_fallocate(fd, 0, size);
+        // fs without fallocate support (e.g. some overlay/tmpfs): a
+        // plain size extension still gives parallel writers a stable
+        // file length (extents then allocate lazily)
+        if (rc != 0 && ::ftruncate(fd, size) != 0) status = -errno;
+    }
+    ::close(fd);
+    return status;
 }
 
 }  // extern "C"

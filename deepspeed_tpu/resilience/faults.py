@@ -18,6 +18,8 @@ Instrumented sites (the stable surface; grep for ``faults.hook``):
 ``ckpt.commit``           just before the atomic staging->tag rename
 ``ckpt.read_record``      before each shard-record read (retry target)
 ``swap.write_item``       before each NVMe moment-file write
+``swap.write_bucket``     before each pipelined bucket write-back submit
+                          (async submit AND its blocking retry path)
 ========================  ==================================================
 
 A fault is scheduled with ``inject(site, kind, ...)`` (or the named

@@ -380,7 +380,10 @@ class DeepSpeedEngine:
                 aio_block_size=config.aio.block_size,
                 aio_thread_count=config.aio.thread_count,
                 aio_queue_depth=config.aio.queue_depth,
-                aio_use_odirect=config.aio.use_odirect)
+                aio_use_odirect=config.aio.use_odirect,
+                pipeline_read=offl_o.pipeline_read,
+                pipeline_write=offl_o.pipeline_write,
+                buffer_count=offl_o.buffer_count)
             opt_state, opt_shardings, opt_specs = (), (), None
         elif want_opt_stream:
             from deepspeed_tpu.runtime.swap_tensor import HostMomentSwapper
@@ -1095,6 +1098,13 @@ class DeepSpeedEngine:
                 grads = self._host_tree_add(grads, g)
             else:
                 grads = jax.tree_util.tree_map(jnp.add, grads, g)
+        # overlap the swap pipeline's HEAD with the in-flight bwd: the
+        # grad dispatches above are async, so the first read window's
+        # NVMe traffic (and any deferred write-back from the previous
+        # step) runs while the device is still computing — the first
+        # bucket's swap-in is free by the time apply() starts
+        if hasattr(self.nvme_swapper, "start_prefetch"):
+            self.nvme_swapper.start_prefetch()
         new_state, metrics = self._nvme_apply_grads(
             grads, lr, rng, leafwise=host_grads, gmetrics=gmetrics)
         metrics["loss"] = loss_sum / self.gas
@@ -1158,10 +1168,16 @@ class DeepSpeedEngine:
                     lambda g: (prec.has_inf_or_nan(g),
                                prec.global_norm(g)))
             overflow, norm_raw = self._nvme_metrics_fn(grads)
-        scale_f = float(jax.device_get(state.scale.loss_scale))
+        # ONE blocking transfer for all three scalars: each device_get
+        # is a full client round-trip (hundreds of ms through a remote
+        # tunnel), and this sync is also the barrier the swap prefetch
+        # overlaps — keep it singular
+        scale_f, ovf, norm = jax.device_get(
+            (state.scale.loss_scale, overflow, norm_raw))
+        scale_f = float(scale_f)
+        ovf = bool(ovf)
         inv = 1.0 / (scale_f * self.gas)
-        ovf = bool(jax.device_get(overflow))
-        norm = float(jax.device_get(norm_raw)) * inv
+        norm = float(norm) * inv
         gscale = inv
         clip = self.config.gradient_clipping
         if clip and clip > 0:
@@ -1175,9 +1191,21 @@ class DeepSpeedEngine:
             init_hysteresis=fp16.hysteresis)
         if ovf:
             new_params = state.params
+            if hasattr(self.nvme_swapper, "cancel_prefetch"):
+                # the skipped step must not leak its prefetched reads
+                # into the next step's buffer pool
+                self.nvme_swapper.cancel_prefetch()
         else:
             new_params = self.nvme_swapper.apply(state.params, grads,
                                                  lr=lr, gscale=gscale)
+            stats = getattr(self.nvme_swapper, "stage_stats", None)
+            if stats and self.config.wall_clock_breakdown:
+                # per-stage swap waits join the breakdown timer group —
+                # link-boundedness is measurable, not asserted
+                for name in ("swap_in_wait", "bucket_update",
+                             "swap_out_wait"):
+                    if stats.get(f"{name}_s") is not None:
+                        self.timers(name).record(stats[f"{name}_s"])
         rng, new_rng = jax.random.split(rng)
         new_state = TrainState(
             step=state.step + 1, params=new_params,
@@ -1330,8 +1358,13 @@ class DeepSpeedEngine:
                 f"loss_scale={float(m['loss_scale']):.0f}", ranks=[0])
             if breakdown:
                 # elapsed accumulates across steps_per_print steps; report
-                # per-step times like the reference EngineTimers
-                self.timers.log(["batch_prep", STEP_GLOBAL_TIMER],
+                # per-step times like the reference EngineTimers (plus the
+                # swap pipeline's stage waits when a swapped tier is live)
+                names = ["batch_prep", STEP_GLOBAL_TIMER]
+                names += [n for n in ("swap_in_wait", "bucket_update",
+                                      "swap_out_wait")
+                          if self.timers.has_timer(n)]
+                self.timers.log(names,
                                 normalizer=self.config.steps_per_print)
         if self.monitor is not None and self.monitor.enabled:
             m = jax.device_get(metrics)

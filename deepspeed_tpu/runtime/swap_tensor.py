@@ -8,17 +8,31 @@ through the device in flat contiguous BUCKETS (single-process; one
 bucket per transformer layer so every layer reuses one compiled
 program):
 
-    read bucket(k+1) from NVMe    ─┐ overlapped (native AIO threads)
-    update bucket k on device      ─┤ one dispatch, one bulk copy each way
-    write bucket(k-1) back to NVMe ─┘ async, bounded in-flight
+    read bucket(k+1..k+B-1) from NVMe ─┐ overlapped (native AIO threads)
+    update bucket k on device          ─┤ one dispatch, one bulk copy each way
+    write bucket(k-1) back to NVMe     ─┘ async, bounded in-flight
 
-matching the reference's flat-partition double buffering
-(``pipelined_optimizer_swapper.py:47`` /
-``partitioned_optimizer_swapper.py:35``) — a leaf-at-a-time stream is
-latency-bound (measured 0.014 GB/s vs ~1 GB/s bulk on the same AIO
-engine); the bucketed stream is bandwidth-bound.  Multi-process jobs
-fall back to the leafwise stream, where each rank swaps only its own
-addressable shards.  HBM and host RAM hold O(bucket), not O(model).
+as a true three-stage software pipeline (reference
+``pipelined_optimizer_swapper.py:47`` — double-buffered swap-in /
+swap-out around the compute stage): a pool of ``buffer_count``
+page-aligned pinned-host read buffers keeps up to ``B-1`` bucket reads
+in flight ahead of the compute, write-back drains behind it under a
+bounded in-flight budget, and the FIRST window's reads plus the LAST
+buckets' write-backs overlap fwd/bwd of the surrounding steps
+(:meth:`NvmeOptimizerSwapper.start_prefetch`, called by the engine
+right after dispatching the grad step, and the deferred write-back
+drained at the next step's stream start).  A failed async write retries
+through the blocking path with jittered backoff before the stream
+invalidates.  Per-stage waits (``swap_in_wait`` / ``bucket_update`` /
+``swap_out_wait``) are measured every apply and surfaced through
+``stage_stats`` / the engine's ``wall_clock_breakdown`` — the
+link-boundedness of the stream is observable, not asserted.
+
+A leaf-at-a-time stream is latency-bound (measured 0.014 GB/s vs ~1
+GB/s bulk on the same AIO engine); the bucketed stream is
+bandwidth-bound.  Multi-process jobs fall back to the leafwise stream,
+where each rank swaps only its own addressable shards.  HBM and host
+RAM hold O(buffer_count * bucket), not O(model).
 
 The optimizer math is the Adam/AdamW family only (the reference swapper
 equally assumes a ``DeepSpeedCPUAdam``-style optimizer whose state is
@@ -180,6 +194,73 @@ def _write_item_file(dst: str, m, v) -> None:
         os.replace(tmp, dst)
 
     _write()
+
+
+def _write_item_files_bulk(handle, dirpath: str, entries) -> None:
+    """Write many items' ``[m; v]`` files through the AIO engine at
+    once — the bulk replacement for the old one-``_write_item_file``-at-
+    a-time loop (per-item sync writes are latency-bound exactly like the
+    leafwise moment stream was; N items in flight run in the file
+    bench's bandwidth regime).  ``entries`` is ``[(item, m, v), ...]``
+    with fp32 views.  Atomicity per item is preserved (tmp + rename
+    after the waits); an item whose async write fails falls back to the
+    sync retriable path."""
+    pend = []
+    for it, m, v in entries:
+        dst = _item_fname(dirpath, it)
+        try:
+            from deepspeed_tpu.resilience import faults
+
+            faults.hook("swap.write_item", path=dst)
+            m32 = np.ascontiguousarray(m, np.float32)
+            v32 = np.ascontiguousarray(v, np.float32)
+            tmp = f"{dst}.tmp.p{jax.process_index()}"
+            from deepspeed_tpu.io.aio import _pretruncate
+
+            _pretruncate(tmp, m32.nbytes + v32.nbytes, exact=True)
+            ops = (handle.async_pwrite(m32, tmp, 0, _truncate=False),
+                   handle.async_pwrite(v32, tmp, m32.nbytes,
+                                       _truncate=False))
+            pend.append((dst, tmp, ops, m, v))
+        except OSError:
+            _write_item_file(dst, m, v)         # sync + retriable
+    for dst, tmp, ops, m, v in pend:
+        ok = True
+        for op in ops:
+            try:
+                handle.wait(op)
+            except OSError:
+                ok = False
+        if ok:
+            os.replace(tmp, dst)
+        else:
+            _write_item_file(dst, m, v)
+
+
+def _read_item_files_bulk(handle, entries) -> None:
+    """Fill many items' ``(m, v)`` views from their ``[m; v]`` files
+    through the AIO engine at once (bulk counterpart of the old
+    per-item ``np.fromfile`` loop).  Missing files are skipped (their
+    views keep whatever the caller zero-initialized); a failed async
+    read falls back to a sync ``np.fromfile``."""
+    pend = []
+    for fname, it, m, v in entries:
+        if not os.path.exists(fname):
+            continue
+        ops = (handle.async_pread(m, fname, 0),
+               handle.async_pread(v, fname, 4 * it["n"]))
+        pend.append((fname, it, m, v, ops))
+    for fname, it, m, v, ops in pend:
+        ok = True
+        for op in ops:
+            try:
+                handle.wait(op)
+            except OSError:
+                ok = False
+        if not ok:
+            raw = np.fromfile(fname, dtype=np.float32)
+            m[:] = raw[:it["n"]]
+            v[:] = raw[it["n"]:2 * it["n"]]
 
 
 def _copy_atomic(src: str, dst: str) -> None:
@@ -365,9 +446,12 @@ class NvmeOptimizerSwapper:
                  adam_w_mode: bool = True,
                  aio_block_size: int = 1 << 20,
                  aio_thread_count: int = 8,
-                 aio_queue_depth: int = 64,
+                 aio_queue_depth: int = 128,
                  aio_use_odirect: bool = False,
-                 bucket_bytes: int = 2 << 30):
+                 bucket_bytes: int = 2 << 30,
+                 pipeline_read: bool = True,
+                 pipeline_write: bool = True,
+                 buffer_count: int = 3):
         from deepspeed_tpu.io.aio import aio_handle
 
         # pid-scoped: two jobs pointing at the same NVMe mount must not
@@ -399,6 +483,29 @@ class NvmeOptimizerSwapper:
         self.wd = float(weight_decay)
         self.adam_w_mode = bool(adam_w_mode)
         self.count = 0                      # successful (non-overflow) steps
+        # -- pipeline shape (reference OffloadOptimizerConfig knobs:
+        # pipeline_read / pipeline_write / buffer_count).  The read pool
+        # holds `buffer_count` page-aligned host buffers; read-ahead is
+        # bounded at buffer_count-1 by the reuse invariant (a slot is
+        # reissued only after the compute that consumed its previous
+        # tenant has been FORCED via its output fetch — an earlier reuse
+        # would alias a buffer the in-flight dispatch may still read).
+        # Write-back keeps at most buffer_count-1 bucket writes in
+        # flight; pipeline_write additionally defers the trailing writes
+        # past apply() so they drain under the NEXT step's fwd/bwd.
+        # Both off => the strictly serial stream (the parity-test
+        # reference: bit-identical state, no overlap).
+        self.pipeline_read = bool(pipeline_read)
+        self.pipeline_write = bool(pipeline_write)
+        self._nbuf = max(2, int(buffer_count)) if self.pipeline_read else 1
+        self._write_depth = (max(1, int(buffer_count) - 1)
+                             if self.pipeline_write else 0)
+        self._use_odirect = bool(aio_use_odirect)
+        self._prefetched: Optional[dict] = None
+        self._deferred_writes: list = []    # (op, arr, kb) past-apply()
+        # per-apply stage telemetry (see _apply_bucketed); engine surfaces
+        # it under wall_clock_breakdown and the bench infinity row
+        self.stage_stats: Dict[str, Any] = {}
         # (leaf key, shard index tag) pairs with moments on disk — THIS
         # process's shards only; other processes track their own
         self._initialized: set = set()
@@ -467,6 +574,10 @@ class NvmeOptimizerSwapper:
         ``leaf``; entries are None where moments are zero-init."""
         dt = self._meta[key][2]
         loc = self._item_loc.get(key)
+        if loc is not None and self._deferred_writes:
+            # a deferred write-back may still be in flight against the
+            # bucket file this read targets — settle it first
+            self._drain_deferred()
         out: Dict[tuple, Optional[tuple]] = {}
         for idx, sh in _unique_shards(leaf).items():
             tag = _idx_tag(idx)
@@ -568,7 +679,8 @@ class NvmeOptimizerSwapper:
         """Wait EVERY pending write (even after one fails — a raised
         ``wait`` means that op finished; abandoning the rest would leave
         live IO racing later writes to the same files), then re-raise the
-        first failure."""
+        first failure.  Covers both the leafwise stream's per-shard
+        writes and the pipeline's deferred bucket write-backs."""
         first_err = None
         try:
             for op in self._pending:
@@ -578,6 +690,10 @@ class NvmeOptimizerSwapper:
                     first_err = first_err or e
         finally:
             self._pending = []
+        try:
+            self._drain_deferred()
+        except Exception as e:
+            first_err = first_err or e
         if first_err is not None:
             raise first_err
 
@@ -586,6 +702,7 @@ class NvmeOptimizerSwapper:
         transient — resumable state lives in the checkpoint's
         ``nvme_optimizer/``, not here).  Idempotent; registered atexit
         (via weakref) and safe to call from engine teardown."""
+        self.cancel_prefetch()
         try:
             self.drain()
         except Exception:
@@ -626,25 +743,28 @@ class NvmeOptimizerSwapper:
             # affected buckets as item files first (the leafwise stream
             # reads/writes item files), reassembled lazily on the next
             # bucketed step
+            self.cancel_prefetch()
             self._spill_buckets_to_items(fkeys & self._plan_keys)
         return self._apply_leafwise(params, grads, lr=lr, gscale=gscale)
 
     def _spill_buckets_to_items(self, keys) -> None:
         """Write the bucket-resident moments of ``keys`` out as per-item
         files and retire those buckets (leafwise IO takes over for
-        them)."""
+        them).  Item writes go through the bulk AIO path — one pass per
+        bucket, all item files in flight together."""
+        self._drain_deferred()
         kbs = sorted({self._item_loc[k][0] for k in keys
                       if k in self._item_loc})
         for kb in kbs:
             if kb not in self._bucket_ready:
                 continue
             b = self._buckets[kb]
-            data = np.fromfile(self._bucket_fname(kb), dtype=np.float32)
-            for it in b["items"]:
-                if (it["key"], it["tag"]) not in self._initialized:
-                    continue
-                m, v = _item_mv(data, it, b["n"])
-                _write_item_file(_item_fname(self.swap_dir, it), m, v)
+            data = np.empty(2 * b["n"], np.float32)
+            self.handle.sync_pread(data, self._bucket_fname(kb))
+            _write_item_files_bulk(
+                self.handle, self.swap_dir,
+                [(it,) + _item_mv(data, it, b["n"]) for it in b["items"]
+                 if (it["key"], it["tag"]) in self._initialized])
             os.remove(self._bucket_fname(kb))
             self._bucket_ready.discard(kb)
             self._items_dirty = True
@@ -682,28 +802,165 @@ class NvmeOptimizerSwapper:
             self._bucket_fns[key] = fn
         return fn
 
+    # -- the software pipeline -------------------------------------------
+
+    def _ensure_read_bufs(self) -> None:
+        if self._read_bufs is None:
+            from deepspeed_tpu.io.aio import aligned_empty
+
+            mx = max(b["n"] for b in self._buckets)
+            # page-aligned so the O_DIRECT read path engages without a
+            # bounce copy when aio.use_odirect is set
+            self._read_bufs = [aligned_empty(2 * mx, np.float32)
+                               for _ in range(self._nbuf)]
+
+    def _issue_read(self, kb: int) -> Optional[tuple]:
+        """Start bucket ``kb``'s NVMe read into its pool slot; None when
+        the bucket has no file yet (zero-init moments)."""
+        if kb not in self._bucket_ready:
+            return None
+        b = self._buckets[kb]
+        view = self._read_bufs[kb % self._nbuf][:2 * b["n"]]
+        return (self.handle.async_pread(view, self._bucket_fname(kb), 0),
+                view)
+
+    def start_prefetch(self) -> None:
+        """Issue the first read-ahead window's bucket reads (and settle
+        any write-backs deferred from the previous step) so the stream's
+        head overlaps the fwd/bwd the engine has just dispatched — the
+        pipeline's first stage starts before the grads exist.  No-op
+        unless the bucketed pipelined stream will run; harmless when the
+        step later overflows (:meth:`cancel_prefetch`)."""
+        if (self._buckets is None or not self.pipeline_read
+                or self._prefetched is not None or self._items_dirty):
+            return
+        try:
+            self._drain_deferred()
+        except Exception:
+            # invalidation is already logged and the state reset; the
+            # apply() that follows streams zero-init moments — don't
+            # kill the in-flight fwd/bwd from a prefetch
+            return
+        self._ensure_read_bufs()
+        self._prefetched = {
+            kb: self._issue_read(kb)
+            for kb in range(min(self._nbuf, len(self._buckets)))}
+
+    def cancel_prefetch(self) -> None:
+        """Settle prefetched reads without consuming them (overflow
+        skipped the step, or the stream fell back leafwise)."""
+        pf, self._prefetched = self._prefetched, None
+        for st in (pf or {}).values():
+            if st is not None:
+                try:
+                    self.handle.wait(st[0])
+                except Exception:
+                    pass
+
+    def _submit_bucket_write(self, kb: int, arr: np.ndarray) -> int:
+        from deepspeed_tpu.io.aio import _pretruncate
+        from deepspeed_tpu.resilience import faults
+
+        fname = self._bucket_fname(kb)
+        action = faults.hook("swap.write_bucket", path=fname)
+        if action is not None and action[0] == "torn":
+            # honor the torn-write directive: a fraction of the bytes
+            # reach the disk, then the "process dies" — the stream's
+            # invalidation contract must cover it
+            with open(fname, "wb") as f:
+                f.write(arr.tobytes()[:max(1, int(arr.nbytes
+                                                  * action[1]))])
+            raise faults.SimulatedCrash(
+                f"[fault-injection] torn bucket write at {fname}")
+        _pretruncate(fname, arr.nbytes, exact=False)
+        return self.handle.async_pwrite(arr, fname, 0, _truncate=False)
+
+    def _sync_rewrite_bucket(self, kb: int, arr: np.ndarray) -> None:
+        """Blocking rewrite with jittered backoff — the retry path
+        behind a failed async bucket write.  Idempotent (full rewrite
+        from offset 0) so every retry is safe; exhausting the budget
+        re-raises and the caller invalidates."""
+        from deepspeed_tpu.resilience import faults
+        from deepspeed_tpu.resilience.retry import retriable
+
+        fname = self._bucket_fname(kb)
+
+        @retriable(retry_on=(OSError,))
+        def _write():
+            faults.hook("swap.write_bucket", path=fname)
+            self.handle.sync_pwrite(arr, fname, 0)
+
+        _write()
+
+    def _finish_write(self, op: int, arr: np.ndarray, kb: int) -> None:
+        """Join one async bucket write; a failed op retries through the
+        blocking path before giving up (arr is the submitted buffer,
+        still pinned by the write queue — no aliasing with later
+        buckets' staging)."""
+        try:
+            self.handle.wait(op)
+        except OSError:
+            self._sync_rewrite_bucket(kb, arr)
+
+    def _drain_deferred(self) -> None:
+        """Settle write-backs deferred past a previous apply() (they
+        have been draining under the fwd/bwd dispatched since).  A
+        persistent failure means that bucket's on-disk moments are STALE
+        relative to params the step already committed — invalidate
+        (moments restart zero-init) and re-raise."""
+        dw, self._deferred_writes = self._deferred_writes, []
+        err = None
+        for op, arr, kb in dw:
+            try:
+                self._finish_write(op, arr, kb)
+            except Exception as e:
+                err = err or e
+        if err is not None:
+            logger.error(
+                "NVMe swap: deferred bucket write-back failed after its "
+                "step committed — on-disk moments are stale; "
+                "invalidating swap state (moments restart zero-init; "
+                "reload the checkpoint to recover real state)")
+            self._initialized.clear()
+            self._bucket_ready.clear()
+            raise err
+
     def _apply_bucketed(self, params: Any, grads: Any, *, lr,
                         gscale) -> Any:
-        """Flat-bucket moment stream (reference
+        """Three-stage pipelined flat-bucket moment stream (reference
         ``pipelined_optimizer_swapper.py:47`` semantics): while bucket k
-        updates on device, bucket k+1's NVMe read and bucket k-1's NVMe
-        write are in flight on the AIO threads, and each bucket moves
-        host↔device as ONE array.  Failure invalidates the swap state
-        exactly like the leafwise path (moments restart zero-init)."""
+        updates on device, the reads of buckets k+1..k+B-1 are in
+        flight on the AIO threads and bucket k-1's write-back drains
+        behind a bounded budget — each bucket moves host↔device as ONE
+        array.  Per-stage blocked time is measured into ``stage_stats``
+        every call.  Failure invalidates the swap state exactly like the
+        leafwise path (moments restart zero-init)."""
+        import time as _time
+        from collections import deque
+
+        from deepspeed_tpu.checkpoint.sharded import path_str
+        from deepspeed_tpu.io.aio import aligned_empty
+
+        prefetched, self._prefetched = self._prefetched, None
+        try:
+            self._drain_deferred()
+        except Exception:
+            self._prefetched = prefetched
+            self.cancel_prefetch()
+            raise
         if self._items_dirty:
             # a leafwise fallback wrote item files for plan keys — fold
-            # them back into bucket files before streaming
+            # them back into bucket files before streaming (prefetched
+            # reads, if any, predate the fold and are discarded)
+            self._prefetched = prefetched
+            self.cancel_prefetch()
+            prefetched = None
             self._assemble_buckets_from_items()
             self._items_dirty = False
         self.count += 1
         count = np.float32(self.count)
         lr = np.float32(lr)
         gscale = np.float32(gscale)
-        from collections import deque
-
-        from deepspeed_tpu.checkpoint.sharded import path_str
-        from deepspeed_tpu.io.aio import _pretruncate
-
         flat_p = jax.tree_util.tree_flatten_with_path(params)
         flat_g = jax.tree_util.tree_flatten(grads)[0]
         keys = [path_str(kp) for kp, _ in flat_p[0]]
@@ -711,64 +968,106 @@ class NvmeOptimizerSwapper:
         idx = {k: i for i, k in enumerate(keys)}
         new_leaves = list(leaves)
         buckets = self._buckets
-        if self._read_bufs is None:
-            mx = max(b["n"] for b in buckets)
-            # 3 slots: read k+1 may be issued while compute k-2 was the
-            # last consumer of that slot — already forced by its output
-            # fetch one iteration ago
-            self._read_bufs = [np.empty(2 * mx, np.float32)
-                               for _ in range(3)]
-        pending: Dict[int, Optional[tuple]] = {}
+        nb = len(buckets)
+        self._ensure_read_bufs()
+        pipelined = self._nbuf > 1
+        t_in = t_up = t_out = 0.0
+        bytes_read = bytes_written = 0
+        t_begin = _time.perf_counter()
 
-        def issue(kb):
-            b = buckets[kb]
-            if kb not in self._bucket_ready:
-                pending[kb] = None
-                return
-            view = self._read_bufs[kb % 3][:2 * b["n"]]
-            pending[kb] = (self.handle.async_pread(
-                view, self._bucket_fname(kb), 0), view)
+        pending: Dict[int, Optional[tuple]] = dict(prefetched or {})
+        next_issue = (max(pending) + 1) if pending else 0
 
-        write_q: Any = deque()
-        prev_out = None                   # (kb, mv_out device array)
+        def issue_upto(limit: int) -> None:
+            # slot-reuse invariant: bucket j reuses slot j % nbuf, whose
+            # previous tenant was bucket j - nbuf — only re-issue once
+            # that bucket's compute has been FORCED (its output fetch in
+            # flush()), or an in-flight dispatch could still be reading
+            # the buffer the new pread scribbles into
+            nonlocal next_issue
+            while next_issue <= min(limit, nb - 1):
+                pending[next_issue] = self._issue_read(next_issue)
+                next_issue += 1
 
-        def flush(entry):
+        write_q: Any = deque()            # (op, staged array, kb)
+
+        def reap(budget: int) -> None:
+            nonlocal t_out
+            while len(write_q) > budget:
+                op, arr, kb = write_q.popleft()
+                t0 = _time.perf_counter()
+                self._finish_write(op, arr, kb)
+                t_out += _time.perf_counter() - t0
+
+        def flush(entry) -> None:
+            nonlocal t_up, t_out, bytes_written
             kb, mv_out = entry
-            while len(write_q) >= 2:      # bound in-flight write buffers
-                op, _arr = write_q.popleft()
-                self.handle.wait(op)
+            t0 = _time.perf_counter()
             mv_np = np.asarray(mv_out)    # forces bucket kb's compute
-            fname = self._bucket_fname(kb)
-            _pretruncate(fname, mv_np.nbytes, exact=False)
-            write_q.append((self.handle.async_pwrite(
-                mv_np, fname, 0, _truncate=False), mv_np))
+            t_up += _time.perf_counter() - t0
+            if self._use_odirect:
+                # jax-owned output buffers aren't page-aligned; stage
+                # through an aligned copy so the O_DIRECT write engages
+                a = aligned_empty(mv_np.size, mv_np.dtype)
+                a[:] = mv_np.ravel()
+                mv_np = a
+            try:
+                write_q.append((self._submit_bucket_write(kb, mv_np),
+                                mv_np, kb))
+            except OSError:
+                # submit-time failure (e.g. preallocation): blocking
+                # retry path, same as a failed in-flight op
+                t0 = _time.perf_counter()
+                self._sync_rewrite_bucket(kb, mv_np)
+                t_out += _time.perf_counter() - t0
+            bytes_written += mv_np.nbytes
+            reap(self._write_depth)       # bound in-flight write buffers
             self._bucket_ready.add(kb)
             for it in buckets[kb]["items"]:
                 self._initialized.add((it["key"], it["tag"]))
 
         ok = False
+        prev_out = None                   # (kb, mv_out device array)
         try:
-            issue(0)
+            issue_upto(self._nbuf - 1)    # initial window: slots all fresh
             for kb, b in enumerate(buckets):
+                if not pipelined:
+                    # serial mode (parity reference): force compute k-1
+                    # and settle its write BEFORE touching the single
+                    # read buffer again
+                    if prev_out is not None:
+                        flush(prev_out)
+                        prev_out = None
+                    issue_upto(kb)
                 st = pending.pop(kb)
+                t0 = _time.perf_counter()
                 if st is None:
                     mv_in = np.zeros((2, b["n"]), np.float32)
                 else:
                     self.handle.wait(st[0])
                     mv_in = st[1].reshape(2, b["n"])
-                if kb + 1 < len(buckets):
-                    issue(kb + 1)
+                    bytes_read += st[1].nbytes
+                t_in += _time.perf_counter() - t0
                 ps = [leaves[idx[it["key"]]] for it in b["items"]]
                 gs = [flat_g[idx[it["key"]]] for it in b["items"]]
                 p_news, mv_out = self._bucket_call(b, ps, gs)(
                     ps, gs, mv_in, count, lr, gscale)
                 for it, pn in zip(b["items"], p_news):
                     new_leaves[idx[it["key"]]] = pn
-                if prev_out is not None:
-                    flush(prev_out)
+                if pipelined and prev_out is not None:
+                    flush(prev_out)       # forces compute kb-1 ...
+                    issue_upto(kb - 1 + self._nbuf)   # ... freeing slots
                 prev_out = (kb, mv_out)
             if prev_out is not None:
                 flush(prev_out)
+            if self.pipeline_write and write_q:
+                # trailing write-backs drain under the NEXT step's
+                # fwd/bwd (settled in start_prefetch / the next apply /
+                # drain); their buffers stay pinned in the deferred list
+                self._deferred_writes.extend(write_q)
+                write_q.clear()
+            else:
+                reap(0)
             ok = True
         finally:
             for st in pending.values():
@@ -778,9 +1077,9 @@ class NvmeOptimizerSwapper:
                     except Exception:
                         pass
             err = None
-            for op, _arr in write_q:
+            for op, arr, kb in write_q:
                 try:
-                    self.handle.wait(op)
+                    self._finish_write(op, arr, kb)
                 except Exception as e:
                     err = err or e
             if not ok or err is not None:
@@ -794,6 +1093,26 @@ class NvmeOptimizerSwapper:
                 self._bucket_ready.clear()
             if ok and err is not None:
                 raise err
+        total = _time.perf_counter() - t_begin
+        self.stage_stats = {
+            "swap_in_wait_s": round(t_in, 4),
+            "bucket_update_s": round(t_up, 4),
+            "swap_out_wait_s": round(t_out, 4),
+            "apply_s": round(total, 4),
+            # fraction of the stream's wall NOT blocked on NVMe waits —
+            # ~1.0 means the disk hides behind compute/transfers (or
+            # vice versa); a low value localizes which stage starves via
+            # the stage times above
+            "overlap_efficiency": (round(1.0 - min(1.0, (t_in + t_out)
+                                                   / total), 4)
+                                   if total > 0 else None),
+            "bytes_read": int(bytes_read),
+            "bytes_written": int(bytes_written),
+            "stream_gbps": (round((bytes_read + bytes_written)
+                                  / total / 1e9, 3) if total > 0 else None),
+            "buckets": nb,
+            "pipelined": pipelined,
+        }
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(params), new_leaves)
 
@@ -898,19 +1217,22 @@ class NvmeOptimizerSwapper:
         if self._buckets is not None:
             # bucketed store → per-item checkpoint files: the checkpoint
             # format stays topology-independent (a multi-host or leafwise
-            # resume reads the same per-leaf [m; v] files)
+            # resume reads the same per-leaf [m; v] files).  One bulk
+            # AIO pass per bucket — all of its item files in flight
+            # together — instead of the old one-sync-write-per-item loop
             covered = set()
             for kb, b in enumerate(self._buckets):
                 if kb not in self._bucket_ready:
                     continue
-                data = np.fromfile(self._bucket_fname(kb),
-                                   dtype=np.float32)
+                data = np.empty(2 * b["n"], np.float32)
+                self.handle.sync_pread(data, self._bucket_fname(kb))
+                entries = []
                 for it in b["items"]:
                     if (it["key"], it["tag"]) not in self._initialized:
                         continue
                     covered.add((it["key"], it["tag"]))
-                    m, v = _item_mv(data, it, b["n"])
-                    _write_item_file(_item_fname(out, it), m, v)
+                    entries.append((it,) + _item_mv(data, it, b["n"]))
+                _write_item_files_bulk(self.handle, out, entries)
             # spilled / foreign-tag items still have their own files
             for key, tag in self._initialized - covered:
                 fname = self._shard_fname(key, tag)
@@ -988,16 +1310,13 @@ class NvmeOptimizerSwapper:
             if not present:
                 continue
             data = np.zeros(2 * b["n"], np.float32)
-            for it in present:
-                fname = self._shard_fname(it["key"], it["tag"])
-                if not os.path.exists(fname):
-                    continue
-                raw = np.fromfile(fname, dtype=np.float32)
-                m, v = _item_mv(data, it, b["n"])
-                m[:] = raw[:it["n"]]
-                v[:] = raw[it["n"]:2 * it["n"]]
-                os.remove(fname)
-            data.tofile(self._bucket_fname(kb))
+            entries = [(self._shard_fname(it["key"], it["tag"]), it)
+                       + _item_mv(data, it, b["n"]) for it in present]
+            _read_item_files_bulk(self.handle, entries)
+            for fname, *_ in entries:
+                if os.path.exists(fname):
+                    os.remove(fname)
+            self.handle.sync_pwrite(data, self._bucket_fname(kb))
             self._bucket_ready.add(kb)
         if missing:
             logger.warning(
@@ -1098,6 +1417,7 @@ class HostMomentSwapper:
             _plan_buckets(self._meta, bucket_bytes)
         self._mv: Dict[int, Any] = {}       # bid -> pinned_host [2, n]
         self._fns: Dict[tuple, Any] = {}
+        self._io_handle = None              # lazy: checkpoint bulk IO only
         log_dist(f"host-offload optimizer stream: {len(self._buckets)} "
                  f"buckets, {total / 1e9:.2f} GB of moments in pinned "
                  "host memory", ranks=[0])
@@ -1198,6 +1518,15 @@ class HostMomentSwapper:
 
     # -- checkpoint integration (NvmeOptimizerSwapper-compatible) --------
 
+    def _io(self):
+        """AIO handle for checkpoint-time bulk item IO (the per-step
+        moment traffic never touches the disk in this tier)."""
+        if self._io_handle is None:
+            from deepspeed_tpu.io.aio import aio_handle
+
+            self._io_handle = aio_handle(thread_count=4)
+        return self._io_handle
+
     def save_to(self, ckpt_dir: str) -> None:
         """Write the per-item ``[m; v]`` files + meta — the same format
         :meth:`NvmeOptimizerSwapper.save_to` produces, so resumes are
@@ -1230,10 +1559,11 @@ class HostMomentSwapper:
                     initialized.append([it["key"], it["tag"]])
                 continue
             data = np.asarray(mv).reshape(-1)
+            entries = []
             for it in b["items"]:
                 initialized.append([it["key"], it["tag"]])
-                m, v = _item_mv(data, it, b["n"])
-                _write_item_file(_item_fname(out, it), m, v)
+                entries.append((it,) + _item_mv(data, it, b["n"]))
+            _write_item_files_bulk(self._io(), out, entries)
         meta_name = f"swap_meta.p{jax.process_index()}.json"
         with open(os.path.join(out, meta_name), "w") as f:
             json.dump({"count": self.count,
@@ -1267,20 +1597,13 @@ class HostMomentSwapper:
         src, restored = self._pending_restore
         n = bucket["n"]
         data = np.zeros(2 * n, np.float32)
-        hit = False
-        for it in bucket["items"]:
-            if (it["key"], it["tag"]) not in restored:
-                continue
-            fname = _item_fname(src, it)
-            if not os.path.exists(fname):
-                continue
-            raw = np.fromfile(fname, dtype=np.float32)
-            m, v = _item_mv(data, it, n)
-            m[:] = raw[:it["n"]]
-            v[:] = raw[it["n"]:2 * it["n"]]
-            hit = True
-        if not hit:
+        entries = [(_item_fname(src, it), it) + _item_mv(data, it, n)
+                   for it in bucket["items"]
+                   if (it["key"], it["tag"]) in restored]
+        entries = [e for e in entries if os.path.exists(e[0])]
+        if not entries:
             return None
+        _read_item_files_bulk(self._io(), entries)
         return jax.device_put(data.reshape(2, n),
                               self._host_sharding(like_leaf))
 

@@ -66,6 +66,16 @@ class Timer:
         touching the accumulated window, unlike :meth:`reset`)."""
         self.started = False
 
+    def record(self, seconds: float) -> None:
+        """Fold in an externally bracketed interval — for stages whose
+        start/stop live inside another component (the swap pipeline's
+        per-stage I/O waits are summed there and recorded here), where a
+        start()/stop() pair would add a device sync per bucket."""
+        assert not self.started, f"timer {self.name} is mid-interval"
+        self.last_interval = seconds
+        self._elapsed += seconds
+        self._record_count += 1
+
     def reset(self) -> None:
         self.started = False
         self._elapsed = 0.0

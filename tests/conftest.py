@@ -16,6 +16,16 @@ import pytest  # noqa: E402
 if not os.environ.get("DSTPU_TEST_ON_TPU"):
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
+    # The suite is XLA-compile-bound (a long tail of 5-20 s tests, each
+    # building unique tiny-model programs whose execution takes
+    # milliseconds) — skip the backend optimization passes on the CPU
+    # test path: measured 46% off the heaviest file, same results.
+    # Anything timing-sensitive runs on real hardware via bench.py, not
+    # here.  An explicit user setting of the flag wins.
+    if "--xla_backend_optimization_level" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_backend_optimization_level=0")
     try:
         jax.config.update("jax_num_cpu_devices", 8)
     except AttributeError:
@@ -28,9 +38,10 @@ if not os.environ.get("DSTPU_TEST_ON_TPU"):
         # breaks every dp-vs-tp parity test
         jax.config.update("jax_threefry_partitionable", True)
     # opt-in persistent XLA compile cache (DSTPU_XLA_CACHE=<dir>): warm
-    # runs halve suite time, but old-jax cache writes are not reliably
-    # concurrent-safe with the subprocess-spawning tests — so never on
-    # by default
+    # runs halve suite time, but this jaxlib's cache path is not stable
+    # enough for the gate — a full-suite run with the cache enabled
+    # segfaulted mid-suite (2026-08, cache writes + old-jaxlib
+    # deserialization), so never on by default
     if os.environ.get("DSTPU_XLA_CACHE"):
         try:
             jax.config.update("jax_compilation_cache_dir",

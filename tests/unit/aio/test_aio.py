@@ -143,3 +143,100 @@ def test_uring_backend_selected_and_roundtrips(tmp_path):
             out2 = np.empty(1 << 16, np.uint8)
             h.sync_pread(out2, path, offset=1000)
             assert np.array_equal(out2, data[: 1 << 16])
+
+
+class TestWriteParity:
+    """The write-path machinery added for read parity: preallocation,
+    aligned buffers, and the O_DIRECT aligned-main/buffered-tail split
+    (an unaligned LENGTH must no longer demote the whole chunk)."""
+
+    def test_aligned_empty_is_page_aligned(self):
+        from deepspeed_tpu.io.aio import aligned_empty
+
+        for n, dt in ((1, np.uint8), (4097, np.uint8),
+                      (1000, np.float32)):
+            a = aligned_empty(n, dt)
+            assert a.ctypes.data % 4096 == 0
+            assert a.shape == (n,) and a.dtype == np.dtype(dt)
+            assert a.flags["C_CONTIGUOUS"]
+            a[:] = 1  # writable
+
+    def test_pretruncate_preallocates_and_shrinks(self, tmp_path):
+        from deepspeed_tpu.io.aio import _pretruncate, file_size
+
+        p = str(tmp_path / "pre.bin")
+        _pretruncate(p, 1 << 20, exact=False)
+        assert file_size(p) == 1 << 20
+        _pretruncate(p, 1 << 10, exact=False)   # extend-only: no shrink
+        assert file_size(p) == 1 << 20
+        _pretruncate(p, 1 << 10, exact=True)
+        assert file_size(p) == 1 << 10
+
+    def test_odirect_unaligned_length_roundtrips(self, tmp_path):
+        """Aligned pointer + offset with a ragged length: the aligned
+        main body takes the direct path, the tail goes buffered, and
+        the bytes come back exact."""
+        from deepspeed_tpu.io.aio import aio_handle, aligned_empty
+
+        h = aio_handle(block_size=1 << 16, thread_count=2,
+                       use_odirect=True)
+        for n in (4096 + 1, (1 << 20) + 123, 5000):
+            data = _rand(n, n % 251)
+            buf = aligned_empty(n)
+            buf[:] = data
+            path = str(tmp_path / f"od{n}.bin")
+            h.sync_pwrite(buf, path)
+            out = aligned_empty(n)
+            h.sync_pread(out, path)
+            assert out.tobytes() == data.tobytes(), n
+
+    def test_odirect_async_many_files(self, tmp_path):
+        """Bulk async O_DIRECT writes (the swap save_to regime) land
+        every byte in the right file."""
+        from deepspeed_tpu.io.aio import aio_handle, aligned_empty
+
+        h = aio_handle(block_size=1 << 16, thread_count=4,
+                       use_odirect=True)
+        datas, bufs, ops = [], [], []
+        for i in range(8):
+            d = _rand((1 << 18) + 7 * i, 50 + i)
+            b = aligned_empty(d.size)
+            b[:] = d
+            datas.append(d)
+            bufs.append(b)
+            ops.append(h.async_pwrite(b, str(tmp_path / f"od{i}.bin")))
+        for op in ops:
+            assert h.wait(op) == 0
+        for i, d in enumerate(datas):
+            out = np.empty_like(d)
+            h.sync_pread(out, str(tmp_path / f"od{i}.bin"))
+            np.testing.assert_array_equal(out, d)
+
+
+def test_sweep_json_lines_and_best_write(tmp_path, capsys):
+    """--sweep mode: one JSON line per grid point plus the best-WRITE
+    config (the knob set the swap stream inherits)."""
+    import json as _json
+
+    from deepspeed_tpu.io.bench import best_write_config, main, sweep
+
+    results = sweep(str(tmp_path), 1 << 20, block_sizes=[1 << 18],
+                    thread_counts=[1], queue_depths=[16, 32],
+                    odirect=[False], loops=1, json_lines=True)
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    assert len(lines) == 2
+    recs = [_json.loads(ln) for ln in lines]
+    assert {r["queue_depth"] for r in recs} == {16, 32}
+    best = best_write_config(results)
+    assert best["write_gbps"] == max(r["write_gbps"] for r in results)
+    assert set(best["config"]["aio"]) == {"block_size", "thread_count",
+                                          "queue_depth", "use_odirect"}
+
+    main(["--dir", str(tmp_path), "--size-mb", "1", "--loops", "1",
+          "--block-sizes", str(1 << 18), "--threads", "1",
+          "--queue-depths", "16", "--odirect", "0", "--sweep"])
+    out_lines = [ln for ln in capsys.readouterr().out.splitlines()
+                 if ln.startswith("{")]
+    assert "best_write" in out_lines[-1]
+    _json.loads(out_lines[-1])
