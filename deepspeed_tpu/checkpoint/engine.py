@@ -73,6 +73,15 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     if jax.process_index() == 0 and os.path.isdir(stage):
         # leftover staging from a crashed save of the same tag
         shutil.rmtree(stage, ignore_errors=True)
+    if jax.process_count() > 1:
+        # no peer may start writing into the staging dir until the
+        # leftover cleanup above has run — without this barrier a fast
+        # peer's early staging files (the NVMe swapper meta copies) get
+        # swept by process 0's rmtree and silently miss the committed
+        # tag.  Runs under the collective watchdog when one is armed.
+        from deepspeed_tpu.comm import barrier
+
+        barrier()
     os.makedirs(stage, exist_ok=True)
     # async: copy shards to host up front (training mutates/donates the
     # state buffers); sync: stream shard-by-shard, bounded host memory

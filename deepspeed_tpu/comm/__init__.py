@@ -20,9 +20,11 @@ from deepspeed_tpu.comm.comm import (
     broadcast,
     axis_index,
     log_summary,
+    straggler_report,
     configure,
     comms_logger,
 )
+from deepspeed_tpu.comm import watchdog
 from deepspeed_tpu.comm.comms_logging import CommsLogger, get_bw
 from deepspeed_tpu.comm.quantized import (quantized_all_gather,
                                           quantized_reduce_scatter)
@@ -32,7 +34,8 @@ __all__ = [
     "get_topology", "peek_topology", "get_mesh", "get_world_size", "get_rank", "get_local_rank",
     "get_process_count", "barrier", "all_reduce", "inference_all_reduce",
     "all_gather", "reduce_scatter", "all_to_all", "ppermute", "broadcast",
-    "axis_index", "log_summary", "configure", "comms_logger", "CommsLogger",
+    "axis_index", "log_summary", "straggler_report", "configure",
+    "comms_logger", "CommsLogger", "watchdog",
     "quantized_all_gather", "quantized_reduce_scatter",
     "get_bw",
 ]

@@ -34,8 +34,10 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deepspeed_tpu.utils.compat import shard_map
 
+from deepspeed_tpu.comm import watchdog as _watchdog
 from deepspeed_tpu.comm.comms_logging import CommsLogger
 from deepspeed_tpu.parallel.topology import MeshTopology, AXIS_ORDER
+from deepspeed_tpu.resilience import faults
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 GroupLike = Union[None, str, Tuple[str, ...], Sequence[str]]
@@ -180,12 +182,31 @@ def get_process_count() -> int:
 def barrier(group: GroupLike = None) -> None:
     """Barrier: flush local device work; on multi-host runs additionally
     synchronize every process (a psum over all global devices, the JAX
-    analogue of ``torch.distributed.barrier``)."""
+    analogue of ``torch.distributed.barrier``).
+
+    Fault site ``comm.barrier`` (straggle delays this rank; drop skips
+    the cross-process sync so peers stall); the cross-process sync runs
+    under the collective watchdog when one is armed."""
+    directive = faults.hook("comm.barrier")
+    if directive is not None:
+        dkind, dparam = directive
+        if dkind == "straggle":
+            time.sleep(dparam)
+        elif dkind == "drop":
+            logger.error("[fault-injection] comm.barrier: dropped on rank "
+                         f"{jax.process_index()} — peers will stall")
+            return
     jax.effects_barrier()
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices("deepspeed_tpu.comm.barrier")
+        t0 = time.perf_counter()
+        _watchdog.guard(
+            lambda: multihost_utils.sync_global_devices(
+                "deepspeed_tpu.comm.barrier"),
+            what="comm.barrier")
+        comms_logger.append("barrier", 0, jax.process_count(),
+                            time.perf_counter() - t0)
 
 
 # ---------------------------------------------------------------------------
@@ -353,8 +374,46 @@ def _axes_size(axes: Tuple[str, ...]) -> int:
 _EAGER_CACHE: dict = {}
 
 
+def _corrupt_local_view(out, fraction: float):
+    """Honor a ``("corrupt", fraction)`` directive: scale the first
+    ``fraction`` of THIS process's addressable shards of the collective
+    result — a lossy link delivering corrupted data to one receiver.
+    The global array is rebuilt from local shards only (no cross-process
+    traffic), so peers keep their clean copies: replication is broken
+    exactly the way the desync detector must catch."""
+    arrays = []
+    for sh in out.addressable_shards:
+        data = np.array(sh.data)                 # local host copy
+        flat = data.reshape(-1)
+        k = max(1, int(flat.size * fraction))
+        flat[:k] = flat[:k] * 1024.0 + 1.0       # deterministic scale+shift
+        arrays.append(jax.device_put(data, sh.device))
+    return jax.make_array_from_single_device_arrays(out.shape, out.sharding,
+                                                    arrays)
+
+
 def _eager_collective(kind: str, x, axes: Tuple[str, ...], **kw):
     log_name = kw.pop("log_name", kind)
+    # fault site (comm.all_reduce / comm.all_gather / comm.broadcast /
+    # ...): one hook firing per EAGER call — in-graph collectives lower
+    # to XLA and cannot be intercepted.  No injector active -> one
+    # module-global None check, nothing else.
+    directive = faults.hook(f"comm.{kind}")
+    if directive is not None:
+        dkind, dparam = directive
+        if dkind == "straggle":
+            # models a rank arriving late from slow compute: the sleep
+            # happens OUTSIDE the timed bracket, so the straggler records
+            # a short wait while every peer's timing absorbs the delay —
+            # the inversion build_straggler_report keys on (argmin)
+            logger.warning(f"[fault-injection] comm.{kind}: straggling "
+                           f"{dparam:.3f}s on rank {jax.process_index()}")
+            time.sleep(dparam)
+        elif dkind == "drop":
+            logger.error(f"[fault-injection] comm.{kind}: dropped on rank "
+                         f"{jax.process_index()} — peers will stall in "
+                         "the collective")
+            return jnp.asarray(x)
     topo = get_topology()
     mesh = topo.mesh
     n = _axes_size(axes)
@@ -427,23 +486,64 @@ def _eager_collective(kind: str, x, axes: Tuple[str, ...], **kw):
         else:
             fn = cached
             warm_up = False
-        x_sharded = jax.device_put(x, NamedSharding(mesh, in_spec))
+        # the timed bracket INCLUDES the sharded device_put: on a
+        # multi-process mesh it synchronizes with the peers' previous
+        # collective retiring, so a straggling rank's delay surfaces
+        # here on every peer (measured: the execute+block segment alone
+        # reads ~ms even when the put stalled 400ms on a slow peer).
+        # Guarded: a dropped/wedged peer hangs this path, not just the
+        # execution wait.
+        t0 = time.perf_counter()
+        x_sharded = _watchdog.guard(
+            lambda: jax.device_put(x, NamedSharding(mesh, in_spec)),
+            what=f"comm.{kind} (device_put)")
         if warm_up:
             # first call pays trace+compile; exclude it from timing
-            jax.block_until_ready(fn(x_sharded))
-        t0 = time.perf_counter()
-        out = fn(x_sharded)
-        out = jax.block_until_ready(out)
+            _watchdog.guard(lambda: jax.block_until_ready(fn(x_sharded)),
+                            what=f"comm.{kind} (warm-up)")
+            t0 = time.perf_counter()
+        out = _watchdog.guard(lambda: jax.block_until_ready(fn(x_sharded)),
+                              what=f"comm.{kind}")
         dt = time.perf_counter() - t0
     comms_logger.append(kind if kind != "all_gather" else "all_gather_into_tensor",
                         _nbytes(x) // max(n, 1) if kind == "all_reduce" else _nbytes(x),
                         n, dt, log_name)
+    if directive is not None and directive[0] == "corrupt":
+        logger.error(f"[fault-injection] comm.{kind}: corrupting "
+                     f"{directive[1]:.2f} of the local result view on rank "
+                     f"{jax.process_index()}")
+        out = _corrupt_local_view(out, directive[1])
     return out
 
 
+def straggler_report(min_spread_s: float = 0.020,
+                     min_ratio: float = 2.0) -> dict:
+    """Cross-rank per-op straggler aggregation: gather every process's
+    mean eager-collective latencies and name the rank peers wait for
+    (``resilience/distributed.py build_straggler_report``).  Costs one
+    small allgather; single-process returns per-op stats with no
+    straggler named (nothing to compare)."""
+    from deepspeed_tpu.resilience.distributed import (allgather_json,
+                                                      build_straggler_report)
+
+    local = comms_logger.per_op_mean_latency()
+    per_rank = allgather_json(local)
+    return build_straggler_report(per_rank, min_spread_s=min_spread_s,
+                                  min_ratio=min_ratio)
+
+
 def log_summary(show_straggler: bool = False) -> str:
-    """Print the comms table (reference ``comm.py:428``)."""
-    return comms_logger.log_summary(show_straggler=show_straggler)
+    """Print the comms table (reference ``comm.py:428``).
+
+    ``show_straggler`` additionally prints per-call straggler effect
+    (max-vs-avg latency) and, on multi-process runs, the CROSS-RANK
+    straggler report naming the rank every collective waits for."""
+    out = comms_logger.log_summary(show_straggler=show_straggler)
+    if show_straggler and jax.process_count() > 1:
+        section = comms_logger.render_straggler_report(straggler_report())
+        logger.info("\n" + section)
+        out = out + "\n" + section
+    return out
 
 
 def configure(comms_config=None) -> None:

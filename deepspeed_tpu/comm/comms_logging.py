@@ -104,6 +104,43 @@ class CommsLogger:
                 logger.info(f"comm op: {key} (traced) | msg size: {convert_size(size)} "
                             f"| world: {world}")
 
+    def per_op_mean_latency(self) -> Dict[str, Dict[str, float]]:
+        """``{op: {"mean_s", "count"}}`` over every measured (eager)
+        call, all message sizes pooled — the local half of the
+        cross-rank straggler aggregation
+        (``resilience/distributed.py build_straggler_report``)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for op_name, sizes in self.comms_dict.items():
+            total, n = 0.0, 0
+            for _size, (_count, total_lat, lats, _world) in sizes.items():
+                total += total_lat
+                n += len(lats)
+            if n:
+                out[op_name] = {"mean_s": total / n, "count": n}
+        return out
+
+    @staticmethod
+    def render_straggler_report(report: Dict[str, Dict]) -> str:
+        """Human-readable lines for a cross-rank straggler report
+        (``build_straggler_report`` output): one line per op, naming
+        the straggler rank when one cleared the thresholds."""
+        lines = ["cross-rank straggler report:"]
+        if not report:
+            lines.append("  (no cross-rank timing data)")
+        for op, rec in sorted(report.items()):
+            per_rank = ", ".join(f"r{i}={m:.2f}" for i, m in
+                                 enumerate(rec["per_rank_ms"]))
+            if rec["straggler_rank"] is not None:
+                lines.append(
+                    f"  {op}: STRAGGLER rank {rec['straggler_rank']} — "
+                    f"peers wait {rec['spread_ms']:.2f} ms for it "
+                    f"(per-rank mean ms: {per_rank})")
+            else:
+                lines.append(f"  {op}: no straggler (spread "
+                             f"{rec['spread_ms']:.2f} ms; per-rank mean "
+                             f"ms: {per_rank})")
+        return "\n".join(lines)
+
     def log_summary(self, show_straggler: bool = False) -> str:
         lines = []
         header = (f"{'Comm. Op':<25}{'Message Size':<18}{'Count':<8}"

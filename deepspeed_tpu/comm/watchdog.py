@@ -1,0 +1,100 @@
+"""Collective watchdog: eager collectives under a deadline.
+
+A dropped or wedged collective (peer died mid-collective, peer's rank
+skipped the call, transport hang) blocks every surviving rank
+indefinitely — by default the only way out is an outer harness killing
+the job at ITS timeout.  The watchdog bounds that: when armed with a
+deadline, each eager collective's blocking wait runs on a dedicated
+heartbeat thread while the caller waits at most ``deadline_s``; on
+expiry the caller gets :class:`CollectiveTimeout`
+(``resilience/distributed.py``) and can abort cleanly (the engine
+routes it through the preemption path; the elastic agent counts it as
+a restartable hard failure).
+
+Disabled (the default, ``deadline_s == 0``) the guard is a direct call
+— no thread, no handoff, zero overhead on the fault-free path.  The
+wedged heartbeat thread is abandoned on timeout (daemon — a blocked
+gloo/ICI wait cannot be interrupted from Python) and a fresh one is
+spawned for the next collective.
+
+Armed via ``resilience.comm.collective_timeout_s`` in the DeepSpeed
+config (the engine calls :func:`configure`) or the
+``DSTPU_COLLECTIVE_TIMEOUT_S`` environment variable (workers without
+an engine).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import os
+from typing import Any, Callable, Optional
+
+from deepspeed_tpu.resilience.distributed import CollectiveTimeout
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["CollectiveWatchdog", "CollectiveTimeout", "configure",
+           "get_watchdog", "guard"]
+
+
+class CollectiveWatchdog:
+    """Deadline enforcement for blocking collective waits.
+
+    ``timeouts`` counts expiries (telemetry + test assertions).  One
+    watchdog per process is the normal shape (module singleton below);
+    standalone instances are fine for tests."""
+
+    def __init__(self, deadline_s: float = 0.0):
+        self.deadline_s = float(deadline_s)
+        self.timeouts = 0
+        self._pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.deadline_s > 0
+
+    def guard(self, fn: Callable[[], Any], what: str = "collective") -> Any:
+        """Run ``fn`` (a blocking collective wait) under the deadline.
+
+        Disabled: calls ``fn`` inline.  Enabled: runs it on the
+        heartbeat thread; expiry abandons that thread and raises
+        :class:`CollectiveTimeout`."""
+        if not self.enabled:
+            return fn()
+        pool = self._pool
+        if pool is None:
+            pool = self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="dstpu-collective-wd")
+        fut = pool.submit(fn)
+        try:
+            return fut.result(timeout=self.deadline_s)
+        except concurrent.futures.TimeoutError:
+            self.timeouts += 1
+            # the heartbeat thread is wedged inside the collective and
+            # may never return — abandon the pool (daemon threads) and
+            # let the next guarded call build a fresh one
+            self._pool = None
+            pool.shutdown(wait=False)
+            logger.error(f"collective watchdog: {what} exceeded "
+                         f"{self.deadline_s:.1f}s deadline — failing fast")
+            raise CollectiveTimeout(
+                f"{what} exceeded the {self.deadline_s:.1f}s collective "
+                "deadline (a peer rank dropped the collective, died "
+                "mid-collective, or the transport wedged); "
+                "resilience.comm.collective_timeout_s bounds this wait"
+            ) from None
+
+
+_WATCHDOG = CollectiveWatchdog(
+    float(os.environ.get("DSTPU_COLLECTIVE_TIMEOUT_S", "0") or 0))
+
+
+def configure(deadline_s: float) -> None:
+    """Set the process-wide collective deadline (0 disables)."""
+    _WATCHDOG.deadline_s = float(deadline_s)
+
+
+def get_watchdog() -> CollectiveWatchdog:
+    return _WATCHDOG
+
+
+def guard(fn: Callable[[], Any], what: str = "collective") -> Any:
+    return _WATCHDOG.guard(fn, what)

@@ -306,6 +306,41 @@ class CompressionConfig(ConfigModel):
     layer_reduction: Dict[str, Any] = Field(default_factory=dict)
 
 
+class CommResilienceConfig(ConfigModel):
+    """``resilience.comm`` subtree (deepspeed_tpu/resilience/distributed.py
+    + comm/watchdog.py): distributed-health knobs — all off by default,
+    and the instrumented comm paths are exact no-ops when off."""
+
+    # eager collectives fail fast with CollectiveTimeout after this many
+    # seconds instead of hanging on a dropped/wedged peer (0 = no
+    # watchdog).  The engine routes the timeout through the preemption
+    # path: emergency checkpoint attempt, then a clean nonzero abort.
+    collective_timeout_s: float = 0.0
+    # every N steps, cross-check replica-identical scalars (loss, grad
+    # norm) across processes; divergence raises GradientAnomalyError
+    # (0 = off; enabling costs one small allgather per check)
+    desync_interval: int = 0
+    # absolute tolerance for the desync comparison (fetched replicas of
+    # the same global scalar should be bit-identical; leave 0 unless a
+    # transport legitimately perturbs them)
+    desync_tolerance: float = 0.0
+    # at steps_per_print, aggregate cross-rank collective timings and
+    # write the straggler report to the monitor (costs one small
+    # allgather per report)
+    straggler_report: bool = False
+
+    @model_validator(mode="after")
+    def _validate(self):
+        if self.collective_timeout_s < 0:
+            raise ValueError(
+                "resilience.comm.collective_timeout_s must be >= 0")
+        if self.desync_interval < 0:
+            raise ValueError("resilience.comm.desync_interval must be >= 0")
+        if self.desync_tolerance < 0:
+            raise ValueError("resilience.comm.desync_tolerance must be >= 0")
+        return self
+
+
 class ResilienceConfig(ConfigModel):
     """``resilience`` subtree (deepspeed_tpu/resilience/): fault-tolerance
     knobs for checkpoint hardening, restart supervision, and training
@@ -325,6 +360,9 @@ class ResilienceConfig(ConfigModel):
     # quarantine to <tag>.corrupt and load falls back to the newest
     # verified tag
     verify_on_load: bool = True
+    # distributed-health knobs (collective watchdog, desync detection,
+    # straggler telemetry)
+    comm: CommResilienceConfig = Field(default_factory=CommResilienceConfig)
 
     @model_validator(mode="after")
     def _validate(self):

@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
 
+from deepspeed_tpu.resilience.distributed import CollectiveTimeout
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -188,7 +189,8 @@ class DSElasticAgent:
                 raise RuntimeError("elastic agent: no healthy devices")
             try:
                 engine, cfg = self._make_engine(devices)
-            except (PreemptionError, jax.errors.JaxRuntimeError) as e:
+            except (PreemptionError, jax.errors.JaxRuntimeError,
+                    CollectiveTimeout) as e:
                 # losing the slice DURING rebuild/resume is the likeliest
                 # failure on a degraded pod — it must consume a restart,
                 # not crash the supervisor
@@ -227,14 +229,17 @@ class DSElasticAgent:
                 logger.warning(
                     f"elastic agent: restart {self.restarts}/"
                     f"{self.max_restarts} ({e})")
-            except jax.errors.JaxRuntimeError as e:
-                # hard device failure: resume from the last periodic save
+            except (jax.errors.JaxRuntimeError, CollectiveTimeout) as e:
+                # hard failure: a dead chip's runtime error, or a
+                # collective watchdog timeout (peer rank gone / wedged
+                # transport — the engine already attempted an emergency
+                # checkpoint).  Resume from the last periodic save
                 # (load_checkpoint verifies and falls back to the newest
                 # VERIFIED tag if the last save was torn)
                 last_err = e
                 self.restarts += 1
                 logger.warning(
-                    f"elastic agent: device failure, restart "
+                    f"elastic agent: hard failure, restart "
                     f"{self.restarts}/{self.max_restarts} ({e})")
                 if self.restarts <= self.max_restarts:
                     self._backoff()

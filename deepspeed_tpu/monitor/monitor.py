@@ -158,3 +158,19 @@ class MonitorMaster(Monitor):
         for m in (self.tb, self.csv, self.wandb, self.comet):
             if m.enabled:
                 m.write_events(events)
+
+    def write_comm_health(self, straggler_report: dict, step: int) -> None:
+        """Surface the cross-rank straggler report
+        (``comm.straggler_report()``) as metric events: per-op latency
+        spread plus the named straggler rank (-1 when no rank cleared
+        the naming thresholds).  A real slow rank shows up as a
+        persistent nonnegative ``straggler_rank`` series."""
+        events: List[Event] = []
+        for op, rec in sorted(straggler_report.items()):
+            rank = rec.get("straggler_rank")
+            events.append((f"Comm/{op}/straggler_rank",
+                           float(-1 if rank is None else rank), step))
+            events.append((f"Comm/{op}/straggler_spread_ms",
+                           float(rec.get("spread_ms", 0.0)), step))
+        if events:
+            self.write_events(events)

@@ -1,13 +1,23 @@
 """Fault-tolerance layer: hardened checkpoint I/O helpers, retry/backoff,
-training guards, and the deterministic fault-injection harness.
+training guards, the deterministic fault-injection harness, and the
+distributed-health layer (collective watchdog exception, desync
+detection, straggler aggregation).
 
 Wired through ``checkpoint/`` (staged atomic commits, crc32-verified
-manifests, quarantine + fallback on load), ``runtime/engine.py``
-(preemption hook, gradient-anomaly guard), and
-``launcher/elastic_agent.py`` (restart budget with exponential
-backoff).  Config knobs live in the ``resilience`` block of the
-DeepSpeed config (``config/config.py ResilienceConfig``).
+manifests, quarantine + fallback on load), ``comm/`` (eager-collective
+fault sites + the collective watchdog, ``comm/watchdog.py``),
+``runtime/engine.py`` (preemption hook, gradient-anomaly guard, desync
+check, collective-timeout routing), and ``launcher/elastic_agent.py``
+(restart budget with exponential backoff; collective timeouts consume
+restarts).  Config knobs live in the ``resilience`` block of the
+DeepSpeed config (``config/config.py ResilienceConfig`` and its
+``resilience.comm`` subtree).
 """
+from deepspeed_tpu.resilience.distributed import (CollectiveTimeout,
+                                                  DesyncDetector,
+                                                  build_straggler_report,
+                                                  install_injector_from_env,
+                                                  tree_checksum)
 from deepspeed_tpu.resilience.faults import (FaultInjector, SimulatedCrash,
                                              torn_write_file)
 from deepspeed_tpu.resilience.guards import (GradientAnomalyError,
@@ -17,4 +27,6 @@ from deepspeed_tpu.resilience.retry import (backoff_delays,
 
 __all__ = ["FaultInjector", "SimulatedCrash", "torn_write_file",
            "GradientAnomalyError", "SkippedStepGuard",
-           "backoff_delays", "call_with_retries", "retriable"]
+           "backoff_delays", "call_with_retries", "retriable",
+           "CollectiveTimeout", "DesyncDetector", "build_straggler_report",
+           "install_injector_from_env", "tree_checksum"]

@@ -1,0 +1,240 @@
+"""Distributed-health layer: cross-process failure detection.
+
+The comm-level half of the resilience subsystem (the reference
+DeepSpeed treats communication as a first-class failure domain — its
+compressed collectives tolerate lossy links and its elastic agent
+assumes ranks die mid-collective).  Four pieces:
+
+- :class:`CollectiveTimeout` — raised by the collective watchdog
+  (``comm/watchdog.py``) when an eager collective exceeds its deadline
+  instead of hanging until an outer harness timeout.  The engine routes
+  it through the preemption path (emergency checkpoint attempt, then a
+  clean nonzero abort) and the elastic agent treats it as a hard
+  failure that consumes a restart.
+- :class:`DesyncDetector` — periodic cross-rank comparison of values
+  that MUST be replica-identical under SPMD (loss, grad norm, local
+  views of collective results).  A corrupted collective that broke the
+  replication invariant becomes a loud
+  :class:`~deepspeed_tpu.resilience.guards.GradientAnomalyError`
+  instead of silent divergence.
+- :func:`build_straggler_report` — names the straggler rank from
+  cross-rank per-op collective timings (the rank everyone waits for
+  arrives last and therefore WAITS LEAST; argmin of mean latency).
+  ``comm.log_summary(show_straggler=True)`` aggregates and renders it.
+- :func:`install_injector_from_env` — plumbs a
+  :class:`~deepspeed_tpu.resilience.faults.FaultInjector` spec through
+  environment variables into subprocess workers (the multiproc chaos
+  tests and real chaos drills inject per-rank comm faults this way).
+
+This module must not import ``deepspeed_tpu.comm`` at module scope —
+the comm facade's watchdog imports :class:`CollectiveTimeout` from
+here.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.resilience.faults import FaultInjector
+from deepspeed_tpu.resilience.guards import GradientAnomalyError
+from deepspeed_tpu.utils.logging import logger
+
+__all__ = ["CollectiveTimeout", "DesyncDetector", "build_straggler_report",
+           "install_injector_from_env", "tree_checksum", "allgather_json"]
+
+
+class CollectiveTimeout(RuntimeError):
+    """An eager collective (or cross-process barrier) exceeded the
+    watchdog deadline — a peer dropped the collective, died
+    mid-collective, or the transport wedged.  Fail fast: the process
+    must abort (after an emergency-checkpoint attempt) rather than
+    hang until an outer harness kills it."""
+
+
+# ---------------------------------------------------------------------------
+# Cross-process exchange primitive
+# ---------------------------------------------------------------------------
+
+_JSON_PAD = 8192
+
+
+def allgather_json(obj: Any, pad: int = _JSON_PAD) -> List[Any]:
+    """Gather one small JSON-serializable object per process.
+
+    Content length may differ per rank (``process_allgather`` needs
+    identical shapes), so payloads are padded to ``pad`` bytes.
+    Single-process: returns ``[obj]`` without touching the transport.
+    """
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    raw = json.dumps(obj).encode()
+    assert len(raw) <= pad, f"allgather_json payload {len(raw)}B > {pad}B"
+    buf = np.zeros(pad, np.uint8)
+    buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    out = []
+    for row in gathered.reshape(jax.process_count(), pad):
+        data = row.tobytes().rstrip(b"\x00")
+        out.append(json.loads(data.decode()))
+    return out
+
+
+def tree_checksum(tree: Any) -> float:
+    """Cheap checksum of THIS process's local view of a pytree: the sum
+    over every leaf's addressable shards.  Two processes holding what
+    should be identical replicas get identical checksums; a corrupted
+    collective that delivered different data to one rank's shards
+    shows up as a mismatch."""
+    total = 0.0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            for sh in leaf.addressable_shards:
+                total += float(np.sum(np.asarray(sh.data, np.float64)))
+        else:
+            total += float(np.sum(np.asarray(leaf, np.float64)))
+    return total
+
+
+# ---------------------------------------------------------------------------
+# Desync detection
+# ---------------------------------------------------------------------------
+
+
+class DesyncDetector:
+    """Periodic cross-rank comparison of replica-identical scalars.
+
+    Under single-controller SPMD every *global* computation is
+    consistent by construction; what CAN silently diverge is per-rank
+    local state — the local replica of a collective result a lossy
+    link corrupted, host-side optimizer streams, fetched metrics.
+    ``check`` exchanges named local scalars across processes and raises
+    :class:`GradientAnomalyError` when any of them disagree beyond
+    ``tolerance`` — turning a corrupted collective into a loud abort
+    (the engine's ``SkippedStepGuard`` story extended across ranks).
+
+    Off by default; the engine builds one when
+    ``resilience.comm.desync_interval > 0`` and feeds it the loss /
+    grad-norm scalars it already fetches.  Single-process ``check`` is
+    a no-op that still counts (the code path stays exercised).
+    """
+
+    def __init__(self, interval: int, tolerance: float = 0.0):
+        assert interval > 0, "use interval > 0 (0 means: no detector)"
+        self.interval = int(interval)
+        self.tolerance = float(tolerance)
+        self.checks = 0
+        self.mismatches = 0
+
+    def should_check(self, step: int) -> bool:
+        return step > 0 and step % self.interval == 0
+
+    def check(self, values: Dict[str, float], step: int) -> bool:
+        """Cross-check ``{name: local_scalar}``; raises on divergence."""
+        self.checks += 1
+        rank = jax.process_index()
+        per_rank = allgather_json({"rank": rank, "values": values})
+        bad = []
+        for name in values:
+            vals = [float(r["values"][name]) for r in per_rank]
+            good = [v for v in vals if np.isfinite(v)]
+            spread = (max(good) - min(good)) if good else float("inf")
+            if len(good) < len(vals) or spread > self.tolerance:
+                bad.append((name, vals))
+        if not bad:
+            return True
+        self.mismatches += 1
+        detail = "; ".join(
+            f"{name}: " + ", ".join(f"rank{i}={v:.6g}"
+                                    for i, v in enumerate(vals))
+            for name, vals in bad)
+        raise GradientAnomalyError(
+            f"cross-rank desync at step {step}: {detail} — ranks hold "
+            "different values for replica-identical state (a corrupted "
+            "collective or diverged host-side stream). Abort and resume "
+            "from the last verified checkpoint "
+            "(resilience.comm.desync_interval controls this check).")
+
+
+# ---------------------------------------------------------------------------
+# Straggler telemetry
+# ---------------------------------------------------------------------------
+
+
+def build_straggler_report(per_rank: List[Dict[str, Any]],
+                           min_spread_s: float = 0.020,
+                           min_ratio: float = 2.0) -> Dict[str, Dict]:
+    """Name the straggler per op from cross-rank mean latencies.
+
+    ``per_rank[r]`` maps ``op -> {"mean_s": float, "count": int}`` for
+    rank ``r``.  The straggler is the rank with the SMALLEST mean wait:
+    it arrives last, so every peer's timing includes waiting for it
+    while its own collective completes immediately.  An op is only
+    flagged when the max/min spread clears both an absolute floor
+    (``min_spread_s``) and a ratio (``min_ratio``) — uniform jitter
+    must not produce accusations."""
+    ops = sorted({op for r in per_rank for op in r})
+    report: Dict[str, Dict] = {}
+    for op in ops:
+        means = [float(r[op]["mean_s"]) if op in r else float("nan")
+                 for r in per_rank]
+        known = [(i, m) for i, m in enumerate(means) if np.isfinite(m)]
+        if len(known) < 2:
+            continue
+        lo_rank, lo = min(known, key=lambda t: t[1])
+        hi_rank, hi = max(known, key=lambda t: t[1])
+        spread = hi - lo
+        flagged = (spread >= min_spread_s
+                   and hi >= min_ratio * max(lo, 1e-9))
+        report[op] = {
+            "straggler_rank": lo_rank if flagged else None,
+            "spread_ms": round(spread * 1e3, 3),
+            "min_ms": round(lo * 1e3, 3),
+            "max_ms": round(hi * 1e3, 3),
+            "slowest_peer_rank": hi_rank,
+            "per_rank_ms": [round(m * 1e3, 3) for m in means],
+        }
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Worker-side fault plumbing
+# ---------------------------------------------------------------------------
+
+
+def install_injector_from_env(env: Optional[Dict[str, str]] = None
+                              ) -> Optional[FaultInjector]:
+    """Arm a :class:`FaultInjector` in THIS process from the
+    environment — the path test harnesses and chaos drills use to
+    inject per-rank comm faults into subprocess workers.
+
+    ``DSTPU_FAULT_SPEC``
+        the :meth:`FaultInjector.from_spec` wire format; absent = no-op.
+    ``DSTPU_FAULT_RANK``
+        only arm when ``jax.process_index()`` matches (per-rank faults:
+        "corrupt the payload on ONE rank"); absent = every rank.
+    ``DSTPU_FAULT_SEED``
+        injector seed (default 0).
+
+    The injector is ENTERED (installed as the process-global active
+    injector); callers that need to disarm mid-process hold the return
+    value and call ``__exit__``.  Call after ``jax.distributed``
+    initialization so the rank gate sees the real process index."""
+    env = os.environ if env is None else env
+    spec = env.get("DSTPU_FAULT_SPEC")
+    if not spec:
+        return None
+    rank_gate = env.get("DSTPU_FAULT_RANK")
+    if rank_gate is not None and jax.process_index() != int(rank_gate):
+        return None
+    inj = FaultInjector.from_spec(spec, seed=int(env.get("DSTPU_FAULT_SEED",
+                                                         "0")))
+    inj.__enter__()
+    logger.warning(f"fault injector armed from DSTPU_FAULT_SPEC on rank "
+                   f"{jax.process_index()}: {spec!r}")
+    return inj

@@ -20,13 +20,37 @@ Instrumented sites (the stable surface; grep for ``faults.hook``):
 ``swap.write_item``       before each NVMe moment-file write
 ``swap.write_bucket``     before each pipelined bucket write-back submit
                           (async submit AND its blocking retry path)
+``comm.all_reduce``       once per EAGER all_reduce call (comm/comm.py)
+``comm.all_gather``       once per eager all_gather call
+``comm.broadcast``        once per eager broadcast call
+``comm.barrier``          once per ``comm.barrier()`` call
+``comm.reduce_scatter``   once per eager reduce_scatter call
+``comm.all_to_all``       once per eager all_to_all call
+``comm.ppermute``         once per eager ppermute call
 ========================  ==================================================
+
+Fault kinds:
+
+``oserror``   raise a transient ``OSError`` (retry/backoff target)
+``torn``      write only ``param`` fraction of the bytes, then die
+``crash``     raise :class:`SimulatedCrash` (process death mid-op)
+``sigterm``   deliver a real SIGTERM (preemption-handler target)
+``corrupt``   comm sites: scale ``param`` fraction of this rank's LOCAL
+              view of the collective result (a lossy link delivering
+              corrupted data to one receiver — breaks cross-rank
+              replication, the desync detector's quarry)
+``straggle``  comm sites: sleep ``param`` seconds before joining the
+              collective (a slow rank; peers stall waiting for it)
+``drop``      comm sites: skip the collective entirely on this rank,
+              so peers hang in it (the collective-watchdog's quarry)
 
 A fault is scheduled with ``inject(site, kind, ...)`` (or the named
 helpers); ``after`` skips that many firings first and ``count`` bounds
 how many firings trigger.  Only one injector may be active per process
 (they install into a module global — the hooks must stay free when
-disarmed).
+disarmed).  For subprocess workers, :func:`FaultInjector.from_spec`
+parses the ``DSTPU_FAULT_SPEC`` wire format (see
+``resilience/distributed.py install_injector_from_env``).
 """
 from __future__ import annotations
 
@@ -46,15 +70,15 @@ class SimulatedCrash(BaseException):
 
 
 class _Fault:
-    __slots__ = ("site", "kind", "count", "after", "fraction")
+    __slots__ = ("site", "kind", "count", "after", "param")
 
     def __init__(self, site: str, kind: str, count: int, after: int,
-                 fraction: float):
+                 param: float):
         self.site = site
         self.kind = kind
         self.count = count          # remaining firings that trigger
         self.after = after          # firings to skip before arming
-        self.fraction = fraction    # torn writes: fraction of bytes kept
+        self.param = param          # torn/corrupt: fraction; straggle: delay_s
 
 
 class FaultInjector:
@@ -72,10 +96,15 @@ class FaultInjector:
 
     # -- scheduling -------------------------------------------------------
 
+    KINDS = ("oserror", "torn", "crash", "sigterm",
+             "corrupt", "straggle", "drop")
+
     def inject(self, site: str, kind: str, count: int = 1, after: int = 0,
-               fraction: float = 0.5) -> "FaultInjector":
-        assert kind in ("oserror", "torn", "crash", "sigterm"), kind
-        self.faults.append(_Fault(site, kind, count, after, fraction))
+               fraction: float = 0.5,
+               param: Optional[float] = None) -> "FaultInjector":
+        assert kind in self.KINDS, kind
+        self.faults.append(_Fault(site, kind, count, after,
+                                  fraction if param is None else param))
         return self
 
     def transient_oserror(self, site: str, count: int,
@@ -99,6 +128,55 @@ class FaultInjector:
         (exercises an installed preemption handler)."""
         return self.inject(site, "sigterm", after=after)
 
+    def corrupt(self, site: str, fraction: float = 0.05, after: int = 0,
+                count: int = 1) -> "FaultInjector":
+        """Corrupt ``fraction`` of this rank's local view of a
+        collective result (scale corruption — a lossy link)."""
+        return self.inject(site, "corrupt", count=count, after=after,
+                           param=fraction)
+
+    def straggle(self, site: str, delay_s: float = 0.25, after: int = 0,
+                 count: int = 1) -> "FaultInjector":
+        """Delay this rank ``delay_s`` seconds before it joins the
+        collective (peers stall waiting — a straggler rank)."""
+        return self.inject(site, "straggle", count=count, after=after,
+                           param=delay_s)
+
+    def drop(self, site: str, after: int = 0,
+             count: int = 1) -> "FaultInjector":
+        """Skip the collective on this rank; peers hang in it until a
+        watchdog deadline fires."""
+        return self.inject(site, "drop", count=count, after=after)
+
+    @classmethod
+    def from_spec(cls, spec: str, seed: int = 0) -> "FaultInjector":
+        """Parse the subprocess wire format: ``;``-separated faults,
+        each a whitespace/comma-separated list of ``key=value`` tokens —
+        ``site=`` and ``kind=`` required; ``after=``, ``count=``,
+        ``param=`` optional.  Example::
+
+            site=comm.all_reduce kind=corrupt after=1 param=0.5
+
+        (``resilience/distributed.py install_injector_from_env`` plumbs
+        this through ``DSTPU_FAULT_SPEC`` into worker processes.)"""
+        inj = cls(seed=seed)
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            kv: Dict[str, str] = {}
+            for tok in part.replace(",", " ").split():
+                k, _, v = tok.partition("=")
+                assert _ == "=", f"bad fault-spec token {tok!r} in {spec!r}"
+                kv[k] = v
+            assert "site" in kv and "kind" in kv, \
+                f"fault spec needs site= and kind=: {part!r}"
+            inj.inject(kv["site"], kv["kind"],
+                       count=int(kv.get("count", 1)),
+                       after=int(kv.get("after", 0)),
+                       param=(float(kv["param"]) if "param" in kv else None))
+        return inj
+
     # -- firing -----------------------------------------------------------
 
     def fire(self, site: str, **ctx: Any) -> Optional[Tuple[str, float]]:
@@ -120,7 +198,10 @@ class FaultInjector:
             if f.kind == "sigterm":
                 _signal.raise_signal(_signal.SIGTERM)
                 return None
-            return ("torn", f.fraction)
+            # directive kinds the site must honor: torn (fraction of
+            # bytes kept), corrupt (fraction of payload), straggle
+            # (delay seconds), drop (skip the op)
+            return (f.kind, f.param)
         return None
 
     # -- install ----------------------------------------------------------
@@ -146,7 +227,9 @@ def active() -> Optional[FaultInjector]:
 def hook(site: str, **ctx: Any) -> Optional[Tuple[str, float]]:
     """Instrumentation point.  Returns ``None`` (the overwhelmingly
     common disarmed case), raises an injected failure, or returns a
-    ``("torn", fraction)`` directive the write site must honor."""
+    ``(kind, param)`` directive the site must honor — ``("torn",
+    fraction)`` for write sites; ``("corrupt", fraction)``,
+    ``("straggle", delay_s)`` or ``("drop", 0)`` for comm sites."""
     if _ACTIVE is None:
         return None
     return _ACTIVE.fire(site, **ctx)

@@ -29,6 +29,7 @@ from deepspeed_tpu.utils.compat import shard_map as _shard_map_compat
 import deepspeed_tpu.comm as dist
 from deepspeed_tpu.config import DeepSpeedConfig, load_config
 from deepspeed_tpu.parallel.topology import MeshTopology
+from deepspeed_tpu.resilience.distributed import CollectiveTimeout
 from deepspeed_tpu.runtime import precision as prec
 from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
                                               RepeatingLoader, shard_batch)
@@ -515,7 +516,23 @@ class DeepSpeedEngine:
             self._skip_guard = SkippedStepGuard(
                 config.resilience.max_consecutive_skips)
         self._preemption_prev_handlers = None
+        self._preemption_save_dir = None
         self.preempted = False
+        # -- distributed health (resilience/distributed.py) ---------------
+        self.comm_timed_out = False
+        self._desync = None
+        rc = config.resilience.comm
+        if rc.collective_timeout_s > 0:
+            from deepspeed_tpu.comm import watchdog as _cwd
+
+            _cwd.configure(rc.collective_timeout_s)
+            log_dist(f"collective watchdog armed: "
+                     f"{rc.collective_timeout_s:.1f}s deadline", ranks=[0])
+        if rc.desync_interval > 0:
+            from deepspeed_tpu.resilience import DesyncDetector
+
+            self._desync = DesyncDetector(rc.desync_interval,
+                                          rc.desync_tolerance)
 
         self.optimizer = OptimizerHandle(self)
         log_dist(
@@ -1325,6 +1342,10 @@ class DeepSpeedEngine:
             else:
                 self.state, metrics = self._train_step_fn(self.state,
                                                           gbatch, lr)
+        except CollectiveTimeout as e:
+            if breakdown:
+                self.timers(STEP_GLOBAL_TIMER).discard()
+            self._handle_collective_timeout(e)    # re-raises
         except Exception:
             if breakdown:
                 self.timers(STEP_GLOBAL_TIMER).discard()
@@ -1348,6 +1369,15 @@ class DeepSpeedEngine:
             self._skip_guard.update(
                 bool(jax.device_get(metrics["overflow"])),
                 self.global_steps)
+        if (self._desync is not None
+                and self._desync.should_check(self.global_steps)):
+            # cross-rank comparison of replica-identical scalars: a
+            # corrupted collective (or diverged host-side stream) raises
+            # GradientAnomalyError here instead of training on silently
+            m = jax.device_get(metrics)
+            self._desync.check({"loss": float(m["loss"]),
+                                "grad_norm": float(m["grad_norm"])},
+                               self.global_steps)
 
         if self.global_steps % self.config.steps_per_print == 0:
             m = jax.device_get(metrics)
@@ -1366,6 +1396,12 @@ class DeepSpeedEngine:
                           if self.timers.has_timer(n)]
                 self.timers.log(names,
                                 normalizer=self.config.steps_per_print)
+            if (self.config.resilience.comm.straggler_report
+                    and self.monitor is not None and self.monitor.enabled):
+                # one small allgather per report (opt-in); names the
+                # rank every eager collective waits for
+                self.monitor.write_comm_health(dist.straggler_report(),
+                                               self.global_samples)
         if self.monitor is not None and self.monitor.enabled:
             m = jax.device_get(metrics)
             self.monitor.write_events([
@@ -1675,6 +1711,26 @@ class DeepSpeedEngine:
             save_dir, tag=f"emergency_step{self.global_steps}",
             async_save=False)
 
+    def _handle_collective_timeout(self, e: CollectiveTimeout) -> None:
+        """Route a collective timeout through the preemption path: a
+        peer is gone or the transport wedged, so this process must stop
+        cleanly and let the elastic layer restart the job.  The
+        emergency checkpoint is an ATTEMPT — its own collectives may hit
+        the same dead peer (the watchdog bounds them too), and a failed
+        save must not mask the original timeout."""
+        self.comm_timed_out = True
+        logger.error(f"collective timeout during training step: {e}")
+        save_dir = self._preemption_save_dir
+        if save_dir:
+            try:
+                path = self.emergency_checkpoint(save_dir)
+                logger.error(f"emergency checkpoint committed at {path}; "
+                             "aborting for elastic restart")
+            except BaseException as ce:
+                logger.error(f"emergency checkpoint failed under comm "
+                             f"timeout ({ce!r}); aborting without it")
+        raise e
+
     def install_preemption_handler(self, save_dir: str, signals=None,
                                    exit_after: bool = True) -> None:
         """SIGTERM hook (TPU preemption notice): drains the async saver,
@@ -1706,6 +1762,8 @@ class DeepSpeedEngine:
         for s in signals:
             prev[s] = _signal.signal(s, _handler)
         self._preemption_prev_handlers = prev
+        # collective timeouts reuse this dir for their emergency save
+        self._preemption_save_dir = save_dir
 
     def uninstall_preemption_handler(self) -> None:
         import signal as _signal

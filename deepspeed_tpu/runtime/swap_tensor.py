@@ -506,6 +506,11 @@ class NvmeOptimizerSwapper:
         # per-apply stage telemetry (see _apply_bucketed); engine surfaces
         # it under wall_clock_breakdown and the bench infinity row
         self.stage_stats: Dict[str, Any] = {}
+        # leafwise-stream IO accounting (incremented where reads/writes
+        # are actually submitted; _apply_leafwise resets per apply and
+        # reports read/write rates — the multi-process bench row)
+        self._io_read_bytes = 0
+        self._io_write_bytes = 0
         # (leaf key, shard index tag) pairs with moments on disk — THIS
         # process's shards only; other processes track their own
         self._initialized: set = set()
@@ -595,6 +600,7 @@ class NvmeOptimizerSwapper:
                     self.handle.async_pread(m, fname, 4 * off),
                     self.handle.async_pread(v, fname, 4 * (n_total + off)),
                     m, v)
+                self._io_read_bytes += m.nbytes + v.nbytes
                 continue
             if (key, tag) not in self._initialized:
                 if self._restored and not self._reshard_warned:
@@ -620,6 +626,7 @@ class NvmeOptimizerSwapper:
             fname = self._shard_fname(key, tag)
             out[idx] = (self.handle.async_pread(m, fname, 0),
                         self.handle.async_pread(v, fname, nbytes), m, v)
+            self._io_read_bytes += 2 * nbytes
         return out
 
     def finish_read(self, key: str, leaf, started) -> Tuple[Any, Any]:
@@ -668,6 +675,7 @@ class NvmeOptimizerSwapper:
                 m_np, fname, 0, _truncate=False))
             self._pending.append(self.handle.async_pwrite(
                 v_np, fname, m_np.nbytes, _truncate=False))
+            self._io_write_bytes += m_np.nbytes + v_np.nbytes
             self._initialized.add((key, tag))
             if self._buckets is not None and key in self._plan_keys:
                 # a leafwise write of a plan key leaves moments in item
@@ -1129,7 +1137,11 @@ class NvmeOptimizerSwapper:
         mixing half-advanced state into a retried step."""
         from deepspeed_tpu.checkpoint.sharded import path_str
 
+        import time as _time
+
         self.count += 1
+        self._io_read_bytes = self._io_write_bytes = 0
+        t_apply0 = _time.perf_counter()
         count = jnp.asarray(self.count, jnp.float32)
         lr = jnp.asarray(lr, jnp.float32)
         gscale = jnp.asarray(gscale, jnp.float32)
@@ -1201,6 +1213,25 @@ class NvmeOptimizerSwapper:
                 self._bucket_ready.clear()
             if ok and drain_err is not None:
                 raise drain_err
+        # per-shard leafwise stream telemetry: every rank reports ITS
+        # partition's read/write rate (the multi-process analogue of the
+        # bucketed path's stage_stats; wall is shared across overlapped
+        # reads/writes so the per-direction rates are indicative, the
+        # combined stream_gbps exact)
+        wall = _time.perf_counter() - t_apply0
+        self.stage_stats = {
+            "mode": "leafwise",
+            "wall_s": round(wall, 4),
+            "bytes_read": int(self._io_read_bytes),
+            "bytes_written": int(self._io_write_bytes),
+            "read_gbps": round(self._io_read_bytes / wall / 1e9, 6)
+            if wall > 0 else 0.0,
+            "write_gbps": round(self._io_write_bytes / wall / 1e9, 6)
+            if wall > 0 else 0.0,
+            "stream_gbps": round((self._io_read_bytes
+                                  + self._io_write_bytes) / wall / 1e9, 6)
+            if wall > 0 else 0.0,
+        }
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(params), new_leaves)
 

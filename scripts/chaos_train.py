@@ -11,10 +11,19 @@ nonzero on any unrecovered failure.
 
 Deterministic: the schedule is a pure function of ``--seed``.
 
+``--comm`` additionally runs the COMM fault pass: each comm-level
+fault kind (corrupt / straggle / drop) is injected into an eager
+``comm.all_reduce`` through the same hook surface the multi-process
+chaos tests drive (``tests/unit/multiproc/test_comm_chaos.py`` runs
+the real 2-process versions; this pass proves the single-process
+detection story end-to-end: wrong payload caught by checksum, delay
+caught by wall clock, skipped collective caught by the op log).
+
 Usage::
 
     python scripts/chaos_train.py --steps 30 --seed 0
     python scripts/chaos_train.py --steps 50 --faults 8 --seed 3
+    python scripts/chaos_train.py --steps 10 --comm
 """
 import argparse
 import os
@@ -88,12 +97,82 @@ def injector_for(kind: str, seed: int) -> FaultInjector:
     return inj
 
 
+def comm_fault_pass(seed: int) -> int:
+    """Inject every comm-level fault kind into an eager all_reduce and
+    verify each one is DETECTED (returns the number of undetected
+    faults — nonzero fails the soak).  Single-process: the group is
+    size 1, so ``expected == x`` for corrupt-free calls and detection
+    rests on payload checksums, wall clocks, and the op log — the
+    multi-process desync/watchdog versions live in the multiproc chaos
+    tests."""
+    import time
+
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.comm import watchdog
+
+    undetected = 0
+    x = jnp.ones((1, 4096), dtype=jnp.float32)
+    dist.comms_logger.enabled = True
+    dist.all_reduce(x)                         # warm the eager cache
+    expected = np.asarray(dist.all_reduce(x))
+
+    # corrupt: the local result view diverges from the clean payload
+    with FaultInjector(seed=seed).corrupt("comm.all_reduce", fraction=0.5):
+        out = np.asarray(dist.all_reduce(x))
+    if np.allclose(out, expected):
+        print("FAIL: corrupt comm fault not detectable in payload")
+        undetected += 1
+    else:
+        print("  comm corrupt: detected (payload checksum diverged)")
+
+    # straggle: the injected delay dominates the call's wall clock
+    delay = 0.2
+    t0 = time.perf_counter()
+    with FaultInjector(seed=seed).straggle("comm.all_reduce",
+                                           delay_s=delay):
+        dist.all_reduce(x)
+    if time.perf_counter() - t0 < delay:
+        print("FAIL: straggle comm fault left no wall-clock trace")
+        undetected += 1
+    else:
+        print(f"  comm straggle: detected (call stalled >= {delay}s)")
+
+    # drop: the collective is skipped — no latency record is appended
+    # and the rank keeps its unreduced input
+    before = dist.comms_logger.per_op_mean_latency()["all_reduce"]["count"]
+    with FaultInjector(seed=seed).drop("comm.all_reduce") as inj:
+        out = np.asarray(dist.all_reduce(x))
+    after = dist.comms_logger.per_op_mean_latency()["all_reduce"]["count"]
+    if after != before or not inj.fired:
+        print("FAIL: drop comm fault not detected in the op log")
+        undetected += 1
+    else:
+        print("  comm drop: detected (collective skipped, op log "
+              "unchanged)")
+
+    # the watchdog deadline fires on a wedged collective wait
+    wd = watchdog.CollectiveWatchdog(0.05)
+    try:
+        wd.guard(lambda: time.sleep(2), "chaos wedge")
+        print("FAIL: watchdog deadline never fired")
+        undetected += 1
+    except Exception as e:
+        print(f"  comm watchdog: deadline fired ({type(e).__name__})")
+    dist.log_summary(show_straggler=True)
+    dist.comms_logger.enabled = False
+    return undetected
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--faults", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--save-interval", type=int, default=5)
+    ap.add_argument("--comm", action="store_true",
+                    help="also run the comm-level fault pass "
+                         "(corrupt/straggle/drop + watchdog)")
     ap.add_argument("--dir", default=None,
                     help="checkpoint dir (default: fresh tmpdir)")
     args = ap.parse_args(argv)
@@ -155,8 +234,16 @@ def main(argv=None) -> int:
     if faults_mod.active() is not None:
         print("FAIL: a FaultInjector leaked past its context")
         return 1
+    comm_undetected = 0
+    if args.comm:
+        print("comm fault pass:")
+        comm_undetected = comm_fault_pass(args.seed)
+        if comm_undetected:
+            print(f"FAIL: {comm_undetected} comm faults went undetected")
+            return 1
     print(f"OK: {args.steps} steps, {n_scheduled} faults injected, "
-          f"{recovered} recoveries, final checkpoint verified")
+          f"{recovered} recoveries, final checkpoint verified"
+          + (", comm fault pass clean" if args.comm else ""))
     return 0
 
 

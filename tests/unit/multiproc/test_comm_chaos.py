@@ -1,0 +1,152 @@
+"""Two-process comm-level chaos tests (worker_chaos.py): seeded
+per-rank fault injection into real cross-process collectives.  Each
+fault kind must terminate DETERMINISTICALLY — detected, named in the
+output, clean (nonzero) exit — well under the fixture timeout, instead
+of wedging both workers until the harness kills them.
+
+Budget note (tier-1): one CPU device per process, 64-float payloads,
+short watchdog deadlines — each case is bounded by worker startup, not
+by the fault path.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "worker_chaos.py")
+
+# worker exit codes (worker_chaos.py)
+EXIT_DESYNC = 3
+EXIT_TIMEOUT = 4
+EXIT_PEER = 5
+EXIT_DROPPED = 6
+
+pytestmark = pytest.mark.faults
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch(mode: str, fault: str = None, fault_rank: int = 1,
+            watchdog_s: float = 6.0, nproc: int = 2, timeout: int = 240):
+    """Run the chaos workers to completion; returns
+    ``([(returncode, output), ...], elapsed_s)`` — nonzero exits are the
+    EXPECTED outcome here, so no assertion happens in the launcher."""
+    port = _free_port()
+    procs = []
+    for pid in range(nproc):
+        env = dict(os.environ)
+        env.update({
+            "DSTPU_COORD": f"127.0.0.1:{port}",
+            "DSTPU_NPROC": str(nproc),
+            "DSTPU_PID": str(pid),
+            "DSTPU_MODE": mode,
+            "DSTPU_WD": str(watchdog_s),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "",
+        })
+        if fault is not None:
+            env["DSTPU_FAULT_SPEC"] = fault
+            env["DSTPU_FAULT_RANK"] = str(fault_rank)
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    t0 = time.monotonic()
+    results = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        results.append((p.returncode, out))
+    return results, time.monotonic() - t0
+
+
+def test_corrupted_all_reduce_detected():
+    """A lossy link corrupts rank 1's local view of one all_reduce; the
+    cross-rank desync check must catch it on EVERY rank and abort with
+    the desync named."""
+    results, _ = _launch(
+        "corrupt",
+        fault="site=comm.all_reduce kind=corrupt after=1 param=0.5")
+    for rc, out in results:
+        assert rc == EXIT_DESYNC, f"expected desync abort, got {rc}:\n{out[-3000:]}"
+        assert "DESYNC_DETECTED" in out
+        assert "cross-rank desync" in out
+    # the corrupting rank logged the injection (determinism evidence)
+    assert any("[fault-injection] comm.all_reduce" in out
+               for _, out in results)
+
+
+def test_straggler_rank_named():
+    """An injected slow rank (arrives 0.4s late on 3 calls) must be
+    NAMED in the cross-rank straggler report and log_summary on every
+    rank — peers wait for it, it never waits itself."""
+    results, _ = _launch(
+        "straggle",
+        fault="site=comm.all_reduce kind=straggle after=1 count=3 param=0.4")
+    for rc, out in results:
+        assert rc == 0, f"straggle run should complete: {rc}\n{out[-3000:]}"
+        rec = next(json.loads(ln[len("RESULT "):])
+                   for ln in out.splitlines() if ln.startswith("RESULT "))
+        assert rec["straggler"]["straggler_rank"] == 1, rec
+        assert "STRAGGLER rank 1" in out
+
+
+def test_dropped_collective_watchdog_abort():
+    """Rank 1 silently skips an all_reduce; rank 0 must NOT hang — the
+    collective watchdog fires its deadline and both workers exit
+    cleanly, fast."""
+    # rank 0 drops: it hosts the jax coordination service, so it must
+    # be the rank that OUTLIVES the abort (a non-coordinator dropper
+    # would be SIGABRTed by its distributed client the moment the
+    # exiting victim closes the coordinator socket)
+    results, elapsed = _launch(
+        "drop", fault="site=comm.all_reduce kind=drop after=1",
+        fault_rank=0, watchdog_s=5.0)
+    rc0, out0 = results[0]
+    rc1, out1 = results[1]
+    # the victim rank is stalled in the dropped all_reduce: its
+    # watchdog deadline must fire — or, if the transport noticed the
+    # missing peer first, a surfaced peer failure.  Both are clean,
+    # marked, fast aborts; neither may hang.
+    assert rc1 in (EXIT_TIMEOUT, EXIT_PEER), f"{rc1}\n{out1[-3000:]}"
+    assert ("COLLECTIVE_TIMEOUT" in out1) or ("COMM_PEER_FAILURE" in out1)
+    assert rc0 == EXIT_DROPPED, f"{rc0}\n{out0[-3000:]}"
+    assert "[fault-injection] comm.all_reduce: dropped" in out0
+    assert elapsed < 150, f"should abort well under the fixture timeout: {elapsed:.0f}s"
+
+
+def test_worker_sigkill_survivor_exits_cleanly():
+    """Rank 1 SIGKILLs itself mid-step; the survivor's next collective
+    must fail fast (watchdog deadline or transport error) instead of
+    hanging until the 240s fixture timeout."""
+    results, elapsed = _launch("kill", watchdog_s=5.0)
+    rc0, out0 = results[0]
+    rc1, out1 = results[1]
+    assert rc1 == -9, f"rank 1 should die by SIGKILL: {rc1}\n{out1[-2000:]}"
+    assert "KILLED rank=1" in out1
+    assert rc0 in (EXIT_TIMEOUT, EXIT_PEER), f"{rc0}\n{out0[-3000:]}"
+    assert ("COLLECTIVE_TIMEOUT" in out0) or ("COMM_PEER_FAILURE" in out0)
+    assert elapsed < 150, f"survivor should abort fast: {elapsed:.0f}s"
+
+
+def test_faults_marker_stays_registered(request):
+    """Budget guard companion: the ``faults`` marker these chaos tests
+    ride on must stay registered in pyproject (unregistered markers turn
+    into warnings and, under -W error, collection failures)."""
+    names = [m.split(":", 1)[0].strip()
+             for m in request.config.getini("markers")]
+    assert "faults" in names, names

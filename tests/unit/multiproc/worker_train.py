@@ -20,7 +20,17 @@ except AttributeError:  # pre-0.5 jax (same fallback as tests/conftest.py)
                                " --xla_force_host_platform_device_count=4")
     # pre-0.5 CPU backend needs gloo for cross-process collectives
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
-jax.distributed.initialize(
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))))
+
+from deepspeed_tpu.resilience.retry import retriable  # noqa: E402
+
+# The old-gloo transport intermittently fails the rendezvous/first
+# connect (EnforceNotMet preamble.length) — a transient, so the
+# distributed bootstrap rides the resilience backoff decorator instead
+# of flaking the whole worker.
+retriable(attempts=4, base_s=0.5, cap_s=4.0,
+          retry_on=(RuntimeError, OSError))(jax.distributed.initialize)(
     coordinator_address=os.environ["DSTPU_COORD"],
     num_processes=int(os.environ["DSTPU_NPROC"]),
     process_id=int(os.environ["DSTPU_PID"]))
@@ -29,11 +39,13 @@ import flax.linen as nn            # noqa: E402
 import jax.numpy as jnp            # noqa: E402
 import numpy as np                 # noqa: E402
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__))))))
-
 import deepspeed_tpu               # noqa: E402
 import deepspeed_tpu.comm as dist  # noqa: E402
+from deepspeed_tpu.resilience import distributed as rdist  # noqa: E402
+
+# per-rank fault plumbing (DSTPU_FAULT_SPEC / DSTPU_FAULT_RANK): no-op
+# unless the launching test armed it
+rdist.install_injector_from_env()
 
 
 class TinyNet(nn.Module):
@@ -80,6 +92,12 @@ def main():
     losses = []
     for s in range(3):
         losses.append(float(jax.device_get(eng.train_batch(batch=data(0)))))
+    # per-shard leafwise moment-stream rate: multi-process jobs run the
+    # leafwise NVMe stream (each rank swaps its own partition) — report
+    # this rank's measured read/write rate (the bench-matrix
+    # leafwise_mp row aggregates it)
+    leafwise = (dict(eng.nvme_swapper.stage_stats)
+                if mode == "nvme" and eng.nvme_swapper is not None else None)
     ckpt = os.path.join(scratch, "ckpt")
     eng.save_checkpoint(ckpt, tag="t", async_save=False)
 
@@ -99,6 +117,7 @@ def main():
         "losses": losses,
         "l_orig": l_orig,
         "l_resume": l_resume,
+        "leafwise": leafwise,
     }), flush=True)
 
 

@@ -114,6 +114,44 @@ def test_comms_logger(topo8):
     dist.comms_logger.enabled = False
 
 
+def test_log_summary_show_straggler_single_process(topo8):
+    """``show_straggler=True`` on one process: the per-call straggler
+    effect (worst-vs-avg latency) renders for every measured op, and no
+    cross-rank section appears (nothing to compare against)."""
+    dist.comms_logger.enabled = True
+    x = jnp.ones((8, 256), dtype=jnp.float32)
+    for _ in range(3):
+        dist.all_reduce(x, group=DATA_AXIS)
+    summary = dist.log_summary(show_straggler=True)
+    assert "straggler effect" in summary
+    assert "cross-rank straggler report" not in summary
+    # the effect line is worst - avg, so it is only emitted with data
+    base = dist.log_summary(show_straggler=False)
+    assert "straggler effect" not in base
+    dist.comms_logger.enabled = False
+
+
+def test_per_op_mean_latency_pools_sizes(topo8):
+    dist.comms_logger.enabled = True
+    for cols in (128, 256):
+        x = jnp.ones((8, cols), dtype=jnp.float32)
+        dist.all_reduce(x, group=DATA_AXIS)
+        dist.all_reduce(x, group=DATA_AXIS)
+    means = dist.comms_logger.per_op_mean_latency()
+    assert means["all_reduce"]["count"] == 4
+    assert means["all_reduce"]["mean_s"] > 0
+    dist.comms_logger.enabled = False
+
+
+def test_straggler_report_single_process_empty(topo8):
+    """One process has nobody to compare against: the report carries no
+    per-op entries (build_straggler_report needs >= 2 ranks)."""
+    dist.comms_logger.enabled = True
+    dist.all_reduce(jnp.ones((8, 64), dtype=jnp.float32), group=DATA_AXIS)
+    assert dist.straggler_report() == {}
+    dist.comms_logger.enabled = False
+
+
 def test_topology_process_coords():
     from deepspeed_tpu.parallel import PipeModelDataParallelTopology
     topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)

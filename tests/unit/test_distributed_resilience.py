@@ -1,0 +1,403 @@
+"""Distributed-resilience unit tests (resilience/distributed.py +
+comm/watchdog.py + the comm fault sites): everything that can be proven
+single-process, tier-1-fast.  The real two-process chaos runs live in
+tests/unit/multiproc/test_comm_chaos.py.
+"""
+import os
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.comm import watchdog
+from deepspeed_tpu.launcher.elastic_agent import DSElasticAgent
+from deepspeed_tpu.resilience import (CollectiveTimeout, DesyncDetector,
+                                      FaultInjector, GradientAnomalyError,
+                                      build_straggler_report, tree_checksum)
+from deepspeed_tpu.resilience import distributed as rdist
+from simple_model import random_tokens, tiny_gpt2
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture
+def topo8(devices):
+    return dist.initialize_mesh(dp=8)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_watchdog():
+    yield
+    watchdog.configure(0)
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_disabled_is_inline_call():
+    wd = watchdog.CollectiveWatchdog(0)
+    assert not wd.enabled
+    # no heartbeat thread: the callable runs on the caller's thread
+    assert wd.guard(threading.get_ident) == threading.get_ident()
+    assert wd._pool is None
+
+
+def test_watchdog_deadline_raises_collective_timeout():
+    wd = watchdog.CollectiveWatchdog(0.05)
+    t0 = time.perf_counter()
+    with pytest.raises(CollectiveTimeout, match="deadline"):
+        wd.guard(lambda: time.sleep(3), what="test-collective")
+    assert time.perf_counter() - t0 < 1.0, "must fail at the deadline"
+    assert wd.timeouts == 1
+    # the wedged heartbeat thread was abandoned; the next guard works
+    assert wd.guard(lambda: 42) == 42
+
+
+def test_watchdog_propagates_exceptions():
+    wd = watchdog.CollectiveWatchdog(5.0)
+
+    def boom():
+        raise ValueError("transport error")
+
+    with pytest.raises(ValueError, match="transport error"):
+        wd.guard(boom)
+
+
+def test_watchdog_configure_roundtrip():
+    watchdog.configure(7.5)
+    assert watchdog.get_watchdog().deadline_s == 7.5
+    assert watchdog.get_watchdog().enabled
+    watchdog.configure(0)
+    assert not watchdog.get_watchdog().enabled
+
+
+# ---------------------------------------------------------------------------
+# fault kinds + spec parsing + env plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_new_fault_kinds_fire_deterministically():
+    inj = FaultInjector(seed=3)
+    inj.corrupt("comm.all_reduce", fraction=0.25, after=1)
+    inj.straggle("comm.all_gather", delay_s=0.5)
+    inj.drop("comm.barrier", count=2)
+    from deepspeed_tpu.resilience import faults as faults_mod
+
+    with inj:
+        assert faults_mod.hook("comm.all_reduce") is None      # after=1
+        assert faults_mod.hook("comm.all_reduce") == ("corrupt", 0.25)
+        assert faults_mod.hook("comm.all_reduce") is None      # count spent
+        assert faults_mod.hook("comm.all_gather") == ("straggle", 0.5)
+        assert faults_mod.hook("comm.barrier") == ("drop", 0.5)
+        assert faults_mod.hook("comm.barrier") == ("drop", 0.5)
+        assert faults_mod.hook("comm.barrier") is None
+    assert inj.fired == [("comm.all_reduce", "corrupt", 2),
+                         ("comm.all_gather", "straggle", 1),
+                         ("comm.barrier", "drop", 1),
+                         ("comm.barrier", "drop", 2)]
+
+
+def test_fault_spec_parsing():
+    inj = FaultInjector.from_spec(
+        "site=comm.all_reduce kind=corrupt after=2 count=3 param=0.75; "
+        "site=ckpt.commit kind=sigterm")
+    assert [(f.site, f.kind, f.count, f.after, f.param)
+            for f in inj.faults] == [
+        ("comm.all_reduce", "corrupt", 3, 2, 0.75),
+        ("ckpt.commit", "sigterm", 1, 0, 0.5)]
+
+
+def test_fault_spec_rejects_garbage():
+    with pytest.raises(AssertionError):
+        FaultInjector.from_spec("comm.all_reduce corrupt")
+    with pytest.raises(AssertionError):
+        FaultInjector.from_spec("site=x.y kind=warp")
+
+
+def test_install_injector_from_env_rank_gate():
+    # this process is rank 0: a rank-1 gate must NOT arm
+    env = {"DSTPU_FAULT_SPEC": "site=comm.all_reduce kind=drop",
+           "DSTPU_FAULT_RANK": "1"}
+    assert rdist.install_injector_from_env(env) is None
+    from deepspeed_tpu.resilience import faults as faults_mod
+
+    assert faults_mod.active() is None
+    # matching rank (0) arms; disarm via the returned handle
+    env["DSTPU_FAULT_RANK"] = "0"
+    inj = rdist.install_injector_from_env(env)
+    try:
+        assert faults_mod.active() is inj
+        assert inj.faults[0].site == "comm.all_reduce"
+    finally:
+        inj.__exit__(None, None, None)
+    assert faults_mod.active() is None
+
+
+def test_install_injector_from_env_absent_is_noop():
+    assert rdist.install_injector_from_env({}) is None
+
+
+# ---------------------------------------------------------------------------
+# comm fault sites (single-process eager path)
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_directive_breaks_local_view(topo8):
+    x = jnp.stack([jnp.full((16,), float(i)) for i in range(8)])
+    clean = np.asarray(dist.all_reduce(x, group="data"))
+    with FaultInjector().corrupt("comm.all_reduce", fraction=0.5):
+        out = np.asarray(dist.all_reduce(x, group="data"))
+    assert not np.allclose(out, clean), "corruption must change the view"
+    # and the checksum diverges — what the cross-rank desync check keys on
+    assert tree_checksum(jnp.asarray(out)) != tree_checksum(
+        jnp.asarray(clean))
+
+
+def test_drop_directive_skips_collective(topo8):
+    dist.comms_logger.enabled = True
+    x = jnp.ones((8, 4))
+    with FaultInjector().drop("comm.all_reduce") as inj:
+        out = np.asarray(dist.all_reduce(x, group="data"))
+    # the rank returned its input unreduced and logged NO latency record
+    np.testing.assert_allclose(out, np.asarray(x))
+    assert inj.fired == [("comm.all_reduce", "drop", 1)]
+    assert "all_reduce" not in dist.comms_logger.per_op_mean_latency()
+
+
+def test_straggle_directive_delays_call(topo8):
+    x = jnp.ones((8, 4))
+    dist.all_reduce(x, group="data")             # warm the eager cache
+    t0 = time.perf_counter()
+    with FaultInjector().straggle("comm.all_reduce", delay_s=0.15):
+        dist.all_reduce(x, group="data")
+    assert time.perf_counter() - t0 >= 0.15
+
+
+def test_barrier_fault_site_and_fastpath(topo8):
+    # disarmed: plain barrier works (the hook is a single None check)
+    dist.barrier()
+    with FaultInjector().drop("comm.barrier") as inj:
+        dist.barrier()                           # dropped: returns at once
+    assert inj.fired == [("comm.barrier", "drop", 1)]
+
+
+def test_eager_collectives_unchanged_without_injector(topo8):
+    # the fault-free path must stay exact: sum of rank contributions
+    x = jnp.stack([jnp.full((4,), float(i)) for i in range(8)])
+    out = np.asarray(dist.all_reduce(x, group="data"))
+    np.testing.assert_allclose(out, np.full((8, 4), float(sum(range(8)))))
+
+
+# ---------------------------------------------------------------------------
+# desync detection + straggler aggregation (cross-rank logic, 1 process)
+# ---------------------------------------------------------------------------
+
+
+def test_desync_detector_single_process_passes():
+    det = DesyncDetector(interval=2)
+    assert not det.should_check(1)
+    assert det.should_check(2)
+    assert det.check({"loss": 1.25, "grad_norm": 0.5}, 2)
+    assert det.checks == 1 and det.mismatches == 0
+
+
+def test_desync_detector_flags_divergence(monkeypatch):
+    det = DesyncDetector(interval=1, tolerance=1e-6)
+    monkeypatch.setattr(
+        rdist, "allgather_json",
+        lambda obj: [{"rank": 0, "values": {"loss": 1.0}},
+                     {"rank": 1, "values": {"loss": 1.5}}])
+    with pytest.raises(GradientAnomalyError, match="cross-rank desync"):
+        det.check({"loss": 1.0}, 7)
+    assert det.mismatches == 1
+
+
+def test_desync_detector_flags_nonfinite_rank(monkeypatch):
+    det = DesyncDetector(interval=1, tolerance=10.0)
+    monkeypatch.setattr(
+        rdist, "allgather_json",
+        lambda obj: [{"rank": 0, "values": {"loss": 1.0}},
+                     {"rank": 1, "values": {"loss": float("nan")}}])
+    with pytest.raises(GradientAnomalyError):
+        det.check({"loss": 1.0}, 3)
+
+
+def test_desync_detector_respects_tolerance(monkeypatch):
+    det = DesyncDetector(interval=1, tolerance=1.0)
+    monkeypatch.setattr(
+        rdist, "allgather_json",
+        lambda obj: [{"rank": 0, "values": {"loss": 1.0}},
+                     {"rank": 1, "values": {"loss": 1.5}}])
+    assert det.check({"loss": 1.0}, 1)
+
+
+def test_allgather_json_single_process_roundtrip():
+    assert rdist.allgather_json({"a": [1, 2]}) == [{"a": [1, 2]}]
+
+
+def test_straggler_report_names_argmin_rank():
+    report = build_straggler_report([
+        {"all_reduce": {"mean_s": 0.300, "count": 4}},
+        {"all_reduce": {"mean_s": 0.002, "count": 4}},
+    ])
+    rec = report["all_reduce"]
+    # the straggler WAITS LEAST (peers absorb its delay)
+    assert rec["straggler_rank"] == 1
+    assert rec["slowest_peer_rank"] == 0
+    assert rec["spread_ms"] == pytest.approx(298.0)
+
+
+def test_straggler_report_uniform_jitter_names_nobody():
+    report = build_straggler_report([
+        {"all_reduce": {"mean_s": 0.0020, "count": 4}},
+        {"all_reduce": {"mean_s": 0.0025, "count": 4}},
+    ])
+    assert report["all_reduce"]["straggler_rank"] is None
+
+
+def test_tree_checksum_covers_leaves():
+    a = tree_checksum({"w": jnp.ones((4, 4)), "b": np.full((2,), 3.0)})
+    assert a == pytest.approx(22.0)
+
+
+# ---------------------------------------------------------------------------
+# engine + elastic agent routing
+# ---------------------------------------------------------------------------
+
+
+def _cfg(**over):
+    cfg = {"train_batch_size": 8,
+           "steps_per_print": 100000,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}}}
+    cfg.update(over)
+    return cfg
+
+
+def _engine(cfg_over=None):
+    topo = dist.initialize_mesh(dp=8)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=_cfg(**(cfg_over or {})), topology=topo,
+        example_batch=random_tokens(8), rng=jax.random.PRNGKey(0))
+    return engine
+
+
+def test_resilience_comm_config_block():
+    engine = _engine({"resilience": {"comm": {
+        "collective_timeout_s": 12.5, "desync_interval": 4,
+        "desync_tolerance": 0.25}}})
+    rc = engine.config.resilience.comm
+    assert rc.collective_timeout_s == 12.5
+    assert rc.desync_interval == 4
+    assert engine._desync is not None and engine._desync.interval == 4
+    # the engine armed the process watchdog from the config
+    assert watchdog.get_watchdog().deadline_s == 12.5
+
+
+def test_resilience_comm_config_rejects_negative():
+    from deepspeed_tpu.config import load_config
+
+    with pytest.raises(Exception):
+        load_config(_cfg(resilience={"comm": {"collective_timeout_s": -1}}))
+
+
+def test_engine_desync_check_wired_into_train_batch(devices):
+    engine = _engine({"resilience": {"comm": {"desync_interval": 1}}})
+    engine.train_batch(batch=random_tokens(8, seed=1))
+    engine.train_batch(batch=random_tokens(8, seed=2))
+    # single process: every check passes but the path runs
+    assert engine._desync.checks == 2
+    assert engine._desync.mismatches == 0
+
+
+def test_engine_routes_collective_timeout_to_emergency_ckpt(tmp_path,
+                                                            devices):
+    engine = _engine()
+    engine.install_preemption_handler(str(tmp_path), exit_after=False)
+    try:
+        def wedged(state, batch, lr):
+            raise CollectiveTimeout("injected: peer dropped the collective")
+
+        engine._train_step_fn = wedged
+        with pytest.raises(CollectiveTimeout):
+            engine.train_batch(batch=random_tokens(8, seed=3))
+    finally:
+        engine.uninstall_preemption_handler()
+    assert engine.comm_timed_out
+    # the preemption path committed an emergency tag before the abort
+    tag = f"emergency_step{engine.global_steps}"
+    assert (tmp_path / tag / "ds_meta.json").exists()
+    fresh = _engine()
+    loaded_tag, _ = fresh.load_checkpoint(str(tmp_path))
+    assert loaded_tag and os.path.basename(loaded_tag) == tag
+
+
+def test_engine_collective_timeout_without_handler_still_raises(devices):
+    engine = _engine()
+
+    def wedged(state, batch, lr):
+        raise CollectiveTimeout("injected")
+
+    engine._train_step_fn = wedged
+    with pytest.raises(CollectiveTimeout):
+        engine.train_batch(batch=random_tokens(8, seed=3))
+    assert engine.comm_timed_out
+
+
+def test_elastic_agent_consumes_restart_on_collective_timeout(tmp_path,
+                                                              devices):
+    calls = {"n": 0}
+
+    def build_engine(topo, cfg):
+        engine, *_ = deepspeed_tpu.initialize(
+            model=tiny_gpt2(), config=dict(cfg), topology=topo,
+            example_batch=random_tokens(8), rng=jax.random.PRNGKey(0))
+        if calls["n"] == 0:
+            # first incarnation wedges on its first step
+            def wedged(state, batch, lr):
+                raise CollectiveTimeout("injected: wedged transport")
+
+            engine._train_step_fn = wedged
+        calls["n"] += 1
+        return engine
+
+    agent = DSElasticAgent(build_engine, _cfg(), str(tmp_path),
+                           save_interval=2, max_restarts=2,
+                           sleep_fn=lambda s: None)
+    engine = agent.run(lambda step, gbs: random_tokens(8, seed=step),
+                       num_steps=2)
+    assert agent.restarts == 1, "the timeout must consume exactly 1 restart"
+    assert engine.global_steps == 2
+
+
+# ---------------------------------------------------------------------------
+# monitor surfacing
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_write_comm_health(tmp_path):
+    from deepspeed_tpu.config import load_config
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+    cfg = load_config(_cfg(csv_monitor={
+        "enabled": True, "output_path": str(tmp_path), "job_name": "j"}))
+    mon = MonitorMaster(cfg.monitor_config)
+    assert mon.enabled
+    mon.write_comm_health({
+        "all_reduce": {"straggler_rank": 1, "spread_ms": 250.0},
+        "barrier": {"straggler_rank": None, "spread_ms": 0.5},
+    }, step=16)
+    named = (tmp_path / "j" / "Comm_all_reduce_straggler_rank.csv")
+    assert named.exists()
+    assert ",1.0" in named.read_text().splitlines()[-1]
+    unnamed = (tmp_path / "j" / "Comm_barrier_straggler_rank.csv")
+    assert ",-1.0" in unnamed.read_text().splitlines()[-1]
