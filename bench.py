@@ -934,22 +934,45 @@ def bench_infinity(args) -> None:
                   for kp, v in big}
     sub_grads = jax.tree_util.tree_map(
         lambda v: jnp.ones(v.shape, v.dtype), sub_params)
-    swapper = NvmeOptimizerSwapper(nvme_dir, sub_params)
-    try:
-        swapper.apply(sub_params, sub_grads, lr=1e-4, gscale=1.0)
-        nbytes = sum(v.size * 8 for v in sub_params.values())
-        t0 = time.perf_counter()
-        swapper.start_prefetch()          # as the engine does, post-bwd
-        swapper.apply(sub_params, sub_grads, lr=1e-4, gscale=1.0)
-        swapper.drain()                   # charge deferred write-back here
-        swap_s = time.perf_counter() - t0
-        # per-stage pipeline waits: the evidence that the stream is
-        # overlap-bound or bandwidth-bound, not an asserted property
-        detail["nvme_swap_stages"] = dict(swapper.stage_stats)
-    finally:
-        swapper.close()
-    stream_gbps = 2 * nbytes / swap_s / 1e9
+    def measure_swap(verify: bool, reps: int = 3):
+        swapper = NvmeOptimizerSwapper(nvme_dir, sub_params,
+                                       sdc_verify=verify)
+        try:
+            swapper.apply(sub_params, sub_grads, lr=1e-4, gscale=1.0)
+            nb = sum(v.size * 8 for v in sub_params.values())
+            best = float("inf")
+            for _ in range(reps):         # best-of: amortize cache noise
+                t0 = time.perf_counter()
+                swapper.start_prefetch()  # as the engine does, post-bwd
+                swapper.apply(sub_params, sub_grads, lr=1e-4, gscale=1.0)
+                swapper.drain()           # charge deferred write-back here
+                best = min(best, time.perf_counter() - t0)
+            stages = dict(swapper.stage_stats)
+        finally:
+            swapper.close()
+        return 2 * nb / best / 1e9, nb, stages
+
+    # verify-off control FIRST (warms the page cache the same way for
+    # both), then the verify-on run the row reports — the delta is the
+    # measured end-to-end checksum cost on the stream
+    gbps_off, _, _ = measure_swap(verify=False)
+    stream_gbps, nbytes, stages = measure_swap(verify=True)
+    # per-stage pipeline waits: the evidence that the stream is
+    # overlap-bound or bandwidth-bound, not an asserted property
+    detail["nvme_swap_stages"] = stages
     detail["nvme_swap_gbps"] = round(stream_gbps, 3)
+    detail["nvme_swap_gbps_verify_off"] = round(gbps_off, 3)
+    # SDC checksum overhead on the moment stream (target <= 5%).  The
+    # digests run on a side thread pool, so the cost hides behind the
+    # pipeline wherever >= 2 host cores exist; on a 1-core container
+    # every pass serializes and this measures the raw 2-extra-memory-
+    # passes cost instead (~bytes/9GBps over the stream wall) — read
+    # it together with host_cores.  Negative deltas are run-to-run
+    # noise, clamped to 0.
+    detail["sdc_overhead_pct"] = round(
+        max(0.0, (gbps_off - stream_gbps) / gbps_off * 100.0), 2) \
+        if gbps_off > 0 else None
+    detail["host_cores"] = os.cpu_count()
     if on_tpu:
         # client-link control: eager device_put/device_get of 64 MB —
         # the path every NVMe swap byte takes under this tunnel harness

@@ -103,6 +103,13 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         "global_samples": engine.global_samples,
         "client_state": client_state or {},
     }
+    # dataloader cursor (seed, epoch, in-epoch batch) rides along so a
+    # resumed run CONTINUES mid-epoch instead of replaying/skipping data
+    dl = getattr(engine, "training_dataloader", None)
+    if dl is not None and callable(getattr(dl, "state_dict", None)):
+        dl_state = dl.state_dict()
+        if dl_state is not None:
+            extra["dataloader"] = dl_state
     meta = {
         "tag": tag,
         "format": "sharded-v1",
@@ -431,6 +438,12 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     engine.global_samples = int(extra.get("global_samples", 0))
     if load_lr_scheduler_states and engine.lr_scheduler is not None:
         engine.lr_scheduler.load_state_dict(extra["lr_scheduler"])
+    dl_state = extra.get("dataloader")
+    dl = getattr(engine, "training_dataloader", None)
+    if dl_state is not None and dl is not None and \
+            callable(getattr(dl, "load_state_dict", None)):
+        dl.load_state_dict(dl_state)
+        engine._data_iter = None          # re-enter at the restored cursor
     if load_optimizer_states and \
             getattr(engine, "nvme_swapper", None) is not None:
         if not engine.nvme_swapper.load_from(path):

@@ -306,6 +306,41 @@ class CompressionConfig(ConfigModel):
     layer_reduction: Dict[str, Any] = Field(default_factory=dict)
 
 
+class SdcConfig(ConfigModel):
+    """``resilience.sdc`` subtree (runtime/swap_tensor.py +
+    resilience/sdc.py): silent-data-corruption defense for the NVMe
+    offload hot path.  Every bucket/shard the moment stream writes is
+    digested (on a side thread, overlapped with the in-flight IO) and
+    re-verified on swap-in before the bytes reach the optimizer update;
+    a mismatch re-reads with backoff, then quarantines the swap file
+    and raises ``SwapCorruptionError`` through the engine's
+    emergency-checkpoint path."""
+
+    # verify every swap-in against the write-side digest (off = the
+    # pre-defense behavior, byte-identical stream, no digests computed)
+    verify_on_read: bool = True
+    # digest algorithm: sum64 (numpy-vectorized wraparound word sum,
+    # ~4 GB/s/core — default; detects any single flipped bit) |
+    # adler32 | crc32 (zlib; slower, stronger burst detection)
+    checksum: str = "sum64"
+    # blocking re-reads before a mismatching bucket/shard is declared
+    # persistently corrupt and quarantined (transient host-buffer/DMA
+    # corruption heals here)
+    max_reread_retries: int = 2
+
+    @model_validator(mode="after")
+    def _validate(self):
+        allowed = ("sum64", "adler32", "crc32")
+        if self.checksum not in allowed:
+            raise ValueError(
+                f"resilience.sdc.checksum must be one of {allowed}, "
+                f"got {self.checksum!r}")
+        if self.max_reread_retries < 0:
+            raise ValueError(
+                "resilience.sdc.max_reread_retries must be >= 0")
+        return self
+
+
 class CommResilienceConfig(ConfigModel):
     """``resilience.comm`` subtree (deepspeed_tpu/resilience/distributed.py
     + comm/watchdog.py): distributed-health knobs — all off by default,
@@ -356,10 +391,17 @@ class ResilienceConfig(ConfigModel):
     # abort after this many CONSECUTIVE overflow-skipped steps (0 = off;
     # enabling costs one scalar device sync per step)
     max_consecutive_skips: int = 0
+    # N > 0: fold the fused inf/nan gradient sweep into bf16/fp32 steps
+    # too (fp16 loss-scaling always has it) — non-finite steps are
+    # SKIPPED and N consecutive ones raise GradientAnomalyError instead
+    # of silently training on NaNs.  Costs one scalar sync per step.
+    check_grad_finite: int = 0
     # verify manifest byte-lengths + crc32 checksums at load; corrupt tags
     # quarantine to <tag>.corrupt and load falls back to the newest
     # verified tag
     verify_on_load: bool = True
+    # silent-data-corruption defense for the NVMe moment stream
+    sdc: SdcConfig = Field(default_factory=SdcConfig)
     # distributed-health knobs (collective watchdog, desync detection,
     # straggler telemetry)
     comm: CommResilienceConfig = Field(default_factory=CommResilienceConfig)
@@ -370,6 +412,8 @@ class ResilienceConfig(ConfigModel):
             raise ValueError("resilience.max_restarts must be >= 0")
         if self.keep_last_k < 0:
             raise ValueError("resilience.keep_last_k must be >= 0")
+        if self.check_grad_finite < 0:
+            raise ValueError("resilience.check_grad_finite must be >= 0")
         return self
 
 

@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, Optional, Sequence
 import jax
 
 from deepspeed_tpu.resilience.distributed import CollectiveTimeout
+from deepspeed_tpu.resilience.guards import SwapCorruptionError
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -190,7 +191,7 @@ class DSElasticAgent:
             try:
                 engine, cfg = self._make_engine(devices)
             except (PreemptionError, jax.errors.JaxRuntimeError,
-                    CollectiveTimeout) as e:
+                    CollectiveTimeout, SwapCorruptionError) as e:
                 # losing the slice DURING rebuild/resume is the likeliest
                 # failure on a degraded pod — it must consume a restart,
                 # not crash the supervisor
@@ -229,13 +230,16 @@ class DSElasticAgent:
                 logger.warning(
                     f"elastic agent: restart {self.restarts}/"
                     f"{self.max_restarts} ({e})")
-            except (jax.errors.JaxRuntimeError, CollectiveTimeout) as e:
-                # hard failure: a dead chip's runtime error, or a
+            except (jax.errors.JaxRuntimeError, CollectiveTimeout,
+                    SwapCorruptionError) as e:
+                # hard failure: a dead chip's runtime error, a
                 # collective watchdog timeout (peer rank gone / wedged
-                # transport — the engine already attempted an emergency
-                # checkpoint).  Resume from the last periodic save
-                # (load_checkpoint verifies and falls back to the newest
-                # VERIFIED tag if the last save was torn)
+                # transport), or persistent silent data corruption in
+                # the NVMe swap path (file quarantined; the engine
+                # already attempted an emergency checkpoint).  Resume
+                # from the last periodic save (load_checkpoint verifies
+                # and falls back to the newest VERIFIED tag if the last
+                # save was torn)
                 last_err = e
                 self.restarts += 1
                 logger.warning(

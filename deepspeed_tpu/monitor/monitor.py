@@ -159,6 +159,15 @@ class MonitorMaster(Monitor):
             if m.enabled:
                 m.write_events(events)
 
+    def write_sdc_health(self, sdc_counters: dict, step: int) -> None:
+        """Surface the swap path's silent-data-corruption counters
+        (``NvmeOptimizerSwapper.sdc_counters`` — cumulative detection /
+        re-read-recovery / quarantine totals).  A host with flaky
+        DRAM/NVMe shows up as a climbing ``Sdc/mismatches`` series long
+        before it would have surfaced as unexplained loss drift."""
+        self.write_events([(f"Sdc/{name}", float(value), step)
+                           for name, value in sorted(sdc_counters.items())])
+
     def write_comm_health(self, straggler_report: dict, step: int) -> None:
         """Surface the cross-rank straggler report
         (``comm.straggler_report()``) as metric events: per-op latency

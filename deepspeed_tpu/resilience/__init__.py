@@ -19,14 +19,19 @@ from deepspeed_tpu.resilience.distributed import (CollectiveTimeout,
                                                   install_injector_from_env,
                                                   tree_checksum)
 from deepspeed_tpu.resilience.faults import (FaultInjector, SimulatedCrash,
+                                             flip_bit_in_file,
                                              torn_write_file)
 from deepspeed_tpu.resilience.guards import (GradientAnomalyError,
-                                             SkippedStepGuard)
+                                             SkippedStepGuard,
+                                             SwapCorruptionError)
 from deepspeed_tpu.resilience.retry import (backoff_delays,
                                             call_with_retries, retriable)
+from deepspeed_tpu.resilience.sdc import CHECKSUM_ALGOS
 
 __all__ = ["FaultInjector", "SimulatedCrash", "torn_write_file",
+           "flip_bit_in_file",
            "GradientAnomalyError", "SkippedStepGuard",
+           "SwapCorruptionError", "CHECKSUM_ALGOS",
            "backoff_delays", "call_with_retries", "retriable",
            "CollectiveTimeout", "DesyncDetector", "build_straggler_report",
            "install_injector_from_env", "tree_checksum"]

@@ -20,6 +20,12 @@ Instrumented sites (the stable surface; grep for ``faults.hook``):
 ``swap.write_item``       before each NVMe moment-file write
 ``swap.write_bucket``     before each pipelined bucket write-back submit
                           (async submit AND its blocking retry path)
+``swap.read_bucket``      after each pipelined bucket read completes,
+                          before its checksum verification (fires again
+                          per blocking re-read — transient vs persistent
+                          corruption is modeled by ``count``)
+``swap.read_item``        after each leafwise moment-shard read joins,
+                          before verification (and per re-read)
 ``comm.all_reduce``       once per EAGER all_reduce call (comm/comm.py)
 ``comm.all_gather``       once per eager all_gather call
 ``comm.broadcast``        once per eager broadcast call
@@ -43,6 +49,13 @@ Fault kinds:
               collective (a slow rank; peers stall waiting for it)
 ``drop``      comm sites: skip the collective entirely on this rank,
               so peers hang in it (the collective-watchdog's quarry)
+``bitflip``   swap read sites: flip ``param`` random bit(s) of the
+              just-read buffer (silent host-buffer/DMA/media
+              corruption — the SDC verifier's quarry).  Positions come
+              from the injector's seeded rng; with ``count=1`` the
+              corruption is transient (the re-read heals), a large
+              ``count`` or :func:`flip_bit_in_file` models persistent
+              on-media corruption
 
 A fault is scheduled with ``inject(site, kind, ...)`` (or the named
 helpers); ``after`` skips that many firings first and ``count`` bounds
@@ -59,7 +72,7 @@ import signal as _signal
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["FaultInjector", "SimulatedCrash", "hook", "active",
-           "torn_write_file"]
+           "torn_write_file", "apply_bitflip", "flip_bit_in_file"]
 
 
 class SimulatedCrash(BaseException):
@@ -97,7 +110,7 @@ class FaultInjector:
     # -- scheduling -------------------------------------------------------
 
     KINDS = ("oserror", "torn", "crash", "sigterm",
-             "corrupt", "straggle", "drop")
+             "corrupt", "straggle", "drop", "bitflip")
 
     def inject(self, site: str, kind: str, count: int = 1, after: int = 0,
                fraction: float = 0.5,
@@ -147,6 +160,16 @@ class FaultInjector:
         """Skip the collective on this rank; peers hang in it until a
         watchdog deadline fires."""
         return self.inject(site, "drop", count=count, after=after)
+
+    def bitflip(self, site: str, bits: int = 1, after: int = 0,
+                count: int = 1) -> "FaultInjector":
+        """Flip ``bits`` random bit(s) of the buffer a swap read site
+        just filled (silent data corruption between the disk and the
+        optimizer update).  ``count=1`` models a transient flip (a
+        re-read returns clean bytes); a large ``count`` corrupts every
+        re-read too — the quarantine path's quarry."""
+        return self.inject(site, "bitflip", count=count, after=after,
+                           param=bits)
 
     @classmethod
     def from_spec(cls, spec: str, seed: int = 0) -> "FaultInjector":
@@ -200,7 +223,8 @@ class FaultInjector:
                 return None
             # directive kinds the site must honor: torn (fraction of
             # bytes kept), corrupt (fraction of payload), straggle
-            # (delay seconds), drop (skip the op)
+            # (delay seconds), drop (skip the op), bitflip (bits to
+            # flip in the just-read buffer)
             return (f.kind, f.param)
         return None
 
@@ -229,10 +253,26 @@ def hook(site: str, **ctx: Any) -> Optional[Tuple[str, float]]:
     common disarmed case), raises an injected failure, or returns a
     ``(kind, param)`` directive the site must honor — ``("torn",
     fraction)`` for write sites; ``("corrupt", fraction)``,
-    ``("straggle", delay_s)`` or ``("drop", 0)`` for comm sites."""
+    ``("straggle", delay_s)`` or ``("drop", 0)`` for comm sites;
+    ``("bitflip", bits)`` for swap read sites (honored via
+    :func:`apply_bitflip`)."""
     if _ACTIVE is None:
         return None
     return _ACTIVE.fire(site, **ctx)
+
+
+def apply_bitflip(buf, nbits: float) -> None:
+    """Honor a ``("bitflip", nbits)`` directive: flip ``nbits`` random
+    bit(s) of ``buf`` (a contiguous numpy array) in place, positions
+    drawn from the active injector's seeded rng — the corruption is
+    reproducible from the injector seed alone."""
+    import numpy as np
+
+    rng = _ACTIVE.rng if _ACTIVE is not None else random.Random(0)
+    view = buf.reshape(-1).view(np.uint8)
+    for _ in range(max(1, int(nbits))):
+        i = rng.randrange(view.size)
+        view[i] ^= np.uint8(1 << rng.randrange(8))
 
 
 def torn_write_file(path: str, fraction: float = 0.5) -> int:
@@ -244,3 +284,25 @@ def torn_write_file(path: str, fraction: float = 0.5) -> int:
     with open(path, "rb+") as f:
         f.truncate(size)
     return size
+
+
+def flip_bit_in_file(path: str, bit: Optional[int] = None,
+                     seed: int = 0) -> int:
+    """Flip one bit of ``path`` in place — PERSISTENT on-media silent
+    corruption (every re-read returns the same flipped bit, unlike the
+    transient ``bitflip`` hook kind).  ``bit`` is the absolute bit
+    index; ``None`` picks one from ``seed``.  Returns the flipped bit
+    index.  Used by ``scripts/chaos_train.py --sdc`` against live swap
+    files."""
+    import os
+
+    nbits = os.path.getsize(path) * 8
+    assert nbits > 0, f"cannot flip a bit in empty file {path}"
+    if bit is None:
+        bit = random.Random(seed).randrange(nbits)
+    with open(path, "rb+") as f:
+        f.seek(bit // 8)
+        byte = f.read(1)[0]
+        f.seek(bit // 8)
+        f.write(bytes([byte ^ (1 << (bit % 8))]))
+    return bit

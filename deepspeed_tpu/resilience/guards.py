@@ -13,6 +13,17 @@ class GradientAnomalyError(RuntimeError):
     gradients — the run is spinning the loss scaler, not learning."""
 
 
+class SwapCorruptionError(RuntimeError):
+    """Silent data corruption detected in the NVMe offload hot path:
+    a swapped moment buffer failed checksum verification and the
+    blocking re-read retries could not produce clean bytes (the
+    corruption is on the media, not transient host-buffer/DMA noise).
+    The offending swap file is quarantined before this raises; the
+    engine routes it through the preemption/emergency-checkpoint path
+    so the elastic agent restarts from the last verified checkpoint
+    instead of training on garbage."""
+
+
 class SkippedStepGuard:
     """Counts CONSECUTIVE overflow-skipped steps and aborts past a bound.
 

@@ -9,7 +9,7 @@ dataset yielding numpy arrays, dicts of arrays, or tuples.
 """
 from __future__ import annotations
 
-from typing import Any, Iterator, Optional
+from typing import Any, Dict, Iterator, Optional
 
 import numpy as np
 
@@ -18,7 +18,9 @@ import jax
 
 class RepeatingLoader:
     """Wraps an iterator to restart on StopIteration (reference
-    ``RepeatingLoader``)."""
+    ``RepeatingLoader``).  Resumable when the wrapped loader is: the
+    state calls delegate, and restoring re-creates the live iterator so
+    the stream continues from the restored cursor."""
 
     def __init__(self, loader):
         self.loader = loader
@@ -33,6 +35,14 @@ class RepeatingLoader:
         except StopIteration:
             self.data_iter = iter(self.loader)
             return next(self.data_iter)
+
+    def state_dict(self) -> Optional[Dict[str, Any]]:
+        sd = getattr(self.loader, "state_dict", None)
+        return sd() if callable(sd) else None
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.loader.load_state_dict(state)
+        self.data_iter = iter(self.loader)
 
 
 def _stack(samples):
@@ -49,7 +59,16 @@ class DeepSpeedDataLoader:
     """Batches an indexable dataset into global batches of
     ``batch_size`` samples, optionally shuffled per epoch with a seeded RNG
     (deterministic across hosts — the TPU analogue of the reference's
-    DistributedSampler consistency check, engine.py:434)."""
+    DistributedSampler consistency check, engine.py:434).
+
+    Resumable: the loader tracks ``(seed, epoch, cursor)`` — the
+    in-epoch batch position — through :meth:`state_dict` /
+    :meth:`load_state_dict`, and the engine persists it in the
+    checkpoint's extra payload.  A restart therefore CONTINUES
+    mid-epoch from the next unseen batch instead of replaying (double-
+    training) or skipping (never seeing) the interrupted epoch's data;
+    the shuffle permutation is a pure function of ``seed + epoch``, so
+    the resumed sequence is identical to the uninterrupted one."""
 
     def __init__(self, dataset, batch_size: int, shuffle: bool = True,
                  seed: int = 1234, drop_last: bool = True,
@@ -60,7 +79,8 @@ class DeepSpeedDataLoader:
         self.seed = seed
         self.drop_last = drop_last
         self.collate_fn = collate_fn or _stack
-        self.epoch = 0
+        self.epoch = 0              # epoch the NEXT batch comes from
+        self.cursor = 0             # batches already served this epoch
         if not hasattr(dataset, "__len__") or not hasattr(dataset, "__getitem__"):
             raise TypeError("DeepSpeedDataLoader needs an indexable dataset; "
                             "wrap pure iterators with RepeatingLoader instead")
@@ -77,11 +97,28 @@ class DeepSpeedDataLoader:
         if self.shuffle:
             rng = np.random.default_rng(self.seed + self.epoch)
             rng.shuffle(idx)
-        self.epoch += 1
         stop = (n // self.batch_size) * self.batch_size if self.drop_last else n
-        for start in range(0, stop, self.batch_size):
+        starts = range(0, stop, self.batch_size)
+        for bi, start in enumerate(starts):
+            if bi < self.cursor:
+                continue            # resume mid-epoch: skip served batches
             sel = idx[start:start + self.batch_size]
-            yield self.collate_fn([self.dataset[int(i)] for i in sel])
+            batch = self.collate_fn([self.dataset[int(i)] for i in sel])
+            self.cursor = bi + 1
+            yield batch
+        self.epoch += 1
+        self.cursor = 0
+
+    # -- resumable state -------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"seed": int(self.seed), "epoch": int(self.epoch),
+                "cursor": int(self.cursor)}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.seed = int(state["seed"])
+        self.epoch = int(state["epoch"])
+        self.cursor = int(state["cursor"])
 
 
 def shard_batch(batch, sharding) -> Any:

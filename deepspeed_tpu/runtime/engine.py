@@ -30,6 +30,7 @@ import deepspeed_tpu.comm as dist
 from deepspeed_tpu.config import DeepSpeedConfig, load_config
 from deepspeed_tpu.parallel.topology import MeshTopology
 from deepspeed_tpu.resilience.distributed import CollectiveTimeout
+from deepspeed_tpu.resilience.guards import SwapCorruptionError
 from deepspeed_tpu.runtime import precision as prec
 from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
                                               RepeatingLoader, shard_batch)
@@ -384,7 +385,10 @@ class DeepSpeedEngine:
                 aio_use_odirect=config.aio.use_odirect,
                 pipeline_read=offl_o.pipeline_read,
                 pipeline_write=offl_o.pipeline_write,
-                buffer_count=offl_o.buffer_count)
+                buffer_count=offl_o.buffer_count,
+                sdc_verify=config.resilience.sdc.verify_on_read,
+                sdc_checksum=config.resilience.sdc.checksum,
+                sdc_max_reread=config.resilience.sdc.max_reread_retries)
             opt_state, opt_shardings, opt_specs = (), (), None
         elif want_opt_stream:
             from deepspeed_tpu.runtime.swap_tensor import HostMomentSwapper
@@ -510,14 +514,21 @@ class DeepSpeedEngine:
 
         # -- resilience guards (resilience/guards.py) ---------------------
         self._skip_guard = None
-        if config.resilience.max_consecutive_skips > 0:
+        # check_grad_finite extends the consecutive-skip abort to
+        # bf16/fp32 runs (their non-finite sweep is built into the
+        # train step when the knob is on); when both knobs are set the
+        # tighter bound wins
+        _guard_bounds = [b for b in (
+            config.resilience.max_consecutive_skips,
+            config.resilience.check_grad_finite) if b > 0]
+        if _guard_bounds:
             from deepspeed_tpu.resilience import SkippedStepGuard
 
-            self._skip_guard = SkippedStepGuard(
-                config.resilience.max_consecutive_skips)
+            self._skip_guard = SkippedStepGuard(min(_guard_bounds))
         self._preemption_prev_handlers = None
         self._preemption_save_dir = None
         self.preempted = False
+        self.swap_corrupted = False
         # -- distributed health (resilience/distributed.py) ---------------
         self.comm_timed_out = False
         self._desync = None
@@ -836,8 +847,11 @@ class DeepSpeedEngine:
 
         # overflow scanning exists for fp16 loss-scaling; bf16/fp32 training
         # never skips steps (reference bf16_optimizer has no overflow path),
-        # so skip the full-gradient inf/nan sweep there
-        check_overflow = self.config.fp16.enabled
+        # so skip the full-gradient inf/nan sweep there — unless
+        # resilience.check_grad_finite folds it in (non-finite bf16/fp32
+        # steps then skip, and N consecutive ones abort via the guard)
+        check_overflow = (self.config.fp16.enabled
+                          or self.config.resilience.check_grad_finite > 0)
 
         def train_step(state: TrainState, batch, lr):
             rng, new_rng = jax.random.split(state.rng)
@@ -1218,9 +1232,10 @@ class DeepSpeedEngine:
             stats = getattr(self.nvme_swapper, "stage_stats", None)
             if stats and self.config.wall_clock_breakdown:
                 # per-stage swap waits join the breakdown timer group —
-                # link-boundedness is measurable, not asserted
+                # link-boundedness (and the SDC verify residual) is
+                # measurable, not asserted
                 for name in ("swap_in_wait", "bucket_update",
-                             "swap_out_wait"):
+                             "swap_out_wait", "swap_verify"):
                     if stats.get(f"{name}_s") is not None:
                         self.timers(name).record(stats[f"{name}_s"])
         rng, new_rng = jax.random.split(rng)
@@ -1346,6 +1361,10 @@ class DeepSpeedEngine:
             if breakdown:
                 self.timers(STEP_GLOBAL_TIMER).discard()
             self._handle_collective_timeout(e)    # re-raises
+        except SwapCorruptionError as e:
+            if breakdown:
+                self.timers(STEP_GLOBAL_TIMER).discard()
+            self._handle_swap_corruption(e)       # re-raises
         except Exception:
             if breakdown:
                 self.timers(STEP_GLOBAL_TIMER).discard()
@@ -1392,7 +1411,7 @@ class DeepSpeedEngine:
                 # swap pipeline's stage waits when a swapped tier is live)
                 names = ["batch_prep", STEP_GLOBAL_TIMER]
                 names += [n for n in ("swap_in_wait", "bucket_update",
-                                      "swap_out_wait")
+                                      "swap_out_wait", "swap_verify")
                           if self.timers.has_timer(n)]
                 self.timers.log(names,
                                 normalizer=self.config.steps_per_print)
@@ -1402,6 +1421,13 @@ class DeepSpeedEngine:
                 # rank every eager collective waits for
                 self.monitor.write_comm_health(dist.straggler_report(),
                                                self.global_samples)
+            sdc = getattr(self.nvme_swapper, "sdc_counters", None)
+            if (sdc is not None and self.monitor is not None
+                    and self.monitor.enabled):
+                # SDC detection/recovery counters stream alongside the
+                # loss: a fleet host with flaky DRAM/storage shows up
+                # as a climbing mismatch series, not a silent loss drift
+                self.monitor.write_sdc_health(sdc, self.global_samples)
         if self.monitor is not None and self.monitor.enabled:
             m = jax.device_get(metrics)
             self.monitor.write_events([
@@ -1729,6 +1755,27 @@ class DeepSpeedEngine:
             except BaseException as ce:
                 logger.error(f"emergency checkpoint failed under comm "
                              f"timeout ({ce!r}); aborting without it")
+        raise e
+
+    def _handle_swap_corruption(self, e: SwapCorruptionError) -> None:
+        """Route persistent silent data corruption in the swap path
+        through the preemption machinery: the corrupt swap file is
+        already quarantined and the swap state invalidated, so the
+        right move is a last-gasp checkpoint (params are intact — the
+        corruption was caught BEFORE the update consumed it) and a
+        clean abort; the elastic agent then restarts from the newest
+        verified checkpoint instead of training on garbage."""
+        self.swap_corrupted = True
+        logger.error(f"silent data corruption in the NVMe swap path: {e}")
+        save_dir = self._preemption_save_dir
+        if save_dir:
+            try:
+                path = self.emergency_checkpoint(save_dir)
+                logger.error(f"emergency checkpoint committed at {path}; "
+                             "aborting for elastic restart")
+            except BaseException as ce:
+                logger.error(f"emergency checkpoint failed under swap "
+                             f"corruption ({ce!r}); aborting without it")
         raise e
 
     def install_preemption_handler(self, save_dir: str, signals=None,

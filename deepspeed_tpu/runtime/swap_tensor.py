@@ -34,6 +34,24 @@ bandwidth-bound.  Multi-process jobs fall back to the leafwise stream,
 where each rank swaps only its own addressable shards.  HBM and host
 RAM hold O(buffer_count * bucket), not O(model).
 
+Every byte the stream reads back is VERIFIED before it reaches the
+optimizer update (silent-data-corruption defense; ``resilience.sdc``
+config block): the write pipeline digests each bucket (and each
+leafwise shard) on a side thread as the write is in flight, stores the
+digest in the swapper metadata, and re-checks it on swap-in — the
+read-side digests are likewise computed under the read-ahead window so
+verification rides the existing latency hiding rather than extending
+the critical path (``swap_verify_s`` in ``stage_stats`` is the
+measured residual).  A mismatch escalates through a tiered recovery:
+(1) blocking re-read with jittered backoff (transient host-buffer/DMA
+corruption heals, bit-identically to an uninjected run), then (2) the
+swap file is quarantined (``*.quarantine``) and
+:class:`~deepspeed_tpu.resilience.guards.SwapCorruptionError` raises
+through the engine's preemption/emergency-checkpoint path so the
+elastic layer restarts from the last verified checkpoint instead of
+training on garbage.  ``faults.hook`` sites ``swap.read_bucket`` /
+``swap.read_item`` (kind ``bitflip``) drive the chaos and unit tests.
+
 The optimizer math is the Adam/AdamW family only (the reference swapper
 equally assumes a ``DeepSpeedCPUAdam``-style optimizer whose state is
 two moments per parameter); the engine falls back to device-resident
@@ -53,6 +71,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.resilience.guards import SwapCorruptionError
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -66,14 +85,22 @@ def _pid_alive(pid: int) -> bool:
     return True
 
 
+_SWAP_DIR_SEQ = iter(range(1 << 62))
+
+
 def _swap_dir_name() -> str:
     # host+pid scoped: the liveness probe in _prune_stale_swap_dirs is
     # os.kill, which only means anything for OUR host's pids — on a mount
     # shared across hosts, a bare-pid name would let host B rmtree host A's
-    # live swap dir just because A's pid happens to be unused on B
+    # live swap dir just because A's pid happens to be unused on B.
+    # The per-process sequence number keeps MULTIPLE swappers in one
+    # process (e.g. an engine resumed next to its predecessor) from
+    # aliasing each other's moment files — the SDC verifier caught two
+    # engines silently stomping a shared dir's files exactly this way.
     import socket
 
-    return f"zero_stage_nvme_opt.{socket.gethostname()}.{os.getpid()}"
+    return (f"zero_stage_nvme_opt.{socket.gethostname()}.{os.getpid()}"
+            f".{next(_SWAP_DIR_SEQ)}")
 
 
 def _prune_stale_swap_dirs(root: str) -> None:
@@ -90,7 +117,9 @@ def _prune_stale_swap_dirs(root: str) -> None:
     except OSError:
         return
     for name in entries:
-        m = re.fullmatch(rf"zero_stage_nvme_opt\.{host}\.(\d+)", name)
+        # with or without the per-process sequence suffix (older dirs)
+        m = re.fullmatch(rf"zero_stage_nvme_opt\.{host}\.(\d+)(?:\.\d+)?",
+                         name)
         if not m or _pid_alive(int(m.group(1))):
             continue
         path = os.path.join(root, name)
@@ -451,7 +480,10 @@ class NvmeOptimizerSwapper:
                  bucket_bytes: int = 2 << 30,
                  pipeline_read: bool = True,
                  pipeline_write: bool = True,
-                 buffer_count: int = 3):
+                 buffer_count: int = 3,
+                 sdc_verify: bool = True,
+                 sdc_checksum: str = "sum64",
+                 sdc_max_reread: int = 2):
         from deepspeed_tpu.io.aio import aio_handle
 
         # pid-scoped: two jobs pointing at the same NVMe mount must not
@@ -503,6 +535,24 @@ class NvmeOptimizerSwapper:
         self._use_odirect = bool(aio_use_odirect)
         self._prefetched: Optional[dict] = None
         self._deferred_writes: list = []    # (op, arr, kb) past-apply()
+        # -- silent-data-corruption defense (resilience.sdc): every
+        # bucket/shard the stream writes is digested (on a side thread,
+        # overlapped with the in-flight IO) and re-checked on swap-in
+        # BEFORE the bytes reach the optimizer update.  Mismatch =>
+        # blocking re-read retry, then quarantine + SwapCorruptionError.
+        self._sdc_verify = bool(sdc_verify)
+        self._sdc_algo = str(sdc_checksum)
+        self._sdc_rereads = max(0, int(sdc_max_reread))
+        self._bucket_sums: Dict[int, tuple] = {}   # kb -> (digest, nbytes)
+        # (key, tag) -> ((m_digest, m_nbytes), (v_digest, v_nbytes))
+        self._item_sums: Dict[tuple, tuple] = {}
+        self._sum_futs: Dict[tuple, Any] = {}      # in-flight digest jobs
+        self._sum_pool = None                      # lazy ThreadPoolExecutor
+        # cumulative detection/recovery telemetry (surfaced through
+        # stage_stats and MonitorMaster.write_sdc_health)
+        self.sdc_counters: Dict[str, int] = {
+            "verified": 0, "mismatches": 0, "rereads": 0,
+            "reread_recovered": 0, "quarantined": 0, "restore_rejected": 0}
         # per-apply stage telemetry (see _apply_bucketed); engine surfaces
         # it under wall_clock_breakdown and the bench infinity row
         self.stage_stats: Dict[str, Any] = {}
@@ -511,6 +561,7 @@ class NvmeOptimizerSwapper:
         # reports read/write rates — the multi-process bench row)
         self._io_read_bytes = 0
         self._io_write_bytes = 0
+        self._verify_wait_s = 0.0           # leafwise verify residual
         # (leaf key, shard index tag) pairs with moments on disk — THIS
         # process's shards only; other processes track their own
         self._initialized: set = set()
@@ -562,6 +613,219 @@ class NvmeOptimizerSwapper:
                  f"{self.swap_dir}; this process swaps its addressable "
                  "shards", ranks=[0])
 
+    # -- silent-data-corruption defense ----------------------------------
+
+    # below this, a thread-pool round trip costs more than the digest
+    # itself (sum64 runs ~9 GB/s) — small buffers digest inline
+    _SDC_DEFER_MIN = 4 << 20
+
+    def _pool(self):
+        """Digest worker (lazy): numpy/zlib checksums release the GIL,
+        so write-side digests genuinely overlap the in-flight IO and
+        the device compute instead of extending the stream's wall."""
+        if self._sum_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._sum_pool = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="dstpu-sdc")
+        return self._sum_pool
+
+    def _digest(self, arr) -> tuple:
+        from deepspeed_tpu.resilience.sdc import digest
+
+        return digest(arr, self._sdc_algo)
+
+    def _note_bucket_sum(self, kb: int, arr, defer: bool = True) -> None:
+        """Record bucket ``kb``'s write-side digest.  ``defer``: compute
+        on the side pool (the submitted buffer is immutable until the
+        write is reaped, so the job races nothing)."""
+        if not self._sdc_verify:
+            return
+        # the bucket's bytes changed: any per-item digests recorded by
+        # an earlier spill/restore are stale now
+        for it in self._buckets[kb]["items"]:
+            self._item_sums.pop((it["key"], it["tag"]), None)
+            self._sum_futs.pop(("i", it["key"], it["tag"]), None)
+        if defer and arr.nbytes >= self._SDC_DEFER_MIN:
+            self._sum_futs[("b", kb)] = self._pool().submit(
+                self._digest, arr)
+        else:
+            self._bucket_sums[kb] = self._digest(arr)
+
+    def _note_item_sums(self, key: str, tag: str, m, v,
+                        defer: bool = True) -> None:
+        """Record one item/shard's write-side ``(m, v)`` digests."""
+        if not self._sdc_verify:
+            return
+        if defer and m.nbytes + v.nbytes >= self._SDC_DEFER_MIN:
+            self._sum_futs[("i", key, tag)] = self._pool().submit(
+                lambda: (self._digest(m), self._digest(v)))
+        else:
+            self._item_sums[(key, tag)] = (self._digest(m),
+                                           self._digest(v))
+
+    def _settle_sums(self) -> None:
+        """Fold finished side-thread digest jobs into the metadata maps
+        (save/spill/restore paths need the full picture; the per-read
+        verify gates use the SELECTIVE lookups below instead, so they
+        never block on digests of unrelated in-flight writes)."""
+        futs, self._sum_futs = self._sum_futs, {}
+        for k, fut in futs.items():
+            d = fut.result()
+            if k[0] == "b":
+                self._bucket_sums[k[1]] = d
+            else:
+                self._item_sums[(k[1], k[2])] = d
+
+    def _expected_bucket_sum(self, kb: int) -> Optional[tuple]:
+        fut = self._sum_futs.pop(("b", kb), None)
+        if fut is not None:
+            self._bucket_sums[kb] = fut.result()
+        return self._bucket_sums.get(kb)
+
+    def _expected_item_sums(self, key: str, tag: str) -> Optional[tuple]:
+        fut = self._sum_futs.pop(("i", key, tag), None)
+        if fut is not None:
+            self._item_sums[(key, tag)] = fut.result()
+        return self._item_sums.get((key, tag))
+
+    def _sdc_clear(self) -> None:
+        """Invalidation hook: a cleared swap state has no bytes left to
+        verify (runs alongside ``_initialized/_bucket_ready`` clears)."""
+        self._bucket_sums.clear()
+        self._item_sums.clear()
+        self._sum_futs.clear()
+
+    def _quarantine_file(self, fname: str) -> str:
+        """Move a checksum-failing swap file aside (never delete — the
+        corrupt bytes matter for postmortem, exactly like the
+        checkpoint layer's ``<tag>.corrupt`` quarantine)."""
+        self.sdc_counters["quarantined"] += 1
+        dst = fname + ".quarantine"
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = f"{fname}.quarantine.{n}"
+        try:
+            os.rename(fname, dst)
+        except OSError:
+            dst = fname                     # already gone; nothing to keep
+        logger.error(f"NVMe swap: QUARANTINED corrupt swap file "
+                     f"{os.path.basename(fname)} -> "
+                     f"{os.path.basename(dst)}")
+        return dst
+
+    def _verify_bucket_view(self, kb: int, view: np.ndarray,
+                            got: Optional[tuple] = None) -> None:
+        """Check a just-read bucket against its stored digest; on
+        mismatch escalate: (1) blocking re-reads with jittered backoff
+        (transient host-buffer/DMA corruption heals here), (2)
+        quarantine + :class:`SwapCorruptionError` (persistent on-media
+        corruption — the engine aborts to the last verified
+        checkpoint).  No-op when verification is off or the bucket has
+        no recorded digest (nothing trustworthy to compare against)."""
+        if not self._sdc_verify:
+            return
+        expect = self._expected_bucket_sum(kb)
+        if expect is None:
+            return
+        if (got or self._digest(view)) == expect:
+            self.sdc_counters["verified"] += 1
+            return
+        self.sdc_counters["mismatches"] += 1
+        fname = self._bucket_fname(kb)
+        logger.error(
+            f"NVMe swap: checksum MISMATCH on bucket {kb} swap-in "
+            f"({os.path.basename(fname)}); re-reading "
+            f"(max {self._sdc_rereads} retries)")
+        from deepspeed_tpu.resilience import faults
+        from deepspeed_tpu.resilience.retry import retriable
+
+        @retriable(attempts=self._sdc_rereads + 1,
+                   retry_on=(SwapCorruptionError,))
+        def _reread():
+            self.sdc_counters["rereads"] += 1
+            action = faults.hook("swap.read_bucket", path=fname)
+            self.handle.sync_pread(view, fname)
+            if action is not None and action[0] == "bitflip":
+                faults.apply_bitflip(view, action[1])
+            if self._digest(view) != expect:
+                raise SwapCorruptionError(
+                    f"bucket {kb} ({os.path.basename(fname)}) failed "
+                    f"checksum verification (algo={self._sdc_algo})")
+
+        try:
+            _reread()
+        except SwapCorruptionError:
+            self._quarantine_file(fname)
+            self._bucket_ready.discard(kb)
+            self._bucket_sums.pop(kb, None)
+            raise
+        self.sdc_counters["reread_recovered"] += 1
+        logger.warning(f"NVMe swap: bucket {kb} re-read clean — "
+                       "transient corruption recovered")
+
+    def _read_bucket_verified(self, kb: int, data: np.ndarray) -> None:
+        """Blocking bucket read + verification — the non-pipelined read
+        path (spill to item files, checkpoint save) shares the hot
+        path's detection story: corrupt moments must not propagate into
+        item files or checkpoints either."""
+        from deepspeed_tpu.resilience import faults
+
+        fname = self._bucket_fname(kb)
+        action = faults.hook("swap.read_bucket", path=fname)
+        self.handle.sync_pread(data, fname)
+        if action is not None and action[0] == "bitflip":
+            faults.apply_bitflip(data, action[1])
+        self._verify_bucket_view(kb, data)
+
+    def _verify_item_read(self, key: str, tag: str, m: np.ndarray,
+                          v: np.ndarray, src: tuple) -> None:
+        """Leafwise counterpart of :meth:`_verify_bucket_view` for one
+        shard's ``(m, v)`` pair; ``src = (fname, off_m, off_v)`` names
+        the re-read source."""
+        if not self._sdc_verify:
+            return
+        expect = self._expected_item_sums(key, tag)
+        if expect is None:
+            return
+        if (self._digest(m), self._digest(v)) == expect:
+            self.sdc_counters["verified"] += 1
+            return
+        self.sdc_counters["mismatches"] += 1
+        fname, off_m, off_v = src
+        logger.error(
+            f"NVMe swap: checksum MISMATCH on moment shard {key!r} "
+            f"swap-in ({os.path.basename(fname)}); re-reading")
+        from deepspeed_tpu.resilience import faults
+        from deepspeed_tpu.resilience.retry import retriable
+
+        @retriable(attempts=self._sdc_rereads + 1,
+                   retry_on=(SwapCorruptionError,))
+        def _reread():
+            self.sdc_counters["rereads"] += 1
+            action = faults.hook("swap.read_item", path=fname, key=key)
+            self.handle.sync_pread(m, fname, off_m)
+            self.handle.sync_pread(v, fname, off_v)
+            if action is not None and action[0] == "bitflip":
+                faults.apply_bitflip(m, action[1])
+            if (self._digest(m), self._digest(v)) != expect:
+                raise SwapCorruptionError(
+                    f"moment shard {key!r} ({os.path.basename(fname)}) "
+                    f"failed checksum verification "
+                    f"(algo={self._sdc_algo})")
+
+        try:
+            _reread()
+        except SwapCorruptionError:
+            self._quarantine_file(fname)
+            self._initialized.discard((key, tag))
+            self._item_sums.pop((key, tag), None)
+            raise
+        self.sdc_counters["reread_recovered"] += 1
+        logger.warning(f"NVMe swap: shard {key!r} re-read clean — "
+                       "transient corruption recovered")
+
     # -- per-step IO ----------------------------------------------------
 
     # Moment files are PER ADDRESSABLE SHARD: ``<leaf>.<index-tag>.bin``.
@@ -599,7 +863,7 @@ class NvmeOptimizerSwapper:
                 out[idx] = (
                     self.handle.async_pread(m, fname, 4 * off),
                     self.handle.async_pread(v, fname, 4 * (n_total + off)),
-                    m, v)
+                    m, v, (fname, 4 * off, 4 * (n_total + off)))
                 self._io_read_bytes += m.nbytes + v.nbytes
                 continue
             if (key, tag) not in self._initialized:
@@ -625,7 +889,8 @@ class NvmeOptimizerSwapper:
             v = np.empty(shp, dt)
             fname = self._shard_fname(key, tag)
             out[idx] = (self.handle.async_pread(m, fname, 0),
-                        self.handle.async_pread(v, fname, nbytes), m, v)
+                        self.handle.async_pread(v, fname, nbytes), m, v,
+                        (fname, 0, nbytes))
             self._io_read_bytes += 2 * nbytes
         return out
 
@@ -633,6 +898,8 @@ class NvmeOptimizerSwapper:
         """Join the shard reads and assemble GLOBAL moment arrays with the
         param leaf's sharding (each process contributes its local
         shards)."""
+        from deepspeed_tpu.resilience import faults
+
         dt = self._meta[key][2]
         vals: Dict[tuple, Tuple[np.ndarray, np.ndarray]] = {}
         for idx, st in started.items():
@@ -640,9 +907,18 @@ class NvmeOptimizerSwapper:
                 shp = tuple(b - a for a, b in idx)
                 vals[idx] = (np.zeros(shp, dt), np.zeros(shp, dt))
             else:
-                op_m, op_v, m, v = st
+                import time as _time
+
+                op_m, op_v, m, v, src = st
                 self.handle.wait(op_m)
                 self.handle.wait(op_v)
+                action = faults.hook("swap.read_item", path=src[0],
+                                     key=key)
+                if action is not None and action[0] == "bitflip":
+                    faults.apply_bitflip(m, action[1])
+                t0 = _time.perf_counter()
+                self._verify_item_read(key, _idx_tag(idx), m, v, src)
+                self._verify_wait_s += _time.perf_counter() - t0
                 vals[idx] = (m, v)
         shards = leaf.addressable_shards
         m_parts = [jax.device_put(vals[_norm_index(s.index, leaf.shape)][0],
@@ -675,6 +951,10 @@ class NvmeOptimizerSwapper:
                 m_np, fname, 0, _truncate=False))
             self._pending.append(self.handle.async_pwrite(
                 v_np, fname, m_np.nbytes, _truncate=False))
+            # write-side digest on the side pool — the buffers are
+            # pinned by the write queue until the ops are reaped, so
+            # the job races nothing and rides the in-flight IO
+            self._note_item_sums(key, tag, m_np, v_np)
             self._io_write_bytes += m_np.nbytes + v_np.nbytes
             self._initialized.add((key, tag))
             if self._buckets is not None and key in self._plan_keys:
@@ -715,6 +995,10 @@ class NvmeOptimizerSwapper:
             self.drain()
         except Exception:
             pass
+        if self._sum_pool is not None:
+            self._sum_pool.shutdown(wait=True)
+            self._sum_pool = None
+        self._sum_futs.clear()
         shutil.rmtree(self.swap_dir, ignore_errors=True)
         try:
             atexit.unregister(self._atexit)
@@ -768,13 +1052,19 @@ class NvmeOptimizerSwapper:
                 continue
             b = self._buckets[kb]
             data = np.empty(2 * b["n"], np.float32)
-            self.handle.sync_pread(data, self._bucket_fname(kb))
-            _write_item_files_bulk(
-                self.handle, self.swap_dir,
-                [(it,) + _item_mv(data, it, b["n"]) for it in b["items"]
-                 if (it["key"], it["tag"]) in self._initialized])
+            # verified read: corrupt bucket bytes must not propagate
+            # into item files (detected here, not N steps later)
+            self._read_bucket_verified(kb, data)
+            entries = [(it,) + _item_mv(data, it, b["n"])
+                       for it in b["items"]
+                       if (it["key"], it["tag"]) in self._initialized]
+            _write_item_files_bulk(self.handle, self.swap_dir, entries)
+            for it, m, v in entries:
+                self._note_item_sums(it["key"], it["tag"], m, v,
+                                     defer=False)
             os.remove(self._bucket_fname(kb))
             self._bucket_ready.discard(kb)
+            self._bucket_sums.pop(kb, None)
             self._items_dirty = True
 
     def _bucket_fname(self, kb: int) -> str:
@@ -931,6 +1221,7 @@ class NvmeOptimizerSwapper:
                 "reload the checkpoint to recover real state)")
             self._initialized.clear()
             self._bucket_ready.clear()
+            self._sdc_clear()
             raise err
 
     def _apply_bucketed(self, params: Any, grads: Any, *, lr,
@@ -977,14 +1268,19 @@ class NvmeOptimizerSwapper:
         new_leaves = list(leaves)
         buckets = self._buckets
         nb = len(buckets)
+        from deepspeed_tpu.resilience import faults as _faults
+
         self._ensure_read_bufs()
         pipelined = self._nbuf > 1
-        t_in = t_up = t_out = 0.0
+        t_in = t_up = t_out = t_verify = 0.0
         bytes_read = bytes_written = 0
         t_begin = _time.perf_counter()
 
         pending: Dict[int, Optional[tuple]] = dict(prefetched or {})
         next_issue = (max(pending) + 1) if pending else 0
+        ready: Dict[int, Optional[np.ndarray]] = {}   # harvested views
+        verify_futs: Dict[int, Any] = {}              # kb -> digest future
+        harvest_next = 0
 
         def issue_upto(limit: int) -> None:
             # slot-reuse invariant: bucket j reuses slot j % nbuf, whose
@@ -996,6 +1292,44 @@ class NvmeOptimizerSwapper:
             while next_issue <= min(limit, nb - 1):
                 pending[next_issue] = self._issue_read(next_issue)
                 next_issue += 1
+
+        def harvest(block_upto: int = -1) -> None:
+            # move completed reads, IN BUCKET ORDER, from `pending` to
+            # `ready`: the swap.read_bucket fault site fires and the
+            # read-side digest job is submitted at completion time, so
+            # verification runs on the side pool while later buckets'
+            # IO and earlier buckets' compute are still in flight —
+            # the check rides the read-ahead window, not the critical
+            # path.  Buckets <= block_upto are waited; later ones are
+            # harvested only if their read already completed.
+            nonlocal harvest_next, t_in, bytes_read
+            while harvest_next < nb and harvest_next in pending:
+                kb2 = harvest_next
+                st2 = pending[kb2]
+                if st2 is None:
+                    pending.pop(kb2)
+                    ready[kb2] = None
+                    harvest_next += 1
+                    continue
+                if (kb2 > block_upto
+                        and self.handle.poll(st2[0]) is None):
+                    break
+                t0 = _time.perf_counter()
+                self.handle.wait(st2[0])
+                t_in += _time.perf_counter() - t0
+                pending.pop(kb2)
+                view = st2[1]
+                bytes_read += view.nbytes
+                action = _faults.hook("swap.read_bucket",
+                                      path=self._bucket_fname(kb2))
+                if action is not None and action[0] == "bitflip":
+                    _faults.apply_bitflip(view, action[1])
+                if (self._sdc_verify
+                        and view.nbytes >= self._SDC_DEFER_MIN):
+                    verify_futs[kb2] = self._pool().submit(
+                        self._digest, view)
+                ready[kb2] = view
+                harvest_next += 1
 
         write_q: Any = deque()            # (op, staged array, kb)
 
@@ -1028,6 +1362,10 @@ class NvmeOptimizerSwapper:
                 t0 = _time.perf_counter()
                 self._sync_rewrite_bucket(kb, mv_np)
                 t_out += _time.perf_counter() - t0
+            # write-side digest on the side pool, overlapped with the
+            # write it describes (mv_np is pinned by the write queue
+            # until reaped, so the job races nothing)
+            self._note_bucket_sum(kb, mv_np)
             bytes_written += mv_np.nbytes
             reap(self._write_depth)       # bound in-flight write buffers
             self._bucket_ready.add(kb)
@@ -1047,21 +1385,34 @@ class NvmeOptimizerSwapper:
                         flush(prev_out)
                         prev_out = None
                     issue_upto(kb)
-                st = pending.pop(kb)
-                t0 = _time.perf_counter()
-                if st is None:
+                if kb not in ready:
+                    harvest(block_upto=kb)
+                view = ready.pop(kb)
+                if view is None:
                     mv_in = np.zeros((2, b["n"]), np.float32)
                 else:
-                    self.handle.wait(st[0])
-                    mv_in = st[1].reshape(2, b["n"])
-                    bytes_read += st[1].nbytes
-                t_in += _time.perf_counter() - t0
+                    # swap-in verification gate: the digest job was
+                    # submitted when the read completed (usually done
+                    # by now); mismatch re-reads, then quarantines +
+                    # raises — corrupt bytes never reach the update
+                    t0 = _time.perf_counter()
+                    fut = verify_futs.pop(kb, None)
+                    self._verify_bucket_view(
+                        kb, view, got=fut.result() if fut else None)
+                    t_verify += _time.perf_counter() - t0
+                    mv_in = view.reshape(2, b["n"])
                 ps = [leaves[idx[it["key"]]] for it in b["items"]]
                 gs = [flat_g[idx[it["key"]]] for it in b["items"]]
                 p_news, mv_out = self._bucket_call(b, ps, gs)(
                     ps, gs, mv_in, count, lr, gscale)
                 for it, pn in zip(b["items"], p_news):
                     new_leaves[idx[it["key"]]] = pn
+                # harvest BEFORE the flush below blocks forcing bucket
+                # kb-1's compute: completed read-ahead buckets get their
+                # digest jobs submitted now, so they run on the side
+                # pool UNDER that block and are done when their turn's
+                # verification gate checks them
+                harvest()
                 if pipelined and prev_out is not None:
                     flush(prev_out)       # forces compute kb-1 ...
                     issue_upto(kb - 1 + self._nbuf)   # ... freeing slots
@@ -1099,6 +1450,7 @@ class NvmeOptimizerSwapper:
                 self.count -= 1
                 self._initialized.clear()
                 self._bucket_ready.clear()
+                self._sdc_clear()
             if ok and err is not None:
                 raise err
         total = _time.perf_counter() - t_begin
@@ -1106,6 +1458,10 @@ class NvmeOptimizerSwapper:
             "swap_in_wait_s": round(t_in, 4),
             "bucket_update_s": round(t_up, 4),
             "swap_out_wait_s": round(t_out, 4),
+            # main-thread residual of swap-in verification (the digest
+            # itself runs on the side pool under the read-ahead window;
+            # this is what verification adds to the critical path)
+            "swap_verify_s": round(t_verify, 4),
             "apply_s": round(total, 4),
             # fraction of the stream's wall NOT blocked on NVMe waits —
             # ~1.0 means the disk hides behind compute/transfers (or
@@ -1120,6 +1476,7 @@ class NvmeOptimizerSwapper:
                                   / total / 1e9, 3) if total > 0 else None),
             "buckets": nb,
             "pipelined": pipelined,
+            "sdc": dict(self.sdc_counters),   # cumulative
         }
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(params), new_leaves)
@@ -1141,6 +1498,7 @@ class NvmeOptimizerSwapper:
 
         self.count += 1
         self._io_read_bytes = self._io_write_bytes = 0
+        self._verify_wait_s = 0.0
         t_apply0 = _time.perf_counter()
         count = jnp.asarray(self.count, jnp.float32)
         lr = jnp.asarray(lr, jnp.float32)
@@ -1211,6 +1569,7 @@ class NvmeOptimizerSwapper:
                 self.count -= 1
                 self._initialized.clear()
                 self._bucket_ready.clear()
+                self._sdc_clear()
             if ok and drain_err is not None:
                 raise drain_err
         # per-shard leafwise stream telemetry: every rank reports ITS
@@ -1231,6 +1590,8 @@ class NvmeOptimizerSwapper:
             "stream_gbps": round((self._io_read_bytes
                                   + self._io_write_bytes) / wall / 1e9, 6)
             if wall > 0 else 0.0,
+            "swap_verify_s": round(self._verify_wait_s, 4),
+            "sdc": dict(self.sdc_counters),   # cumulative
         }
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(params), new_leaves)
@@ -1245,6 +1606,11 @@ class NvmeOptimizerSwapper:
         out = os.path.join(ckpt_dir, "nvme_optimizer")
         os.makedirs(out, exist_ok=True)
         self.drain()
+        self._settle_sums()
+        # per-item digests travel with the checkpoint so a restore is
+        # VERIFIED (a flipped bit in a checkpointed moment file is
+        # rejected at load, not trained on): [key, tag, m_dig, v_dig]
+        sums: list = []
         if self._buckets is not None:
             # bucketed store → per-item checkpoint files: the checkpoint
             # format stays topology-independent (a multi-host or leafwise
@@ -1256,7 +1622,9 @@ class NvmeOptimizerSwapper:
                 if kb not in self._bucket_ready:
                     continue
                 data = np.empty(2 * b["n"], np.float32)
-                self.handle.sync_pread(data, self._bucket_fname(kb))
+                # verified read: a corrupt bucket must not become the
+                # "last verified checkpoint" the recovery relies on
+                self._read_bucket_verified(kb, data)
                 entries = []
                 for it in b["items"]:
                     if (it["key"], it["tag"]) not in self._initialized:
@@ -1264,6 +1632,11 @@ class NvmeOptimizerSwapper:
                     covered.add((it["key"], it["tag"]))
                     entries.append((it,) + _item_mv(data, it, b["n"]))
                 _write_item_files_bulk(self.handle, out, entries)
+                if self._sdc_verify:
+                    for it, m, v in entries:
+                        sums.append([it["key"], it["tag"],
+                                     list(self._digest(m)),
+                                     list(self._digest(v))])
             # spilled / foreign-tag items still have their own files
             for key, tag in self._initialized - covered:
                 fname = self._shard_fname(key, tag)
@@ -1271,6 +1644,9 @@ class NvmeOptimizerSwapper:
                     continue
                 dst = os.path.join(out, os.path.basename(fname))
                 _copy_atomic(fname, dst)
+                if (key, tag) in self._item_sums:
+                    dm, dv = self._item_sums[(key, tag)]
+                    sums.append([key, tag, list(dm), list(dv)])
         else:
             for key, tag in self._initialized:
                 fname = self._shard_fname(key, tag)
@@ -1278,18 +1654,25 @@ class NvmeOptimizerSwapper:
                 # replicated leaves carry the same full-extent tag in
                 # every process
                 _copy_atomic(fname, dst)
+                if (key, tag) in self._item_sums:
+                    dm, dv = self._item_sums[(key, tag)]
+                    sums.append([key, tag, list(dm), list(dv)])
         # one meta file per process: each process's shard set is disjoint
         # (multi-host swap — reference rank-local partition semantics)
         meta_name = f"swap_meta.p{jax.process_index()}.json"
         with open(os.path.join(out, meta_name), "w") as f:
             import json
 
-            json.dump({"count": self.count,
-                       "initialized": sorted(list(t)
-                                             for t in self._initialized),
-                       "adam_w_mode": self.adam_w_mode,
-                       "betas": [self.b1, self.b2], "eps": self.eps,
-                       "weight_decay": self.wd}, f)
+            meta = {"count": self.count,
+                    "initialized": sorted(list(t)
+                                          for t in self._initialized),
+                    "adam_w_mode": self.adam_w_mode,
+                    "betas": [self.b1, self.b2], "eps": self.eps,
+                    "weight_decay": self.wd}
+            if sums:
+                meta["checksum_algo"] = self._sdc_algo
+                meta["sums"] = sums
+            json.dump(meta, f)
 
     def _load_legacy(self, src: str, meta_f: str) -> bool:
         """Restore a pre-shard-format checkpoint (``swap_meta.json`` with
@@ -1344,10 +1727,19 @@ class NvmeOptimizerSwapper:
             entries = [(self._shard_fname(it["key"], it["tag"]), it)
                        + _item_mv(data, it, b["n"]) for it in present]
             _read_item_files_bulk(self.handle, entries)
+            for fname, it, m, v in entries:
+                if not os.path.exists(fname):
+                    continue
+                # item files fold into the bucket verified — corrupt
+                # restored/spilled moments escalate here, before they
+                # become bucket-resident "truth"
+                self._verify_item_read(it["key"], it["tag"], m, v,
+                                       (fname, 0, m.nbytes))
             for fname, *_ in entries:
                 if os.path.exists(fname):
                     os.remove(fname)
             self.handle.sync_pwrite(data, self._bucket_fname(kb))
+            self._note_bucket_sum(kb, data, defer=False)
             self._bucket_ready.add(kb)
         if missing:
             logger.warning(
@@ -1384,6 +1776,9 @@ class NvmeOptimizerSwapper:
                 "resuming applies the NEW coefficients to the old moments")
         self.count = int(meta["count"])
         self._initialized = set()
+        ck_algo = meta.get("checksum_algo", self._sdc_algo)
+        ck_sums = {(k, t): ((dm[0], dm[1]), (dv[0], dv[1]))
+                   for k, t, dm, dv in meta.get("sums", [])}
         for entry in meta["initialized"]:
             key, tag = entry
             if key not in self._meta:
@@ -1391,10 +1786,54 @@ class NvmeOptimizerSwapper:
                                "ignored")
                 continue
             fname = self._shard_fname(key, tag)
-            shutil.copy2(os.path.join(src, os.path.basename(fname)), fname)
+            if not self._restore_item_file(
+                    os.path.join(src, os.path.basename(fname)), fname,
+                    key, tag, ck_sums.get((key, tag)), ck_algo):
+                continue                    # rejected: restarts zero-init
             self._initialized.add((key, tag))
         self._restored = True
         self._assemble_buckets_from_items()
+        return True
+
+    def _restore_item_file(self, src_path: str, dst: str, key: str,
+                           tag: str, exp: Optional[tuple],
+                           algo: str) -> bool:
+        """Copy one checkpointed ``[m; v]`` moment file into the swap
+        dir, VERIFIED against the digests the checkpoint recorded (a
+        flipped bit in checkpointed moments is rejected at restore —
+        that moment restarts zero with a loud error — instead of being
+        trained on).  Files from checkpoints without digests copy
+        unverified, as before."""
+        from deepspeed_tpu.resilience.sdc import checksum
+
+        try:
+            data = np.fromfile(src_path, np.uint8)
+        except OSError as e:
+            logger.warning(f"moment file {os.path.basename(src_path)} "
+                           f"unreadable ({e}); restarting zero-init")
+            return False
+        if exp is not None:
+            (dm, nm), (dv, nv) = exp
+            m, v = data[:nm], data[nm:nm + nv]
+            if (data.nbytes != nm + nv or checksum(m, algo) != dm
+                    or checksum(v, algo) != dv):
+                self.sdc_counters["restore_rejected"] += 1
+                logger.error(
+                    f"NVMe swap: checkpointed moments for {key!r} FAILED "
+                    f"checksum verification at restore "
+                    f"({os.path.basename(src_path)}); rejected — this "
+                    "moment restarts zero-init")
+                return False
+        tmp = f"{dst}.tmp.p{jax.process_index()}"
+        data.tofile(tmp)
+        os.replace(tmp, dst)
+        if exp is not None and self._sdc_verify:
+            if algo == self._sdc_algo:
+                self._item_sums[(key, tag)] = exp
+            else:
+                (dm, nm), (dv, nv) = exp
+                self._note_item_sums(key, tag, data[:nm],
+                                     data[nm:nm + nv], defer=False)
         return True
 
 

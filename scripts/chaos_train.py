@@ -19,11 +19,24 @@ the real 2-process versions; this pass proves the single-process
 detection story end-to-end: wrong payload caught by checksum, delay
 caught by wall clock, skipped collective caught by the op log).
 
+``--sdc`` runs the SILENT-DATA-CORRUPTION pass against a live
+NVMe-offloaded engine: a transient bit flip injected into a just-read
+moment bucket must be detected and healed by re-read (training
+continues), and a bit flipped directly in a live swap FILE (persistent
+media corruption) must be detected before the corrupted moment reaches
+any optimizer update, quarantine the file, commit an emergency
+checkpoint, and let a rebuilt engine resume from it — the elastic
+restart story end-to-end.  Any corruption that trains on undetected
+exits nonzero.
+
+``--all`` = the base checkpoint-fault schedule + ``--comm`` + ``--sdc``.
+
 Usage::
 
     python scripts/chaos_train.py --steps 30 --seed 0
     python scripts/chaos_train.py --steps 50 --faults 8 --seed 3
     python scripts/chaos_train.py --steps 10 --comm
+    python scripts/chaos_train.py --steps 10 --all
 """
 import argparse
 import os
@@ -43,7 +56,8 @@ import numpy as np  # noqa: E402
 import deepspeed_tpu  # noqa: E402
 import deepspeed_tpu.comm as dist  # noqa: E402
 from deepspeed_tpu.checkpoint import sharded  # noqa: E402
-from deepspeed_tpu.resilience import FaultInjector, SimulatedCrash  # noqa: E402
+from deepspeed_tpu.resilience import (FaultInjector,  # noqa: E402
+                                      SimulatedCrash, SwapCorruptionError)
 from deepspeed_tpu.resilience import faults as faults_mod  # noqa: E402
 
 FAULT_KINDS = ("torn", "crash", "oserror", "sigterm")
@@ -164,6 +178,101 @@ def comm_fault_pass(seed: int) -> int:
     return undetected
 
 
+def make_sdc_engine(nvme_dir: str, ckpt_dir: str):
+    from simple_model import tiny_gpt2
+
+    topo = dist.initialize_mesh(dp=1, devices=jax.devices()[:1])
+    engine, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), topology=topo,
+        config={"train_batch_size": 8,
+                "steps_per_print": 1_000_000,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "offload_optimizer": {"device": "nvme",
+                                          "nvme_path": nvme_dir}},
+                "resilience": {"keep_last_k": 3, "verify_on_load": True}},
+        example_batch={"input_ids": np.zeros((8, 16), np.int32)},
+        rng=jax.random.PRNGKey(0))
+    engine.load_checkpoint(ckpt_dir)
+    return engine
+
+
+def sdc_fault_pass(seed: int) -> int:
+    """Silent-data-corruption pass against a live NVMe-offloaded
+    engine (returns the number of UNDETECTED corruptions — nonzero
+    fails the soak).  Transient flip (hook kind ``bitflip``) must heal
+    via re-read; a bit flipped in a live swap file (persistent media
+    corruption) must quarantine + emergency-checkpoint + survive an
+    elastic-style restart from the last verified checkpoint."""
+    undetected = 0
+    nvme_dir = tempfile.mkdtemp(prefix="chaos_sdc_nvme_")
+    ckpt_dir = tempfile.mkdtemp(prefix="chaos_sdc_ckpt_")
+    engine = make_sdc_engine(nvme_dir, ckpt_dir)
+    engine.install_preemption_handler(ckpt_dir, exit_after=False)
+    for step in range(2):
+        engine.train_batch(batch=data_fn(step))
+    engine.save_checkpoint(ckpt_dir, async_save=False)
+    sw = engine.nvme_swapper
+
+    # transient: one flipped bit in a just-read bucket buffer — the
+    # re-read returns clean bytes and training continues
+    with FaultInjector(seed=seed).bitflip("swap.read_bucket", count=1):
+        engine.train_batch(batch=data_fn(2))
+    if (sw.sdc_counters["mismatches"] < 1
+            or sw.sdc_counters["reread_recovered"] < 1):
+        print("FAIL: transient swap bitflip not detected/recovered: "
+              f"{sw.sdc_counters}")
+        undetected += 1
+    else:
+        print("  swap transient bitflip: detected, healed by re-read "
+              f"(counters {sw.sdc_counters})")
+
+    # persistent: flip a bit in a live swap FILE — every re-read sees
+    # it, so the tiered recovery must quarantine and escalate BEFORE
+    # the corrupted moment reaches an optimizer update
+    sw.drain()
+    bucket = sorted(f for f in os.listdir(sw.swap_dir)
+                    if f.startswith("bucket_") and f.endswith(".bin"))[0]
+    bit = faults_mod.flip_bit_in_file(
+        os.path.join(sw.swap_dir, bucket), seed=seed)
+    try:
+        engine.train_batch(batch=data_fn(3))
+        print(f"FAIL: persistent flip (bit {bit} of {bucket}) trained "
+              "on undetected")
+        undetected += 1
+    except SwapCorruptionError:
+        quarantined = [f for f in os.listdir(sw.swap_dir)
+                       if ".quarantine" in f]
+        emergency = [t for t in os.listdir(ckpt_dir)
+                     if t.startswith("emergency_step")]
+        if not quarantined:
+            print("FAIL: corrupt swap file was not quarantined")
+            undetected += 1
+        if not emergency:
+            print("FAIL: no emergency checkpoint committed")
+            undetected += 1
+        if quarantined and emergency:
+            print(f"  swap persistent bitflip: detected before use, "
+                  f"{quarantined[0]} quarantined, emergency checkpoint "
+                  f"{emergency[0]} committed")
+    engine.uninstall_preemption_handler()
+    engine.nvme_swapper.close()     # free the dead engine's swap files
+
+    # the elastic-restart half: a rebuilt engine resumes from the last
+    # verified checkpoint and trains on
+    engine = make_sdc_engine(nvme_dir, ckpt_dir)
+    resumed = engine.global_steps
+    engine.train_batch(batch=data_fn(resumed))
+    if engine.global_steps != resumed + 1:
+        print("FAIL: post-corruption restart did not train")
+        undetected += 1
+    else:
+        print(f"  restart: resumed at step {resumed} from the last "
+              "verified checkpoint and trained on")
+    engine.nvme_swapper.close()
+    return undetected
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--steps", type=int, default=30)
@@ -173,9 +282,18 @@ def main(argv=None) -> int:
     ap.add_argument("--comm", action="store_true",
                     help="also run the comm-level fault pass "
                          "(corrupt/straggle/drop + watchdog)")
+    ap.add_argument("--sdc", action="store_true",
+                    help="also run the silent-data-corruption pass "
+                         "(bit flips in the NVMe swap hot path: "
+                         "transient heals, persistent quarantines + "
+                         "emergency checkpoint + restart)")
+    ap.add_argument("--all", action="store_true",
+                    help="the full sweep: base schedule + --comm + --sdc")
     ap.add_argument("--dir", default=None,
                     help="checkpoint dir (default: fresh tmpdir)")
     args = ap.parse_args(argv)
+    if args.all:
+        args.comm = args.sdc = True
 
     ckpt_dir = args.dir or tempfile.mkdtemp(prefix="chaos_ckpt_")
     schedule = build_schedule(args.seed, args.steps, args.faults,
@@ -241,9 +359,17 @@ def main(argv=None) -> int:
         if comm_undetected:
             print(f"FAIL: {comm_undetected} comm faults went undetected")
             return 1
+    if args.sdc:
+        print("sdc fault pass:")
+        sdc_undetected = sdc_fault_pass(args.seed)
+        if sdc_undetected:
+            print(f"FAIL: {sdc_undetected} silent corruptions went "
+                  "undetected")
+            return 1
     print(f"OK: {args.steps} steps, {n_scheduled} faults injected, "
           f"{recovered} recoveries, final checkpoint verified"
-          + (", comm fault pass clean" if args.comm else ""))
+          + (", comm fault pass clean" if args.comm else "")
+          + (", sdc fault pass clean" if args.sdc else ""))
     return 0
 
 

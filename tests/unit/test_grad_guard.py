@@ -1,0 +1,75 @@
+"""bf16/fp32 non-finite gradient guard (ISSUE 4 satellite).
+
+The fused inf/nan sweep historically only ran under fp16 loss scaling;
+``resilience.check_grad_finite = N`` folds the same check into
+bf16/fp32 steps — non-finite steps are SKIPPED (params untouched) and
+N consecutive ones raise ``GradientAnomalyError`` instead of silently
+training on NaNs forever.
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.resilience import GradientAnomalyError
+from simple_model import random_tokens, tiny_gpt2
+
+
+def _engine(check_grad_finite=0):
+    topo = dist.initialize_mesh(dp=8)
+    eng, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), topology=topo,
+        config={"train_batch_size": 8, "steps_per_print": 10000,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "resilience": {"check_grad_finite": check_grad_finite}},
+        example_batch=random_tokens(8), rng=jax.random.PRNGKey(0))
+    return eng
+
+
+def _poison(eng):
+    """NaN the params — every subsequent gradient is non-finite (the
+    'diverged model' failure mode)."""
+    nan_params = jax.tree_util.tree_map(
+        lambda x: x * jnp.nan
+        if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        eng.state.params)
+    eng.state = eng.state.replace(params=nan_params)
+
+
+def test_fp32_steps_skip_nonfinite_and_abort_after_n(devices):
+    eng = _engine(check_grad_finite=2)
+    assert eng._skip_guard is not None and eng._skip_guard.bound == 2
+    eng.train_batch(batch=random_tokens(8, seed=0))   # healthy step
+    assert not bool(jax.device_get(eng._last_metrics["overflow"]))
+    _poison(eng)
+    eng.train_batch(batch=random_tokens(8, seed=1))   # skip #1
+    assert bool(jax.device_get(eng._last_metrics["overflow"]))
+    assert int(jax.device_get(eng.state.skipped_steps)) == 1
+    with pytest.raises(GradientAnomalyError):
+        eng.train_batch(batch=random_tokens(8, seed=2))  # skip #2 aborts
+
+
+def test_fp32_default_keeps_legacy_behavior(devices):
+    """Knob off (default): no sweep, no skip — bf16/fp32 runs behave
+    exactly as before (overflow is always reported False)."""
+    eng = _engine()
+    assert eng._skip_guard is None
+    _poison(eng)
+    eng.train_batch(batch=random_tokens(8, seed=0))
+    assert not bool(jax.device_get(eng._last_metrics["overflow"]))
+    assert int(jax.device_get(eng.state.skipped_steps)) == 0
+
+
+def test_finite_run_with_guard_on_never_skips(devices):
+    eng = _engine(check_grad_finite=3)
+    for s in range(3):
+        eng.train_batch(batch=random_tokens(8, seed=s))
+    assert int(jax.device_get(eng.state.skipped_steps)) == 0
+    assert eng._skip_guard.consecutive == 0

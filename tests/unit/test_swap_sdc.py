@@ -1,0 +1,495 @@
+"""Silent-data-corruption defense for the NVMe offload hot path.
+
+The load-bearing guarantees (ISSUE 4 acceptance):
+
+1. DETECT-BEFORE-USE — a seeded ``bitflip`` injected into a swapped
+   bucket/shard is caught by checksum verification BEFORE the
+   corrupted moment participates in any optimizer update.
+2. TIERED RECOVERY — a transient flip (host buffer / DMA) heals via
+   the blocking re-read path with training bit-identical to an
+   uninjected run; a persistent flip (on the media — every re-read
+   sees it) quarantines the swap file and raises
+   ``SwapCorruptionError`` through the engine's emergency-checkpoint
+   path.
+3. VERIFY-OFF IS A NO-OP — ``resilience.sdc.verify_on_read = false``
+   restores the pre-defense behavior exactly (bit-identical stream, no
+   digests, and — demonstrably — the corruption the defense exists to
+   catch goes through undetected).
+
+Both the bucketed single-process stream and the leafwise (multi-process
+fallback) stream are covered, plus the torn-write interaction and the
+verified-restore path (corrupt checkpointed moments rejected at load).
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import deepspeed_tpu
+import deepspeed_tpu.comm as dist
+from deepspeed_tpu.resilience import (FaultInjector, SimulatedCrash,
+                                      SwapCorruptionError, flip_bit_in_file)
+from deepspeed_tpu.resilience import retry as retry_mod
+from deepspeed_tpu.resilience.sdc import CHECKSUM_ALGOS, checksum, digest
+from deepspeed_tpu.runtime.swap_tensor import NvmeOptimizerSwapper
+from simple_model import random_tokens, tiny_gpt2
+
+
+@pytest.fixture
+def fake_sleep(monkeypatch):
+    """Re-read backoffs must never really sleep in tier-1."""
+    delays = []
+    monkeypatch.setattr(retry_mod, "_sleep", delays.append)
+    return delays
+
+
+def _params(n_layers=3, width=48):
+    p = {}
+    for i in range(n_layers):
+        p[f"layer{i}/w"] = (jnp.arange(8 * width, dtype=jnp.float32)
+                            .reshape(8, width) * 0.01 * (i + 1))
+        p[f"layer{i}/b"] = jnp.full((width,), float(i), jnp.float32)
+    return jax.device_put(p)
+
+
+def _grads(params, step):
+    return jax.tree_util.tree_map(
+        lambda x: jnp.full(x.shape, 0.1 * (step + 1), x.dtype), params)
+
+
+def _run_steps(sw, params, steps, start=0):
+    cur = params
+    for s in range(start, start + steps):
+        sw.start_prefetch()
+        cur = sw.apply(cur, _grads(cur, s), lr=1e-2, gscale=1.0)
+    sw.drain()
+    return cur
+
+
+def _assert_tree_bitwise_equal(a, b):
+    for (kp, x), (_, y) in zip(
+            jax.tree_util.tree_flatten_with_path(a)[0],
+            jax.tree_util.tree_flatten_with_path(b)[0]):
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(x)), np.asarray(jax.device_get(y)),
+            err_msg=str(kp))
+
+
+def _leafwise(sw):
+    """Force the leafwise stream (the multi-process fallback) on a
+    single-process swapper."""
+    sw._buckets = None
+    sw._item_loc = {}
+    return sw
+
+
+# ---------------------------------------------------------------------------
+# checksum primitives
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("algo", CHECKSUM_ALGOS)
+def test_every_algo_detects_any_single_bit_flip(algo):
+    rng = np.random.default_rng(0)
+    buf = rng.standard_normal(1031).astype(np.float32)  # odd, tail bytes
+    clean = checksum(buf, algo)
+    view = buf.view(np.uint8)
+    for bit in rng.choice(view.size * 8, size=32, replace=False):
+        view[bit // 8] ^= np.uint8(1 << (bit % 8))
+        assert checksum(buf, algo) != clean, f"{algo} missed bit {bit}"
+        view[bit // 8] ^= np.uint8(1 << (bit % 8))
+    assert checksum(buf, algo) == clean
+
+
+def test_digest_detects_truncation_via_nbytes():
+    buf = np.zeros(64, np.uint8)
+    d = digest(buf, "sum64")
+    assert d[1] == 64
+    # all-zero buffers of different sizes must not collide
+    assert digest(np.zeros(32, np.uint8), "sum64") != d
+
+
+# ---------------------------------------------------------------------------
+# bucketed stream: transient / persistent / torn interaction
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_transient_bitflip_recovers_bit_identical(tmp_path, devices,
+                                                  fake_sleep):
+    """One flipped bit in a just-read bucket buffer: detected, healed
+    by re-read, and the training outcome is BIT-IDENTICAL to an
+    uninjected run — the acceptance's transient story."""
+    params = _params()
+    faulty = NvmeOptimizerSwapper(str(tmp_path / "faulty"), params)
+    clean = NvmeOptimizerSwapper(str(tmp_path / "clean"), params)
+    try:
+        p_f = _run_steps(faulty, params, steps=1)
+        p_c = _run_steps(clean, params, steps=1)
+        with FaultInjector(seed=3).bitflip("swap.read_bucket",
+                                           count=1) as inj:
+            p_f = _run_steps(faulty, p_f, steps=1, start=1)
+        assert ("swap.read_bucket", "bitflip", 1) in inj.fired
+        c = faulty.sdc_counters
+        assert c["mismatches"] == 1 and c["reread_recovered"] == 1
+        assert c["quarantined"] == 0
+        assert fake_sleep == [], "first re-read healed; no backoff needed"
+        assert faulty.count == 2            # never invalidated
+        p_c = _run_steps(clean, p_c, steps=1, start=1)
+        _assert_tree_bitwise_equal(p_f, p_c)
+        # and the streams stay in lockstep afterwards
+        _assert_tree_bitwise_equal(_run_steps(faulty, p_f, 1, start=2),
+                                   _run_steps(clean, p_c, 1, start=2))
+        assert faulty.stage_stats["sdc"]["mismatches"] == 1
+    finally:
+        faulty.close()
+        clean.close()
+
+
+@pytest.mark.faults
+def test_persistent_bitflip_quarantines_and_raises(tmp_path, devices,
+                                                   fake_sleep):
+    """A bit flipped on the MEDIA (every re-read returns it): re-reads
+    exhaust, the bucket file is quarantined, SwapCorruptionError
+    raises, and the swap state invalidates — the corrupted moment
+    never participates in an update."""
+    params = _params()
+    sw = NvmeOptimizerSwapper(str(tmp_path / "sw"), params,
+                              sdc_max_reread=1)
+    fresh = NvmeOptimizerSwapper(str(tmp_path / "fresh"), params)
+    try:
+        p1 = _run_steps(sw, params, steps=1)
+        bucket = sw._bucket_fname(0)
+        flip_bit_in_file(bucket, seed=11)
+        with pytest.raises(SwapCorruptionError):
+            sw.start_prefetch()
+            sw.apply(p1, _grads(p1, 1), lr=1e-2, gscale=1.0)
+        c = sw.sdc_counters
+        assert c["mismatches"] == 1 and c["quarantined"] == 1
+        assert c["rereads"] == 2            # initial retry + 1 backoff
+        assert c["reread_recovered"] == 0
+        assert not os.path.exists(bucket)
+        assert os.path.exists(bucket + ".quarantine")
+        # invalidation contract: count rolled back, no trusted state
+        assert sw.count == 1
+        assert not sw._initialized and not sw._bucket_ready
+        assert not sw._bucket_sums and not sw._item_sums
+        # recovery: streams zero-init moments like a fresh swapper
+        out = sw.apply(p1, _grads(p1, 1), lr=1e-2, gscale=1.0)
+        sw.drain()
+        fresh.count = 1
+        ref = fresh.apply(p1, _grads(p1, 1), lr=1e-2, gscale=1.0)
+        fresh.drain()
+        _assert_tree_bitwise_equal(out, ref)
+    finally:
+        sw.close()
+        fresh.close()
+
+
+@pytest.mark.faults
+def test_torn_write_then_bitflip_compose(tmp_path, devices, fake_sleep):
+    """The torn-write invalidation contract and the SDC verifier
+    compose: a torn write-back invalidates (digest metadata included),
+    the next apply streams zero-init, and a transient bitflip on the
+    step after that is still caught and healed."""
+    params = _params()
+    sw = NvmeOptimizerSwapper(str(tmp_path / "sw"), params)
+    try:
+        p1 = _run_steps(sw, params, steps=1)
+        with FaultInjector(seed=0) as inj:
+            inj.torn_write("swap.write_bucket", fraction=0.25)
+            with pytest.raises(SimulatedCrash):
+                sw.apply(p1, _grads(p1, 1), lr=1e-2, gscale=1.0)
+        assert ("swap.write_bucket", "torn", 1) in inj.fired
+        assert not sw._bucket_sums, "invalidation must clear digests"
+        # zero-init recovery step (writes fresh buckets + digests)
+        p2 = sw.apply(p1, _grads(p1, 1), lr=1e-2, gscale=1.0)
+        sw.drain()
+        assert sw._bucket_sums
+        # the defense is live again: transient flip caught + healed
+        with FaultInjector(seed=5).bitflip("swap.read_bucket",
+                                           count=1) as inj:
+            _run_steps(sw, p2, steps=1, start=2)
+        assert inj.fired
+        assert sw.sdc_counters["reread_recovered"] == 1
+        assert sw.sdc_counters["quarantined"] == 0
+    finally:
+        sw.close()
+
+
+# ---------------------------------------------------------------------------
+# leafwise stream (the multi-process fallback path)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_leafwise_transient_bitflip_recovers(tmp_path, devices,
+                                             fake_sleep):
+    params = _params(n_layers=2)
+    faulty = _leafwise(NvmeOptimizerSwapper(str(tmp_path / "f"), params))
+    clean = _leafwise(NvmeOptimizerSwapper(str(tmp_path / "c"), params))
+    try:
+        p_f = _run_steps(faulty, params, steps=1)
+        p_c = _run_steps(clean, params, steps=1)
+        with FaultInjector(seed=1).bitflip("swap.read_item",
+                                           count=1) as inj:
+            p_f = _run_steps(faulty, p_f, steps=1, start=1)
+        assert ("swap.read_item", "bitflip", 1) in inj.fired
+        c = faulty.sdc_counters
+        assert c["mismatches"] == 1 and c["reread_recovered"] == 1
+        assert faulty.stage_stats["mode"] == "leafwise"
+        p_c = _run_steps(clean, p_c, steps=1, start=1)
+        _assert_tree_bitwise_equal(p_f, p_c)
+    finally:
+        faulty.close()
+        clean.close()
+
+
+@pytest.mark.faults
+def test_leafwise_persistent_bitflip_quarantines(tmp_path, devices,
+                                                 fake_sleep):
+    params = _params(n_layers=2)
+    sw = _leafwise(NvmeOptimizerSwapper(str(tmp_path / "sw"), params,
+                                        sdc_max_reread=1))
+    try:
+        p1 = _run_steps(sw, params, steps=1)
+        key, tag = sorted(sw._initialized)[0]
+        shard = sw._shard_fname(key, tag)
+        flip_bit_in_file(shard, seed=13)
+        with pytest.raises(SwapCorruptionError):
+            sw.apply(p1, _grads(p1, 1), lr=1e-2, gscale=1.0)
+        assert sw.sdc_counters["quarantined"] == 1
+        assert not os.path.exists(shard)
+        assert os.path.exists(shard + ".quarantine")
+        assert sw.count == 1 and not sw._initialized
+    finally:
+        sw.close()
+
+
+# ---------------------------------------------------------------------------
+# verify-off: zero behavior change (and the documented blind spot)
+# ---------------------------------------------------------------------------
+
+
+def test_verify_off_is_bit_identical_and_computes_nothing(tmp_path,
+                                                          devices):
+    params = _params()
+    on = NvmeOptimizerSwapper(str(tmp_path / "on"), params)
+    off = NvmeOptimizerSwapper(str(tmp_path / "off"), params,
+                               sdc_verify=False)
+    try:
+        p_on = _run_steps(on, params, steps=3)
+        p_off = _run_steps(off, params, steps=3)
+        _assert_tree_bitwise_equal(p_on, p_off)
+        for kb in sorted(on._bucket_ready):
+            with open(on._bucket_fname(kb), "rb") as f:
+                da = f.read()
+            with open(off._bucket_fname(kb), "rb") as f:
+                db = f.read()
+            assert da == db
+        assert not off._bucket_sums and not off._item_sums
+        assert off._sum_pool is None, "verify-off must not spin a pool"
+        assert all(v == 0 for v in off.sdc_counters.values())
+        assert off.stage_stats["swap_verify_s"] == 0.0
+        assert on._bucket_sums and on.sdc_counters["verified"] > 0
+    finally:
+        on.close()
+        off.close()
+
+
+@pytest.mark.faults
+def test_verify_off_leaves_corruption_undetected(tmp_path, devices):
+    """The blind spot the defense exists to close: with verify off, a
+    flipped bit sails straight into the optimizer update — the apply
+    succeeds, nothing is counted, and the result silently diverges
+    from the clean run."""
+    params = _params()
+    blind = NvmeOptimizerSwapper(str(tmp_path / "blind"), params,
+                                 sdc_verify=False)
+    clean = NvmeOptimizerSwapper(str(tmp_path / "clean"), params)
+    try:
+        p_b = _run_steps(blind, params, steps=1)
+        p_c = _run_steps(clean, params, steps=1)
+        with FaultInjector(seed=3).bitflip("swap.read_bucket",
+                                           count=1) as inj:
+            p_b = _run_steps(blind, p_b, steps=1, start=1)  # no raise
+        assert inj.fired, "the fault site still fires with verify off"
+        assert all(v == 0 for v in blind.sdc_counters.values())
+        p_c = _run_steps(clean, p_c, steps=1, start=1)
+        flat_b = np.concatenate([np.asarray(x).ravel() for x in
+                                 jax.tree_util.tree_leaves(p_b)])
+        flat_c = np.concatenate([np.asarray(x).ravel() for x in
+                                 jax.tree_util.tree_leaves(p_c)])
+        assert not np.array_equal(flat_b, flat_c), \
+            "corruption should have silently poisoned the blind run"
+    finally:
+        blind.close()
+        clean.close()
+
+
+# ---------------------------------------------------------------------------
+# verified restore: corrupt checkpointed moments rejected at load
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_checkpoint_restore_rejects_corrupt_moment_file(tmp_path,
+                                                        devices):
+    params = _params(n_layers=2)
+    sw = NvmeOptimizerSwapper(str(tmp_path / "sw"), params)
+    try:
+        _run_steps(sw, params, steps=2)
+        ck = str(tmp_path / "ck")
+        sw.save_to(ck)
+        import json
+
+        with open(os.path.join(ck, "nvme_optimizer",
+                               "swap_meta.p0.json")) as f:
+            meta = json.load(f)
+        assert meta.get("sums"), "checkpoint must carry moment digests"
+        from deepspeed_tpu.runtime.swap_tensor import _item_base
+
+        victim_key, victim_tag = meta["sums"][0][0], meta["sums"][0][1]
+        victim = f"{_item_base(victim_key)}.{victim_tag}.bin"
+        flip_bit_in_file(os.path.join(ck, "nvme_optimizer", victim),
+                         seed=17)
+        other = NvmeOptimizerSwapper(str(tmp_path / "other"), params)
+        try:
+            assert other.load_from(ck)
+            assert other.sdc_counters["restore_rejected"] == 1
+            assert (victim_key, victim_tag) not in other._initialized
+            # untouched moments restored fine
+            assert other._initialized
+        finally:
+            other.close()
+    finally:
+        sw.close()
+
+
+def test_clean_restore_records_digests_for_later_verification(tmp_path,
+                                                              devices):
+    params = _params(n_layers=2)
+    sw = NvmeOptimizerSwapper(str(tmp_path / "sw"), params)
+    try:
+        p2 = _run_steps(sw, params, steps=2)
+        ck = str(tmp_path / "ck")
+        sw.save_to(ck)
+        other = NvmeOptimizerSwapper(str(tmp_path / "other"), params)
+        try:
+            assert other.load_from(ck)
+            assert other.sdc_counters["restore_rejected"] == 0
+            # assembled buckets carry fresh digests: the very next
+            # swap-in is verified
+            assert other._bucket_sums
+            other.count = sw.count
+            out = _run_steps(other, p2, steps=1, start=2)
+            ref = _run_steps(sw, p2, steps=1, start=2)
+            _assert_tree_bitwise_equal(out, ref)
+            assert other.sdc_counters["verified"] > 0
+        finally:
+            other.close()
+    finally:
+        sw.close()
+
+
+# ---------------------------------------------------------------------------
+# engine integration: config plumbing + emergency-checkpoint routing
+# ---------------------------------------------------------------------------
+
+
+def _nvme_engine(tmp_path, extra_resilience=None):
+    topo = dist.initialize_mesh(dp=8)
+    cfg = {
+        "train_batch_size": 8,
+        "steps_per_print": 10000,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {
+            "stage": 2,
+            "offload_optimizer": {"device": "nvme",
+                                  "nvme_path": str(tmp_path / "nvme")}},
+        "resilience": extra_resilience or {},
+    }
+    eng, *_ = deepspeed_tpu.initialize(
+        model=tiny_gpt2(), config=cfg, topology=topo,
+        example_batch=random_tokens(8), rng=jax.random.PRNGKey(0))
+    return eng
+
+
+def test_engine_plumbs_sdc_config_to_swapper(tmp_path, devices):
+    eng = _nvme_engine(tmp_path, {"sdc": {"verify_on_read": False,
+                                          "checksum": "crc32",
+                                          "max_reread_retries": 5}})
+    sw = eng.nvme_swapper
+    assert sw is not None
+    assert not sw._sdc_verify
+    assert sw._sdc_algo == "crc32" and sw._sdc_rereads == 5
+    sw.close()
+
+
+def test_sdc_config_validation():
+    from deepspeed_tpu.config.config import load_config
+
+    with pytest.raises(ValueError, match="checksum"):
+        load_config({"resilience": {"sdc": {"checksum": "md5"}}})
+    with pytest.raises(ValueError, match="max_reread_retries"):
+        load_config({"resilience": {"sdc": {"max_reread_retries": -1}}})
+    with pytest.raises(ValueError, match="check_grad_finite"):
+        load_config({"resilience": {"check_grad_finite": -2}})
+    cfg = load_config({})
+    assert cfg.resilience.sdc.verify_on_read
+    assert cfg.resilience.sdc.checksum == "sum64"
+
+
+@pytest.mark.faults
+def test_engine_routes_corruption_through_emergency_checkpoint(
+        tmp_path, devices):
+    """Persistent corruption in a live swap file during training: the
+    engine takes an emergency checkpoint and re-raises — the elastic
+    agent's restart-from-last-verified-tag path (which
+    scripts/chaos_train.py --sdc drives end-to-end)."""
+    eng = _nvme_engine(tmp_path)
+    sw = eng.nvme_swapper
+    ckpt_dir = str(tmp_path / "ckpt")
+    eng.install_preemption_handler(ckpt_dir, exit_after=False)
+    try:
+        eng.train_batch(batch=random_tokens(8, seed=0))
+        eng.train_batch(batch=random_tokens(8, seed=1))
+        sw.drain()
+        bucket = [f for f in os.listdir(sw.swap_dir)
+                  if f.startswith("bucket_") and f.endswith(".bin")][0]
+        flip_bit_in_file(os.path.join(sw.swap_dir, bucket), seed=23)
+        with pytest.raises(SwapCorruptionError):
+            eng.train_batch(batch=random_tokens(8, seed=2))
+        assert eng.swap_corrupted
+        assert any(".quarantine" in f for f in os.listdir(sw.swap_dir))
+        emergency = [t for t in os.listdir(ckpt_dir)
+                     if t.startswith("emergency_step")]
+        assert emergency, "corruption must trigger the last-gasp save"
+        from deepspeed_tpu.checkpoint import sharded
+
+        ok, reason = sharded.verify_tag(
+            os.path.join(ckpt_dir, emergency[0]))
+        assert ok, reason
+    finally:
+        eng.uninstall_preemption_handler()
+        sw.close()
+
+
+def test_engine_surfaces_sdc_in_stage_stats_and_timers(tmp_path, devices):
+    eng = _nvme_engine(tmp_path)
+    eng.config.wall_clock_breakdown = True
+    sw = eng.nvme_swapper
+    try:
+        eng.train_batch(batch=random_tokens(8, seed=0))
+        eng.train_batch(batch=random_tokens(8, seed=1))
+        assert "sdc" in sw.stage_stats
+        assert sw.stage_stats["sdc"]["verified"] > 0
+        assert "swap_verify_s" in sw.stage_stats
+        assert eng.timers.has_timer("swap_verify")
+    finally:
+        sw.close()
