@@ -484,6 +484,8 @@ def bench_moe_ep(args) -> None:
 
 def bench_inference(args) -> None:
     """KV-cache decode throughput (tokens/s/chip), greedy sampling."""
+    import os
+
     import deepspeed_tpu
 
     on_tpu = not args.smoke
@@ -510,9 +512,16 @@ def bench_inference(args) -> None:
 
     jax.block_until_ready(engine.generate(ids, max_new_tokens=new))  # compile
     # device time via profiler (the tunnel's per-dispatch host latency is
-    # a harness artifact, like the train configs); wall reported alongside
+    # a harness artifact, like the train configs); wall reported alongside.
+    # The timed loop uses the DEFERRED-HARVEST path (generate_async): call
+    # k+1's host work overlaps call k's device work, and the harness's one
+    # final sync harvests everything — the serving host-path pipeline's v1
+    # treatment.
+    engine.host_stats.reset()
     dev_dt, wall_dt = device_seconds_per_call(
-        lambda: jnp.asarray(engine.generate(ids, max_new_tokens=new)), n=3)
+        lambda: engine.generate_async(ids, max_new_tokens=new)
+        .device_array(), n=3)
+    serving_stages = engine.serving_stages()
     n_chips = len(jax.devices())
     tps = bsz * new / dev_dt
     # Two floors, both FIXED (VERDICT Weak #5: a floor re-based to the
@@ -537,7 +546,15 @@ def bench_inference(args) -> None:
                    "floor_current_batch64": floor_batch64,
                    "tokens_per_sec_per_chip": round(tps / n_chips, 1),
                    "wall_tokens_per_sec": round(bsz * new / wall_dt, 1),
+                   "wall_vs_device_ratio": round(wall_dt / dev_dt, 2),
                    "device_call_ms": round(dev_dt * 1e3, 1),
+                   "serving_stages": serving_stages,
+                   # wall time NOT covered by device work — the async
+                   # dispatch path never blocks inside the engine, so
+                   # the wall/device gap is the authoritative view here
+                   "host_bound_fraction": round(
+                       max(0.0, 1.0 - dev_dt / wall_dt), 4),
+                   "host_cores": os.cpu_count(),
                    "device": jax.devices()[0].device_kind},
     }))
 
@@ -569,9 +586,11 @@ def _ragged_run(model, params, *, max_seqs, max_len, chunk, prompt_lens,
         eng.step()
     if eng.has_work():
         eng.step()
+    eng.sync()          # fold pipelined in-flight warmup tokens first
     warmup_tokens = (sum(len(s.generated) for s in eng.slots
                          if s is not None) +
                      sum(len(r.generated) for r in eng.finished))
+    eng.host_stats.reset()          # stage breakdown covers the timed loop
 
     # device time via profiler: the host-driven scheduler pays one tunnel
     # round-trip per DISPATCH under this harness (wall is an artifact
@@ -635,8 +654,11 @@ def bench_ragged(args) -> None:
     run_kw = dict(max_seqs=max_seqs, max_len=max_len, chunk=chunk,
                   prompt_lens=prompt_lens, new=new, vocab=cfg.vocab_size)
     decode_block = 8
+    import os
+
     gen_tokens, dispatches, wall, dev_s, base_eng = _ragged_run(
         model, {"params": params}, decode_block=decode_block, **run_kw)
+    serving_stages = base_eng.serving_stages()
     n_chips = len(jax.devices())
     best_s = dev_s if dev_s else wall
     detail = {"requests": int(n_req), "max_seqs": max_seqs,
@@ -648,8 +670,36 @@ def bench_ragged(args) -> None:
               "device_s": round(dev_s, 2) if dev_s else None,
               "wall_s": round(wall, 2),
               "wall_tokens_per_sec": round(gen_tokens / wall, 1),
+              "wall_vs_device_ratio": (round(wall / dev_s, 2)
+                                       if dev_s else None),
+              "serving_stages": serving_stages,
+              # profiler-measured device seconds against wall when
+              # available (authoritative); engine-observed fraction
+              # (stage timers) otherwise
+              "host_bound_fraction": (
+                  round(max(0.0, 1.0 - dev_s / wall), 4) if dev_s
+                  else serving_stages["host_bound_fraction"]),
+              "host_cores": os.cpu_count(),
+              "pipeline": {"enabled": base_eng.pipeline,
+                           "async_depth": base_eng.async_depth,
+                           "harvest_interval": base_eng.harvest_interval},
               "n_chips": n_chips,
               "device": jax.devices()[0].device_kind}
+
+    # pipeline-off control: the unpipelined host path (fresh metadata
+    # upload + one blocking harvest per dispatch) on the SAME workload —
+    # the measured before/after for the serving host-path pipeline
+    off_t, off_d, off_wall, off_dev, off_eng = _ragged_run(
+        model, {"params": params}, decode_block=decode_block,
+        pipeline=False, **run_kw)
+    off_stages = off_eng.serving_stages()
+    detail["pipeline_off"] = {
+        "wall_tokens_per_sec": round(off_t / off_wall, 1),
+        "tokens_per_sec": round(off_t / (off_dev if off_dev else off_wall),
+                                1),
+        "dispatches": off_d,
+        "host_bound_fraction": off_stages["host_bound_fraction"],
+        "serving_stages": off_stages}
 
     # decode-block sweep: on-device sampling makes larger K nearly free
     # in device time and divides the host-dispatch count by K

@@ -1,10 +1,76 @@
 """Conventions shared by the v1 and v2 inference engines."""
 from __future__ import annotations
 
-from typing import Any, Optional
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
+
+
+class HostStageStats:
+    """Per-dispatch host-path breakdown for the serving engines.
+
+    The serving wall/device throughput gap lives entirely on the host
+    (BENCH_MATRIX ragged: 23.3k device vs 295 wall tok/s), so both
+    engines bracket every hot-loop stage:
+
+    - ``plan``:     host-side numpy scheduling (admission, SplitFuse
+                    packing, page growth, run-ahead projection)
+    - ``upload``:   host->device metadata transfers (``jnp.asarray``)
+    - ``dispatch``: handing the jitted program to the async runtime
+    - ``device``:   host BLOCKED waiting on device results (the only
+                    sync points: harvests and run-ahead depth waits)
+    - ``harvest``:  folding fetched tokens back into request state
+
+    ``serving_stages()`` reports per-dispatch milliseconds plus
+    ``host_bound_fraction`` = host-stage time / (host + device-wait)
+    — ~1.0 means the loop never waits on the device (host-bound),
+    ~0.0 means the host keeps the device saturated (device-bound).
+    Counters make the pipelining contract testable: ``meta_uploads``
+    and ``blocking_gets`` must stay flat across steady-state decode
+    blocks when the pipeline is on.
+    """
+
+    STAGES = ("plan", "upload", "dispatch", "device", "harvest")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.seconds: Dict[str, float] = {s: 0.0 for s in self.STAGES}
+        self.ticks = 0            # model ticks (a K-block counts K)
+        self.dispatches = 0       # compiled-program launches
+        self.meta_uploads = 0     # host->device metadata arrays sent
+        self.blocking_gets = 0    # blocking device->host fetches
+        self.harvests = 0         # deferred-harvest fold-backs
+
+    @contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] += time.perf_counter() - t0
+
+    def serving_stages(self) -> Dict[str, Any]:
+        d = max(self.dispatches, 1)
+        out: Dict[str, Any] = {
+            f"{s}_ms": round(self.seconds[s] * 1e3 / d, 4)
+            for s in self.STAGES}
+        host = sum(self.seconds[s] for s in
+                   ("plan", "upload", "dispatch", "harvest"))
+        dev = self.seconds["device"]
+        out["host_s"] = round(host, 4)
+        out["device_wait_s"] = round(dev, 4)
+        out["host_bound_fraction"] = (round(host / (host + dev), 4)
+                                      if host + dev > 0 else None)
+        out.update(ticks=self.ticks, dispatches=self.dispatches,
+                   meta_uploads=self.meta_uploads,
+                   blocking_gets=self.blocking_gets,
+                   harvests=self.harvests)
+        return out
 
 
 def logits_of(out):

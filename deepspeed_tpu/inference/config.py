@@ -34,6 +34,32 @@ class QuantConfig(ConfigModel):
     group_size: int = 128
 
 
+class InferenceV2Config(ConfigModel):
+    """``v2`` subtree: the serving host-path pipeline knobs.
+
+    ``pipeline`` (default ON) runs the ragged engine's decode steady
+    state as a software pipeline — metadata pinned on device, host
+    planning overlapped with device work, tokens harvested every
+    ``harvest_interval`` decode blocks with at most ``async_depth``
+    blocks in flight.  ``pipeline=False`` preserves the unpipelined
+    host loop exactly (one blocking harvest + fresh metadata upload per
+    dispatch) and is the bit-identical parity reference.  The v1 engine
+    consumes the same subtree for its deferred-harvest
+    ``generate_async`` path."""
+
+    pipeline: bool = True
+    async_depth: int = 2
+    harvest_interval: int = 4
+
+    @model_validator(mode="after")
+    def _positive(self):
+        if self.async_depth < 1:
+            raise ValueError("async_depth must be >= 1")
+        if self.harvest_interval < 1:
+            raise ValueError("harvest_interval must be >= 1")
+        return self
+
+
 class DeepSpeedInferenceConfig(ConfigModel):
     """Top-level inference config (``deepspeed.init_inference`` arg)."""
 
@@ -46,6 +72,7 @@ class DeepSpeedInferenceConfig(ConfigModel):
     enable_cuda_graph: bool = False
     max_batch_size: int = 0                 # 0 = unbounded (shape-compiled)
     quant: QuantConfig = Field(default_factory=QuantConfig)
+    v2: InferenceV2Config = Field(default_factory=InferenceV2Config)
     # reference knobs accepted for config compat, consumed elsewhere
     replace_method: str = "auto"
     checkpoint: Optional[str] = None

@@ -15,7 +15,15 @@ captures a CUDA graph, and defers generation to HF ``generate``.  Here:
   compile into single XLA programs per shape;
 - generation is native: greedy/temperature/top-k/top-p sampling fused into
   the loop (``inference/sampling.py``), KV cache per layer
-  (``inference/kv_cache.py``).
+  (``inference/kv_cache.py``);
+- the token harvest is deferrable (the serving host-path pipeline,
+  ``config.v2``): :meth:`InferenceEngine.generate_async` dispatches the
+  fused prefill+decode program and returns a :class:`PendingGeneration`
+  handle WITHOUT blocking on ``device_get`` — back-to-back calls overlap
+  the next dispatch's host work with the previous call's device work, and
+  the caller harvests when it actually needs tokens.  ``generate()`` is
+  ``generate_async(...).result()``.  ``host_stats`` breaks the host path
+  into plan/upload/dispatch/device/harvest per dispatch.
 """
 from __future__ import annotations
 
@@ -28,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import deepspeed_tpu.comm as dist
+from deepspeed_tpu.inference.common import HostStageStats
 from deepspeed_tpu.inference.config import (DeepSpeedInferenceConfig,
                                             load_inference_config)
 from deepspeed_tpu.inference.kv_cache import init_cache
@@ -62,6 +71,45 @@ def init_inference(model: Any, config: Any = None, params: Any = None,
                            rng=rng)
 
 
+class PendingGeneration:
+    """Deferred-harvest handle from :meth:`InferenceEngine.generate_async`.
+
+    The fused decode program is already dispatched (the device runs
+    asynchronously); :meth:`result` blocks on the ONE ``device_get`` and
+    caches the numpy tokens.  :meth:`device_array` exposes the device
+    buffer for callers chaining further device work (the bench harness's
+    overlap loop) without ever paying the host copy."""
+
+    def __init__(self, engine: "InferenceEngine", arr):
+        self._engine = engine
+        self._arr = arr
+        self._result: Optional[np.ndarray] = None
+
+    def device_array(self):
+        return self._arr
+
+    def ready(self) -> bool:
+        """True when the tokens can be read without blocking (already
+        harvested, or the device reports the buffer ready)."""
+        if self._result is not None:
+            return True
+        try:
+            return bool(self._arr.is_ready())
+        except AttributeError:      # pragma: no cover - old jax arrays
+            return True
+
+    def result(self) -> np.ndarray:
+        if self._result is None:
+            st = self._engine.host_stats
+            with st.stage("device"):
+                st.blocking_gets += 1
+                out = jax.device_get(self._arr)
+            st.harvests += 1
+            with st.stage("harvest"):
+                self._result = np.asarray(out)
+        return self._result
+
+
 class InferenceEngine:
     def __init__(self, model, config: DeepSpeedInferenceConfig, params=None,
                  topology=None, rng: Optional[jax.Array] = None,
@@ -74,6 +122,8 @@ class InferenceEngine:
         self.dtype = _DTYPES[config.dtype]
         self.module = model                      # API parity with reference
         self._param_source = param_source
+        self.host_stats = HostStageStats()
+        self.v2 = config.v2      # serving host-path knobs (pipeline, ...)
 
         tp_size = config.tensor_parallel.tp_size if config.tensor_parallel.enabled else 1
         dist.init_distributed()
@@ -338,19 +388,26 @@ class InferenceEngine:
 
         return jax.jit(gen)
 
-    def generate(self, input_ids, max_new_tokens: int = 128,
-                 do_sample: bool = False, temperature: float = 1.0,
-                 top_k: int = 0, top_p: float = 1.0,
-                 eos_token_id: Optional[int] = None,
-                 rng: Optional[jax.Array] = None) -> np.ndarray:
-        """Autoregressive generation: prefill + ``max_new_tokens`` fused
-        decode steps in one compiled program per (batch, prompt-len,
-        max-new) shape.  Returns ``[B, P + max_new_tokens]`` token ids."""
+    def generate_async(self, input_ids, max_new_tokens: int = 128,
+                       do_sample: bool = False, temperature: float = 1.0,
+                       top_k: int = 0, top_p: float = 1.0,
+                       eos_token_id: Optional[int] = None,
+                       rng: Optional[jax.Array] = None
+                       ) -> PendingGeneration:
+        """Dispatch the fused prefill+decode program and return WITHOUT
+        waiting for the device — the deferred-harvest half of
+        :meth:`generate`.  The returned :class:`PendingGeneration`
+        blocks only when ``result()`` is called, so a serving loop can
+        keep dispatching (the host path of call k+1 overlaps the device
+        work of call k) and harvest tokens in bulk."""
         if self._decode_model is None:
             raise TypeError(
                 "generate() needs a decoder model; encoder families "
                 "(BERT) serve through forward() only")
-        prompt = jnp.asarray(np.asarray(input_ids), jnp.int32)
+        st = self.host_stats
+        with st.stage("upload"):
+            st.meta_uploads += 1
+            prompt = jnp.asarray(np.asarray(input_ids), jnp.int32)
         assert prompt.ndim == 2, "input_ids must be [batch, prompt_len]"
         B, P = prompt.shape
         if self.config.max_batch_size and B > self.config.max_batch_size:
@@ -362,13 +419,37 @@ class InferenceEngine:
         assert P + max_new_tokens <= self.max_cache_len, (
             f"prompt {P} + max_new_tokens {max_new_tokens} exceeds "
             f"max_cache_len {self.max_cache_len} (raise max_out_tokens)")
-        key = (B, P, max_new_tokens, do_sample, temperature, top_k, top_p,
-               eos_token_id)
-        if key not in self._generate_cache:
-            self._generate_cache[key] = self._build_generate(
-                B, P, max_new_tokens, do_sample, temperature, top_k, top_p,
-                eos_token_id)
+        with st.stage("plan"):
+            key = (B, P, max_new_tokens, do_sample, temperature, top_k,
+                   top_p, eos_token_id)
+            if key not in self._generate_cache:
+                self._generate_cache[key] = self._build_generate(
+                    B, P, max_new_tokens, do_sample, temperature, top_k,
+                    top_p, eos_token_id)
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        return np.asarray(jax.device_get(
-            self._generate_cache[key](self._live_params(), prompt, rng)))
+        with st.stage("dispatch"):
+            st.dispatches += 1
+            arr = self._generate_cache[key](self._live_params(), prompt,
+                                            rng)
+        st.ticks += max_new_tokens
+        return PendingGeneration(self, arr)
+
+    def generate(self, input_ids, max_new_tokens: int = 128,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None,
+                 rng: Optional[jax.Array] = None) -> np.ndarray:
+        """Autoregressive generation: prefill + ``max_new_tokens`` fused
+        decode steps in one compiled program per (batch, prompt-len,
+        max-new) shape.  Returns ``[B, P + max_new_tokens]`` token ids.
+        (``generate_async`` is the non-blocking variant.)"""
+        return self.generate_async(
+            input_ids, max_new_tokens=max_new_tokens, do_sample=do_sample,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_token_id=eos_token_id, rng=rng).result()
+
+    def serving_stages(self) -> Dict[str, Any]:
+        """Per-dispatch host-path breakdown + ``host_bound_fraction``
+        (see :class:`~deepspeed_tpu.inference.common.HostStageStats`)."""
+        return self.host_stats.serving_stages()

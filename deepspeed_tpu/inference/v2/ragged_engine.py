@@ -40,6 +40,35 @@ Round-4 additions:
   1/K.  Finished sequences park on the trash page mid-block; the host
   reconstructs outputs from the per-tick produced mask.
 
+Round-5/6 addition — the **pipelined serving host path** (this repo's
+software-pipeline treatment, same shape as the NVMe moment stream): the
+round-5 verdict measured the ragged engine at 23.3k device tok/s but 295
+WALL tok/s — ~99% of serving wall time was host planning, per-tick
+``jnp.asarray`` metadata uploads, and a blocking ``device_get`` per
+dispatch.  With ``pipeline=True`` (default) the decode steady state runs
+as a software pipeline:
+
+- the decode-block carry (``last_tok``/``pos``/``active``/``remaining``)
+  and the per-tick metadata (``page_indices``/``kv_lens`` derivation
+  inputs, sampler configs, eos ids) stay RESIDENT on device across
+  dispatches — uploaded once at loop entry, re-uploaded only when the
+  page table actually grows;
+- while block *k* executes on device, the host plans block *k+1* from an
+  exact projection of each sequence's length/remaining budget (JAX
+  dispatch is async — the host never synchronizes per block, bounded by
+  ``async_depth`` blocks in flight);
+- sampled tokens accumulate on device and are harvested (one
+  ``device_get``) every ``harvest_interval`` blocks.  EOS/finish
+  detection stays device-side (the decode block's ``active`` carry).
+
+Harvests are FORCED at every point where the unpipelined engine could
+have reaped, admitted, or evicted (a possible finish, a newly admittable
+request, page-growth failure), so the dispatch sequence — programs,
+metadata values, and rng splits — is identical to ``pipeline=False`` and
+outputs are bit-identical, greedy or seeded-sampling.  ``host_stats``
+(:class:`~deepspeed_tpu.inference.common.HostStageStats`) breaks the
+host path into plan/upload/dispatch/device/harvest per dispatch.
+
 Host-side scheduling (admission, chunk budgeting, finish detection) is
 plain Python — the reference's scheduler tier is host-side too.  Models:
 anything llama-shaped in the zoo (Llama, Mistral, Qwen2, Mixtral, ... —
@@ -57,6 +86,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deepspeed_tpu.inference.common import HostStageStats
 from deepspeed_tpu.inference.paged import (PageAllocator,
                                            pages_for)
 from deepspeed_tpu.inference.sampling import (sample_logits,
@@ -115,7 +145,11 @@ class RaggedInferenceEngineV2:
                  decode_block_size: int = 8,
                  kv_cache_dtype: str = "none",
                  quantize_weights: Optional[str] = None,
-                 kv_reserve: str = "on_demand"):
+                 kv_reserve: str = "on_demand",
+                 pipeline: Optional[bool] = None,
+                 async_depth: Optional[int] = None,
+                 harvest_interval: Optional[int] = None,
+                 config: Any = None):
         """``kv_cache_dtype``: "none" | "fp8" | "int8" — paged KV pool
         storage format (reference fp_quantizer KV quantization).
         ``quantize_weights``: None | "int8" | "fp8" | "fp6" | "w8a8" —
@@ -129,7 +163,15 @@ class RaggedInferenceEngineV2:
         admit on prompt-size pages, grow per decode block, evict +
         requeue as a continuation when the pool runs dry) or
         "worst_case" (reserve prompt + max_new_tokens at admission; no
-        mid-flight out-of-pages state, lower concurrency per byte)."""
+        mid-flight out-of-pages state, lower concurrency per byte).
+        ``pipeline``/``async_depth``/``harvest_interval``: the serving
+        host-path pipeline knobs (module docstring).  Defaults come from
+        ``config`` (a ``DeepSpeedInferenceConfig``/dict with a ``v2``
+        subtree: ``inference.v2.pipeline`` default-on, ``async_depth``
+        2, ``harvest_interval`` 4); explicit kwargs win.
+        ``pipeline=False`` preserves the unpipelined host loop exactly
+        — one blocking harvest and a fresh metadata upload per
+        dispatch."""
         mcfg = getattr(model, "config", None)
         assert dataclasses.is_dataclass(mcfg) and hasattr(mcfg, "decode"), \
             "ragged engine needs a model-zoo module with a decode config"
@@ -175,6 +217,28 @@ class RaggedInferenceEngineV2:
         self.kv_reserve = kv_reserve
         self.evictions = 0
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        if config is not None:
+            from deepspeed_tpu.inference.config import \
+                load_inference_config
+
+            v2cfg = load_inference_config(config).v2
+            pipeline = v2cfg.pipeline if pipeline is None else pipeline
+            async_depth = (v2cfg.async_depth if async_depth is None
+                           else async_depth)
+            harvest_interval = (v2cfg.harvest_interval
+                                if harvest_interval is None
+                                else harvest_interval)
+        self.pipeline = True if pipeline is None else bool(pipeline)
+        self.async_depth = max(
+            int(async_depth) if async_depth is not None else 2, 1)
+        self.harvest_interval = max(
+            int(harvest_interval) if harvest_interval is not None else 4,
+            1)
+        self.host_stats = HostStageStats()
+        # device-resident decode-loop state while the pipeline runs
+        # ahead of the host (None <=> host state is authoritative)
+        self._dev: Optional[Dict[str, Any]] = None
 
         from deepspeed_tpu.inference.common import normalize_params
 
@@ -255,6 +319,8 @@ class RaggedInferenceEngineV2:
             f"max_seq_len={max_seq_len} prefill_chunk={prefill_chunk} "
             f"pages={self.num_pages}x{self.page_size} tp={self.tp} "
             f"decode_block={self.decode_block_size} "
+            f"pipeline={self.pipeline} depth={self.async_depth} "
+            f"harvest={self.harvest_interval} "
             f"(paged KV, fused SplitFuse step)", ranks=[0])
 
     # -- parameter / cache placement (TP) --------------------------------
@@ -312,16 +378,34 @@ class RaggedInferenceEngineV2:
     # -- request API ----------------------------------------------------
 
     def put_request(self, prompt, **kw) -> int:
+        """Queue a request; raises ``ValueError`` AT SUBMIT TIME for a
+        request that could never be scheduled (a prompt + budget beyond
+        ``max_seq_len``, or needing more KV pages than the whole pool
+        holds even after evicting every other sequence) — admitting one
+        would deadlock the FIFO queue behind an unschedulable head.
+        (``ValueError``, not ``assert``: these guard USER input and must
+        stay loud under ``python -O``.)"""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        assert prompt.size > 0
-        assert kw.get("max_new_tokens", 64) >= 1, (
-            "max_new_tokens must be >= 1 (prefill seeds the first token)")
-        total = prompt.size + kw.get("max_new_tokens", 64)
-        assert total <= self.max_seq_len, \
-            "prompt + max_new_tokens exceeds max_seq_len"
-        assert self.allocator.pages_for(total) <= self.num_pages - 1, (
-            "request needs more KV pages than the engine owns — raise "
-            "num_pages")
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        max_new = int(kw.get("max_new_tokens", 64))
+        if max_new < 1:
+            raise ValueError(
+                "max_new_tokens must be >= 1 (prefill seeds the first "
+                "token)")
+        total = prompt.size + max_new
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new}) = "
+                f"{total} exceeds the engine token budget "
+                f"max_seq_len={self.max_seq_len} — the request can never "
+                "be scheduled; shorten the prompt or raise max_seq_len")
+        if self.allocator.pages_for(total) > self.num_pages - 1:
+            raise ValueError(
+                f"request needs {self.allocator.pages_for(total)} KV "
+                f"pages but the engine owns {self.num_pages - 1} usable "
+                "pages — even after evicting every other sequence it "
+                "could never be scheduled; raise num_pages")
         req = Request(uid=next(self._uid), prompt=prompt, **kw)
         self.waiting.append(req)
         return req.uid
@@ -337,6 +421,39 @@ class RaggedInferenceEngineV2:
 
     def has_work(self) -> bool:
         return bool(self.waiting) or any(s is not None for s in self.slots)
+
+    def sync(self) -> int:
+        """Fold any pipelined in-flight device work back into host
+        request state (deferred-harvest flush); returns the tokens
+        folded.  No-op when the pipeline is off or idle.  Callers that
+        read ``slots[*].generated`` / ``finished`` between ``step()``
+        calls (benchmark boundaries, draining shutdown) call this
+        first."""
+        if self._dev is None:
+            return 0
+        return self._pipeline_harvest()
+
+    def serving_stages(self) -> Dict[str, Any]:
+        """Per-dispatch host-path breakdown + ``host_bound_fraction``
+        (see :class:`~deepspeed_tpu.inference.common.HostStageStats`)."""
+        return self.host_stats.serving_stages()
+
+    # -- host<->device funnels (every transfer is counted/timed) ---------
+
+    def _upload(self, x):
+        """Host -> device metadata transfer.  The pipelined decode loop
+        must NOT call this in steady state (metadata is device-resident;
+        ``host_stats.meta_uploads`` asserts it in tests)."""
+        with self.host_stats.stage("upload"):
+            self.host_stats.meta_uploads += 1
+            return jnp.asarray(x)
+
+    def _fetch(self, tree):
+        """Blocking device -> host fetch — the serving loop's only sync
+        point (``host_stats.blocking_gets`` counts them)."""
+        with self.host_stats.stage("device"):
+            self.host_stats.blocking_gets += 1
+            return jax.device_get(tree)
 
     # -- compiled fused step ---------------------------------------------
 
@@ -478,15 +595,18 @@ class RaggedInferenceEngineV2:
                 tick, (cache, last_tok, pos, active, remaining, rng),
                 length=K)
             cache, last_tok, pos, active, remaining, rng = carry
-            return cache, last_tok, toks, mask, rng
+            # the full carry returns so the pipelined host path can keep
+            # it device-resident across dispatches (no re-upload)
+            return cache, last_tok, pos, active, remaining, toks, mask
 
         fn = jax.jit(run, donate_argnums=(1,))
         self._decode_block_cache[sampled] = fn
         return fn
 
-    def _step_decode_block(self, reqs: List[Request]) -> int:
-        """Run one on-device decode block and fold results back into the
-        host request state."""
+    def _block_arrays(self, reqs: List[Request]):
+        """Host numpy decode-block state for ``reqs`` (shared by the
+        unpipelined per-block rebuild and the pipelined loop's one-time
+        entry upload)."""
         S = self.max_seqs
         last_tok = np.asarray(self._last_tokens, np.int32)
         pos = np.zeros((S,), np.int32)
@@ -508,49 +628,244 @@ class RaggedInferenceEngineV2:
             temperature[s] = r.temperature
             top_k[s] = r.top_k
             top_p[s] = r.top_p
-        sampled = bool(do_sample.any())
-        self.rng, sub = jax.random.split(self.rng)
-        cache, new_last, toks, mask, _ = self._decode_block_fn(sampled)(
-            self.params, self.cache, jnp.asarray(last_tok),
-            jnp.asarray(pos), jnp.asarray(active), jnp.asarray(remaining),
-            jnp.asarray(self.page_table), jnp.asarray(eos_ids),
-            jnp.asarray(do_sample), jnp.asarray(temperature),
-            jnp.asarray(top_k), jnp.asarray(top_p), sub)
-        self.cache = cache
-        toks = np.asarray(jax.device_get(toks))         # [K, S]
-        mask = np.asarray(jax.device_get(mask))         # [K, S]
-        # np.array: device_get returns a READ-ONLY view; the SplitFuse
-        # tick assigns into _last_tokens per sampled token
-        self._last_tokens = np.array(jax.device_get(new_last))
+        return (last_tok, pos, active, remaining, eos_ids, do_sample,
+                temperature, top_k, top_p)
+
+    def _fold_block(self, reqs: List[Request], toks: np.ndarray,
+                    mask: np.ndarray) -> int:
+        """Fold one harvested [K, S] block into request state."""
         produced = 0
         for r in reqs:
-            s = r.slot
-            new = toks[mask[:, s], s]
+            new = toks[mask[:, r.slot], r.slot]
             r.generated.extend(int(t) for t in new)
             produced += int(new.size)
-            self._maybe_finish(r)
-        self._reap()
+        return produced
+
+    def _step_decode_block(self, reqs: List[Request]) -> int:
+        """Run one on-device decode block and fold results back into the
+        host request state (the ``pipeline=False`` path: fresh metadata
+        upload + one blocking harvest per block)."""
+        st = self.host_stats
+        with st.stage("plan"):
+            (last_tok, pos, active, remaining, eos_ids, do_sample,
+             temperature, top_k, top_p) = self._block_arrays(reqs)
+            sampled = bool(do_sample.any())
+        self.rng, sub = jax.random.split(self.rng)
+        args = [self._upload(a) for a in
+                (last_tok, pos, active, remaining, self.page_table,
+                 eos_ids, do_sample, temperature, top_k, top_p)]
+        with st.stage("dispatch"):
+            st.dispatches += 1
+            (cache, new_last, _pos, _active, _remaining, toks,
+             mask) = self._decode_block_fn(sampled)(
+                self.params, self.cache, *args, sub)
+        self.cache = cache
+        st.ticks += self.decode_block_size
+        toks, mask, new_last = self._fetch((toks, mask, new_last))
+        st.harvests += 1
+        with st.stage("harvest"):
+            toks = np.asarray(toks)                     # [K, S]
+            mask = np.asarray(mask)                     # [K, S]
+            # np.array: device_get returns a READ-ONLY view; the
+            # SplitFuse tick assigns into _last_tokens per sampled token
+            self._last_tokens = np.array(new_last)
+            produced = self._fold_block(reqs, toks, mask)
+            for r in reqs:
+                self._maybe_finish(r)
+            self._reap()
+        return produced
+
+    # -- the pipelined decode loop (serving host-path tentpole) ----------
+
+    def _admittable(self) -> bool:
+        """Would the unpipelined engine admit the queue head right now?
+        Evaluated from EXACT global state (allocator + slots), so the
+        pipelined loop reconciles at precisely the steps where
+        ``pipeline=False`` would have admitted."""
+        if not self.waiting or not any(s is None for s in self.slots):
+            return False
+        req = self.waiting[0]
+        ctx_len = req.ctx_len
+        if self.kv_reserve == "worst_case":
+            need = ctx_len + req.max_new_tokens - len(req.generated)
+        else:
+            need = ctx_len + min(self.decode_block_size,
+                                 max(req.max_new_tokens -
+                                     len(req.generated), 1))
+        return self.allocator.can_allocate(need)
+
+    def _pipeline_start(self, reqs: List[Request]) -> None:
+        """Enter the pipelined decode loop: upload the decode-block
+        carry and sampler metadata ONCE; subsequent blocks chain
+        device-resident state (zero steady-state uploads)."""
+        with self.host_stats.stage("plan"):
+            (last_tok, pos, active, remaining, eos_ids, do_sample,
+             temperature, top_k, top_p) = self._block_arrays(reqs)
+            S = self.max_seqs
+            # exact host projection of per-slot cache length and token
+            # budget — for eos-free sequences the device's active/
+            # remaining carry is a deterministic function of these, so
+            # the host can plan ahead without syncing; eos-bearing
+            # sequences force a harvest every block (finish_possible)
+            plen = np.zeros((S,), np.int64)
+            rem = np.zeros((S,), np.int64)
+            has_eos = np.zeros((S,), bool)
+            for r in reqs:
+                plen[r.slot] = r.length
+                rem[r.slot] = remaining[r.slot]
+                has_eos[r.slot] = r.eos_token_id is not None
+        self._dev = {
+            "reqs": list(reqs),
+            "sampled": bool(do_sample.any()),
+            "last_tok": self._upload(last_tok),
+            "pos": self._upload(pos),
+            "active": self._upload(active),
+            "remaining": self._upload(remaining),
+            "page_table": self._upload(self.page_table),
+            "eos_ids": self._upload(eos_ids),
+            "do_sample": self._upload(do_sample),
+            "temperature": self._upload(temperature),
+            "top_k": self._upload(top_k),
+            "top_p": self._upload(top_p),
+            "plen": plen, "rem": rem, "has_eos": has_eos,
+            "pending": [],                # un-harvested (toks, mask)
+        }
+
+    def _pipeline_step(self) -> int:
+        """One pipelined iteration: plan + dispatch block k+1 while the
+        device still runs block k; harvest only when forced."""
+        dv = self._dev
+        st = self.host_stats
+        K = self.decode_block_size
+        # a queued request became admittable (put_request arrived, or a
+        # reap freed capacity): reconcile so the normal path admits it
+        # exactly when the unpipelined engine would
+        if self._admittable():
+            return self._pipeline_harvest(teardown=True)
+        with st.stage("plan"):
+            # grow pages to cover the next block — exact, because the
+            # projection is exact for every sequence that can reach this
+            # point un-harvested (see _pipeline_start)
+            slots_active = [r.slot for r in dv["reqs"]
+                            if dv["rem"][r.slot] > 0 and
+                            dv["plen"][r.slot] < self.max_seq_len]
+            grow_ok = bool(slots_active)
+            table_dirty = False
+            for s in slots_active:
+                want = int(min(dv["plen"][s] + min(K, dv["rem"][s]),
+                               self.max_seq_len))
+                before = self.allocator.owned(s)
+                if not self._ensure_pages(s, want):
+                    grow_ok = False
+                    break
+                table_dirty |= self.allocator.owned(s) != before
+        if not grow_ok:
+            # out of pages (or nothing left to run): reconcile and hand
+            # control back to the normal path (stall/evict semantics)
+            return self._pipeline_harvest(teardown=True)
+        if table_dirty:
+            dv["page_table"] = self._upload(self.page_table)
+        self.rng, sub = jax.random.split(self.rng)
+        with st.stage("dispatch"):
+            st.dispatches += 1
+            (self.cache, dv["last_tok"], dv["pos"], dv["active"],
+             dv["remaining"], toks, mask) = self._decode_block_fn(
+                dv["sampled"])(
+                self.params, self.cache, dv["last_tok"], dv["pos"],
+                dv["active"], dv["remaining"], dv["page_table"],
+                dv["eos_ids"], dv["do_sample"], dv["temperature"],
+                dv["top_k"], dv["top_p"], sub)
+        dv["pending"].append((toks, mask))
+        st.ticks += K
+        with st.stage("plan"):
+            # advance the projection past this block and decide whether
+            # the unpipelined engine could have reaped after it
+            finish_possible = False
+            for s in slots_active:
+                prod = int(min(K, dv["rem"][s],
+                               self.max_seq_len - dv["plen"][s]))
+                dv["rem"][s] -= prod
+                dv["plen"][s] += prod
+                if (dv["has_eos"][s] or dv["rem"][s] <= 0 or
+                        dv["plen"][s] >= self.max_seq_len):
+                    finish_possible = True
+        if len(dv["pending"]) > self.async_depth:
+            # bound device run-ahead without harvesting: wait for the
+            # (now - depth)-th block; in-order execution keeps at most
+            # async_depth programs queued behind it
+            with st.stage("device"):
+                jax.block_until_ready(
+                    dv["pending"][-self.async_depth - 1][0])
+        if finish_possible or len(dv["pending"]) >= self.harvest_interval:
+            return self._pipeline_harvest()
+        return 0
+
+    def _pipeline_harvest(self, teardown: bool = False) -> int:
+        """Fold every pending block back into host request state (ONE
+        blocking fetch), reap finishes, and either keep the
+        device-resident carry (nothing changed) or tear down so the
+        normal path re-plans."""
+        dv = self._dev
+        st = self.host_stats
+        st.harvests += 1
+        toks_l, mask_l, last_tok = self._fetch((
+            [t for t, _ in dv["pending"]],
+            [m for _, m in dv["pending"]], dv["last_tok"]))
+        with st.stage("harvest"):
+            # np.array: device_get returns READ-ONLY views
+            self._last_tokens = np.array(last_tok)
+            produced = 0
+            for toks, mask in zip(toks_l, mask_l):
+                produced += self._fold_block(
+                    dv["reqs"], np.asarray(toks), np.asarray(mask))
+            for r in dv["reqs"]:
+                self._maybe_finish(r)
+            changed = any(r.done for r in dv["reqs"])
+            self._reap()
+            dv["pending"] = []
+            if teardown or changed:
+                self._dev = None
+            else:
+                # device carry stays authoritative; re-anchor the host
+                # projection on the now-exact lengths
+                for r in dv["reqs"]:
+                    dv["plen"][r.slot] = r.length
+                    dv["rem"][r.slot] = (r.max_new_tokens -
+                                         len(r.generated))
         return produced
 
     # -- the scheduler tick ----------------------------------------------
 
     def step(self) -> int:
-        """One engine iteration; returns the number of tokens produced.
+        """One engine iteration; returns the number of tokens produced
+        (0 for pipelined iterations whose harvest is still deferred —
+        the tokens are counted at the harvest step).
 
         All-decoding batches take the multi-tick on-device block (K
-        tokens per sequence per host dispatch); any prefilling sequence
+        tokens per sequence per host dispatch) — pipelined across
+        dispatches when ``pipeline=True``; any prefilling sequence
         falls back to the fused SplitFuse tick."""
-        self._admit()
-        live = [r for r in self.slots if r is not None and not r.done]
-        if (self.decode_block_size > 1 and live and
+        if self._dev is not None:
+            return self._pipeline_step()
+        st = self.host_stats
+        with st.stage("plan"):
+            self._admit()
+            live = [r for r in self.slots if r is not None and not r.done]
+            all_decoding = (
+                self.decode_block_size > 1 and live and
                 all(r.prefill_done >= r.ctx_len for r in live) and
                 all(self._ensure_pages(
                     r.slot,
                     r.length + min(self.decode_block_size,
                                    r.max_new_tokens - len(r.generated)))
-                    for r in live)):
+                    for r in live))
+        if all_decoding:
+            if self.pipeline:
+                self._pipeline_start(live)
+                return self._pipeline_step()
             return self._step_decode_block(live)
-        plan = self._plan_tick()
+        with st.stage("plan"):
+            plan = self._plan_tick()
         if plan is None:
             self._reap()
             # every live sequence is page-stalled: evict the youngest as
@@ -569,12 +884,14 @@ class RaggedInferenceEngineV2:
             return 0
         (token_ids, positions, kv_lens, page_indices, cu_q_lens, num_seqs,
          new_kv_dest, sample_rows, samplers) = plan
-        sel_logits, self.cache = self._fused_step_fn()(
-            self.params, self.cache,
-            jnp.asarray(token_ids[None]), jnp.asarray(positions[None]),
-            jnp.asarray(kv_lens), jnp.asarray(page_indices),
-            jnp.asarray(cu_q_lens), jnp.asarray(num_seqs),
-            jnp.asarray(new_kv_dest), jnp.asarray(sample_rows))
+        args = [self._upload(a) for a in
+                (token_ids[None], positions[None], kv_lens, page_indices,
+                 cu_q_lens, num_seqs, new_kv_dest, sample_rows)]
+        with st.stage("dispatch"):
+            st.dispatches += 1
+            sel_logits, self.cache = self._fused_step_fn()(
+                self.params, self.cache, *args)
+        st.ticks += 1
         produced = self._sample(sel_logits, samplers)
         self._reap()
         return produced
@@ -599,6 +916,17 @@ class RaggedInferenceEngineV2:
                 need = req.ctx_len + min(self.decode_block_size,
                                          max(req.max_new_tokens -
                                              len(req.generated), 1))
+            if self.allocator.pages_for(need) > self.num_pages - 1:
+                # defense in depth behind put_request's submit-time
+                # check: an unschedulable head would deadlock the FIFO
+                # queue forever — drop it and fail loudly
+                self.waiting.popleft()
+                raise ValueError(
+                    f"request uid={req.uid} needs "
+                    f"{self.allocator.pages_for(need)} KV pages to admit "
+                    f"({need} tokens) but the engine owns "
+                    f"{self.num_pages - 1} usable pages — it can never "
+                    "be scheduled, even after full eviction")
             if not self.allocator.can_allocate(need):
                 break                      # FIFO: wait for pages to free
             self.waiting.popleft()
@@ -762,14 +1090,16 @@ class RaggedInferenceEngineV2:
             sub = None
             if do_sample:
                 self.rng, sub = jax.random.split(self.rng)
-            toks = np.asarray(sample_logits(
+            dev_toks = sample_logits(
                 sel_logits[rows], sub, do_sample=do_sample,
-                temperature=temp, top_k=top_k, top_p=top_p))
-            for (r, _), tok in zip(pairs, toks):
-                r.generated.append(int(tok))
-                self._last_tokens[r.slot] = int(tok)
-                produced += 1
-                self._maybe_finish(r)
+                temperature=temp, top_k=top_k, top_p=top_p)
+            toks = np.asarray(self._fetch(dev_toks))
+            with self.host_stats.stage("harvest"):
+                for (r, _), tok in zip(pairs, toks):
+                    r.generated.append(int(tok))
+                    self._last_tokens[r.slot] = int(tok)
+                    produced += 1
+                    self._maybe_finish(r)
         return produced
 
     def _maybe_finish(self, req: Request) -> None:

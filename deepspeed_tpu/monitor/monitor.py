@@ -168,6 +168,20 @@ class MonitorMaster(Monitor):
         self.write_events([(f"Sdc/{name}", float(value), step)
                            for name, value in sorted(sdc_counters.items())])
 
+    def write_serving_health(self, serving_stages: dict,
+                             step: int) -> None:
+        """Surface a serving engine's host-path breakdown
+        (``engine.serving_stages()`` — per-dispatch
+        plan/upload/dispatch/device/harvest ms plus
+        ``host_bound_fraction``) as ``Serving/*`` series.  A serving
+        fleet whose ``Serving/host_bound_fraction`` climbs toward 1.0
+        is wasting its accelerators on host scheduling — the signal the
+        pipelined host path exists to drive down."""
+        self.write_events([(f"Serving/{name}", float(value), step)
+                           for name, value in sorted(
+                               serving_stages.items())
+                           if isinstance(value, (int, float))])
+
     def write_comm_health(self, straggler_report: dict, step: int) -> None:
         """Surface the cross-rank straggler report
         (``comm.straggler_report()``) as metric events: per-op latency

@@ -23,6 +23,10 @@ def main() -> None:
     p.add_argument("--max-seqs", type=int, default=4)
     p.add_argument("--max-seq-len", type=int, default=256)
     p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--no-pipeline", action="store_true",
+                   help="disable the serving host-path pipeline "
+                        "(per-dispatch blocking harvest)")
+    p.add_argument("--harvest-interval", type=int, default=4)
     args = p.parse_args()
 
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -41,7 +45,9 @@ def main() -> None:
 
     engine = RaggedInferenceEngineV2(
         model, params=params, max_seqs=args.max_seqs,
-        max_seq_len=args.max_seq_len, prefill_chunk=64)
+        max_seq_len=args.max_seq_len, prefill_chunk=64,
+        pipeline=not args.no_pipeline,
+        harvest_interval=args.harvest_interval)
 
     # a burst of variable-length "requests"
     rng = np.random.default_rng(0)
@@ -58,6 +64,11 @@ def main() -> None:
         for uid, tokens in engine.get_outputs():
             print(f"[step {step}] request {uid} done: "
                   f"{tokens.size} tokens -> {tokens[-8:].tolist()}")
+    stages = engine.serving_stages()
+    print("serving stages (per dispatch): " +
+          " ".join(f"{k}={stages[k]}" for k in
+                   ("plan_ms", "upload_ms", "dispatch_ms", "device_ms",
+                    "harvest_ms", "host_bound_fraction")))
 
 
 if __name__ == "__main__":

@@ -136,6 +136,30 @@ def test_generate_sampling_temperature_topk(devices):
     assert (a[:, 4:] < cfg.vocab_size).all() and (a[:, 4:] >= 0).all()
 
 
+def test_generate_async_deferred_harvest(devices):
+    """v1 deferred harvest (serving host-path pipeline): generate_async
+    dispatches without blocking; result() pays the single device_get and
+    matches the blocking generate() bit-for-bit."""
+    from deepspeed_tpu.models.gpt2 import GPT2Model
+
+    cfg = _gpt2_cfg()
+    model = GPT2Model(cfg)
+    prompt = np.ones((2, 4), np.int32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(prompt))["params"]
+    engine = deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "max_out_tokens": 32},
+        params=params)
+    ref = engine.generate(prompt, max_new_tokens=6)
+    engine.host_stats.reset()
+    h = engine.generate_async(prompt, max_new_tokens=6)
+    assert engine.host_stats.blocking_gets == 0      # deferred
+    np.testing.assert_array_equal(h.result(), ref)
+    assert engine.host_stats.blocking_gets == 1      # harvested once
+    stages = engine.serving_stages()
+    assert {"plan_ms", "upload_ms", "dispatch_ms", "device_ms",
+            "harvest_ms", "host_bound_fraction"} <= set(stages)
+
+
 def test_engine_tp_sharded_generation(devices):
     """TP=2 serving: params sharded over `tensor`, same greedy tokens."""
     from deepspeed_tpu.models.llama import LlamaForCausalLM

@@ -128,7 +128,7 @@ class TestScheduling:
 
     def test_request_validation(self, params):
         eng = make_v2(params, max_seq_len=16)
-        with pytest.raises(AssertionError):
+        with pytest.raises(ValueError, match="max_seq_len"):
             eng.put_request(np.ones(10, np.int32), max_new_tokens=20)
 
     def test_sampling_path_runs(self, params):
@@ -434,6 +434,6 @@ class TestOnDemandPaging:
         eng = make_v2(params, max_seqs=2, max_seq_len=128,
                       prefill_chunk=16, page_size=16, num_pages=4,
                       kv_reserve="on_demand")
-        with pytest.raises(AssertionError):
+        with pytest.raises(ValueError, match="never be scheduled"):
             # needs 8 pages total, pool has 3 usable
             eng.put_request(_prompts([16])[0], max_new_tokens=112)

@@ -64,6 +64,29 @@ def test_comet_monitor_disabled_without_sdk(monkeypatch):
     m.write_events([("x", 1.0, 1)])      # no-op
 
 
+def test_serving_health_events(tmp_path):
+    """write_serving_health streams the serving host-path breakdown as
+    Serving/* series, dropping non-numeric entries."""
+    from deepspeed_tpu.config.config import MonitorConfig
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+
+    mc = MonitorConfig(csv_monitor=CSVConfig(enabled=True,
+                                             output_path=str(tmp_path),
+                                             job_name="serve"))
+    master = MonitorMaster(mc)
+    master.write_serving_health(
+        {"plan_ms": 0.4, "device_ms": 3.1, "host_bound_fraction": 0.12,
+         "dispatches": 42, "device": "cpu-string-skipped",
+         "host_bound_fraction_note": None}, step=7)
+    out = tmp_path / "serve"
+    with open(out / "Serving_host_bound_fraction.csv") as f:
+        rows = list(csv.reader(f))
+    assert rows[1] == ["7", "0.12"]
+    assert (out / "Serving_plan_ms.csv").exists()
+    assert (out / "Serving_dispatches.csv").exists()
+    assert not (out / "Serving_device.csv").exists()
+
+
 def test_master_includes_comet(fake_comet):
     from deepspeed_tpu.config.config import MonitorConfig
     from deepspeed_tpu.monitor.monitor import MonitorMaster
