@@ -701,6 +701,52 @@ def bench_ragged(args) -> None:
         "host_bound_fraction": off_stages["host_bound_fraction"],
         "serving_stages": off_stages}
 
+    # speculative decoding: ngram (prompt-lookup, no second model), a
+    # small random draft model (machinery cost at worst-case ~0
+    # acceptance — random weights give the drafter nothing to learn
+    # from), and self-draft (draft == target: the draft-quality upper
+    # bound, isolating the verify/rollback machinery's ceiling).  The
+    # spec-off control is the base run above.  `tokens_per_target_pass`
+    # (= 1 + mean accepted length) is the per-weight-read amortization
+    # speculation exists to raise.
+    base_wall_tps = gen_tokens / wall
+    import dataclasses as _dc
+    draft_cfg = _dc.replace(
+        cfg, num_hidden_layers=max(1, cfg.num_hidden_layers // 4),
+        scan_layers=False)
+    draft_params = jax.jit(LlamaModel(draft_cfg).init)(
+        jax.random.PRNGKey(1), np.ones((1, 2), np.int32),
+        positions=np.zeros((1, 2), np.int32))
+    spec_runs = {
+        "ngram": dict(speculation="ngram"),
+        "draft": dict(speculation="draft",
+                      draft_model=LlamaModel(draft_cfg),
+                      draft_params=draft_params),
+        "self_draft": dict(speculation="draft",
+                           draft_model=LlamaModel(cfg),
+                           draft_params={"params": params}),
+    }
+    detail["speculation"] = {
+        "off_control": {"wall_tokens_per_sec": round(base_wall_tps, 1),
+                        "tokens_per_dispatch": round(
+                            gen_tokens / max(dispatches, 1), 1)}}
+    for sname, skw in spec_runs.items():
+        st_, sd_, swall, sdev, seng = _ragged_run(
+            model, {"params": params}, decode_block=decode_block,
+            **run_kw, **skw)
+        ss = seng.serving_stages()
+        brk = dict(ss.get("speculation") or {})
+        if brk:
+            brk["tokens_per_target_pass"] = round(
+                1.0 + brk["mean_accepted_len"], 3)
+        detail["speculation"][sname] = {
+            "wall_tokens_per_sec": round(st_ / swall, 1),
+            "tokens_per_sec": round(st_ / (sdev if sdev else swall), 1),
+            "speedup_vs_off_wall": round((st_ / swall) /
+                                         max(base_wall_tps, 1e-9), 3),
+            "dispatches": sd_,
+            "breakdown": brk}
+
     # decode-block sweep: on-device sampling makes larger K nearly free
     # in device time and divides the host-dispatch count by K
     best_tps = gen_tokens / best_s

@@ -31,9 +31,17 @@ class HostStageStats:
     Counters make the pipelining contract testable: ``meta_uploads``
     and ``blocking_gets`` must stay flat across steady-state decode
     blocks when the pipeline is on.
+
+    Speculative decoding adds two host stages — ``draft`` (draft-model
+    KV catch-up prefill + host-side draft planning) and ``verify`` (the
+    fused draft+verify block's dispatch bracket; program handoff time,
+    not device time) — and the ``spec_*`` counters.  When any
+    speculative block ran, ``serving_stages()`` carries a
+    ``speculation`` sub-dict with the acceptance breakdown.
     """
 
-    STAGES = ("plan", "upload", "dispatch", "device", "harvest")
+    STAGES = ("plan", "upload", "dispatch", "device", "harvest", "draft",
+              "verify")
 
     def __init__(self):
         self.reset()
@@ -45,6 +53,10 @@ class HostStageStats:
         self.meta_uploads = 0     # host->device metadata arrays sent
         self.blocking_gets = 0    # blocking device->host fetches
         self.harvests = 0         # deferred-harvest fold-backs
+        self.spec_dispatches = 0  # speculative draft+verify blocks
+        self.spec_proposed = 0    # draft tokens proposed (device count)
+        self.spec_accepted = 0    # draft tokens accepted (device count)
+        self.spec_tokens = 0      # tokens emitted by speculative blocks
 
     @contextmanager
     def stage(self, name: str):
@@ -60,7 +72,8 @@ class HostStageStats:
             f"{s}_ms": round(self.seconds[s] * 1e3 / d, 4)
             for s in self.STAGES}
         host = sum(self.seconds[s] for s in
-                   ("plan", "upload", "dispatch", "harvest"))
+                   ("plan", "upload", "dispatch", "harvest", "draft",
+                    "verify"))
         dev = self.seconds["device"]
         out["host_s"] = round(host, 4)
         out["device_wait_s"] = round(dev, 4)
@@ -70,6 +83,23 @@ class HostStageStats:
                    meta_uploads=self.meta_uploads,
                    blocking_gets=self.blocking_gets,
                    harvests=self.harvests)
+        if self.spec_dispatches:
+            sd = self.spec_dispatches
+            out["speculation"] = {
+                "spec_dispatches": sd,
+                "draft_ms": round(self.seconds["draft"] * 1e3 / sd, 4),
+                "verify_ms": round(self.seconds["verify"] * 1e3 / sd, 4),
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "acceptance_rate": round(
+                    self.spec_accepted / max(self.spec_proposed, 1), 4),
+                "mean_accepted_len": round(
+                    self.spec_accepted / max(self.spec_tokens -
+                                             self.spec_accepted, 1), 4),
+                "tokens": self.spec_tokens,
+                "effective_tokens_per_dispatch": round(
+                    self.spec_tokens / sd, 2),
+            }
         return out
 
 

@@ -34,6 +34,38 @@ class QuantConfig(ConfigModel):
     group_size: int = 128
 
 
+class SpeculationConfig(ConfigModel):
+    """``v2.speculation`` subtree: speculative decoding on the ragged
+    engine's decode-block path.
+
+    ``mode``: ``off`` | ``ngram`` (prompt-lookup drafting from the
+    sequence's own emitted+prompt tokens — no second model) | ``draft``
+    (a small same-vocab family member proposes; the engine needs the
+    draft module+params passed programmatically, ``draft_model`` here
+    names a model-zoo preset for CLIs/benches to construct).
+    ``k``: drafted tokens per speculative tick — the target scores all
+    ``k+1`` positions in ONE ragged dispatch, so one weight pass
+    amortizes over up to ``k+1`` emitted tokens.
+    ``ngram``: the lookup n-gram length for ``mode=ngram``."""
+
+    mode: str = "off"
+    k: int = 4
+    ngram: int = 3
+    draft_model: Optional[str] = None
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.mode not in ("off", "ngram", "draft"):
+            raise ValueError(
+                f"speculation.mode must be off|ngram|draft, got "
+                f"{self.mode!r}")
+        if self.k < 1:
+            raise ValueError("speculation.k must be >= 1")
+        if self.ngram < 1:
+            raise ValueError("speculation.ngram must be >= 1")
+        return self
+
+
 class InferenceV2Config(ConfigModel):
     """``v2`` subtree: the serving host-path pipeline knobs.
 
@@ -50,6 +82,8 @@ class InferenceV2Config(ConfigModel):
     pipeline: bool = True
     async_depth: int = 2
     harvest_interval: int = 4
+    speculation: SpeculationConfig = Field(
+        default_factory=SpeculationConfig)
 
     @model_validator(mode="after")
     def _positive(self):

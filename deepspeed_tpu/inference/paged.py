@@ -98,6 +98,26 @@ class PageAllocator:
     def free(self, slot: int) -> None:
         self._free.extend(reversed(self._owned.pop(slot, [])))
 
+    def audit(self) -> Dict[str, int]:
+        """Conservation check for the pool: every non-trash page is
+        accounted for exactly once (free xor owned, no duplicates).
+        Raises ``AssertionError`` on a leak or double-grant; returns the
+        counts.  The speculative-decoding rollback path keeps pages it
+        over-allocated for rejected draft positions (they cover the very
+        next block's writes), so accounting exactness — not
+        owned==pages_for(length) minimality — is the invariant."""
+        owned = [p for pages in self._owned.values() for p in pages]
+        seen = set(owned) | set(self._free)
+        assert len(owned) + len(self._free) == len(seen), (
+            "page granted twice: "
+            f"{sorted(p for p in seen if owned.count(p) + self._free.count(p) > 1)}")
+        assert TRASH_PAGE not in seen, "trash page entered circulation"
+        assert len(seen) == self.num_pages - 1, (
+            f"page leak: {self.num_pages - 1 - len(seen)} pages neither "
+            "free nor owned")
+        return {"free": len(self._free), "owned": len(owned),
+                "total": self.num_pages - 1}
+
 
 # ---------------------------------------------------------------------------
 # XLA-compilable reference attention (CPU path / parity oracle)

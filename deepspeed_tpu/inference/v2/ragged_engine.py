@@ -69,6 +69,37 @@ outputs are bit-identical, greedy or seeded-sampling.  ``host_stats``
 (:class:`~deepspeed_tpu.inference.common.HostStageStats`) breaks the
 host path into plan/upload/dispatch/device/harvest per dispatch.
 
+Round-6 addition — **speculative decoding on the pipelined decode
+path**: decode is memory-bound (every dispatch re-reads the weights from
+HBM for ONE token per sequence), so with ``speculation.mode != off`` each
+decode-block tick drafts ``k`` tokens per slot and the target model
+scores all ``k+1`` positions in ONE ragged dispatch (the drafted tokens
+enter as a short prefill-like chunk against the paged KV — the same
+SplitFuse machinery that mixes prefill chunks into decode ticks).  A
+device-resident accept/rollback step then compares draft vs target:
+
+- **greedy** slots accept the longest exact-match prefix and emit the
+  target's argmax everywhere, so speculative greedy output is
+  bit-identical to non-speculative decode regardless of draft quality;
+- **sampled** slots use standard rejection sampling with
+  residual-distribution resampling
+  (:func:`~deepspeed_tpu.inference.sampling.speculative_verify`), so
+  the output distribution provably equals the non-speculative one.
+
+Two draft modes share the interface: ``ngram`` (prompt-lookup over a
+device-resident token-history buffer — no second model) and ``draft``
+(a small same-vocab family member runs its own decode carry against the
+SAME page table; its KV pool is separate, its page cursors are shared).
+Rollback is pure position rollback: KV rows written for rejected draft
+positions are provably overwritten by the next block before any query
+can attend to them, and the pages stay owned (the next block writes the
+same span).  Accepted length, rolled-back cursors, and the corrected
+bonus token all live in the decode carry, so speculation composes with
+the pipelined host path — the host projects per-slot advance as
+``[1, k+1]``-per-tick BOUNDS instead of exact counts, grows pages to
+the worst case, and forces a harvest whenever a finish is possible
+under the fast bound.
+
 Host-side scheduling (admission, chunk budgeting, finish detection) is
 plain Python — the reference's scheduler tier is host-side too.  Models:
 anything llama-shaped in the zoo (Llama, Mistral, Qwen2, Mixtral, ... —
@@ -89,8 +120,10 @@ import numpy as np
 from deepspeed_tpu.inference.common import HostStageStats
 from deepspeed_tpu.inference.paged import (PageAllocator,
                                            pages_for)
-from deepspeed_tpu.inference.sampling import (sample_logits,
-                                              sample_logits_batched)
+from deepspeed_tpu.inference.sampling import (filter_logits_batched,
+                                              sample_logits,
+                                              sample_logits_batched,
+                                              speculative_verify)
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -149,6 +182,8 @@ class RaggedInferenceEngineV2:
                  pipeline: Optional[bool] = None,
                  async_depth: Optional[int] = None,
                  harvest_interval: Optional[int] = None,
+                 speculation: Any = None,
+                 draft_model=None, draft_params: Any = None,
                  config: Any = None):
         """``kv_cache_dtype``: "none" | "fp8" | "int8" — paged KV pool
         storage format (reference fp_quantizer KV quantization).
@@ -171,7 +206,14 @@ class RaggedInferenceEngineV2:
         2, ``harvest_interval`` 4); explicit kwargs win.
         ``pipeline=False`` preserves the unpipelined host loop exactly
         — one blocking harvest and a fresh metadata upload per
-        dispatch."""
+        dispatch.
+        ``speculation``: ``None`` (config subtree decides; off by
+        default), a mode string (``"ngram"``/``"draft"``/``"off"``), a
+        dict, or a :class:`~deepspeed_tpu.inference.config.SpeculationConfig`
+        — speculative decoding on the decode-block path (module
+        docstring).  ``mode="draft"`` additionally needs ``draft_model``
+        (a small same-vocab llama-family zoo module) and its
+        ``draft_params``."""
         mcfg = getattr(model, "config", None)
         assert dataclasses.is_dataclass(mcfg) and hasattr(mcfg, "decode"), \
             "ragged engine needs a model-zoo module with a decode config"
@@ -229,6 +271,8 @@ class RaggedInferenceEngineV2:
             harvest_interval = (v2cfg.harvest_interval
                                 if harvest_interval is None
                                 else harvest_interval)
+            speculation = (v2cfg.speculation if speculation is None
+                           else speculation)
         self.pipeline = True if pipeline is None else bool(pipeline)
         self.async_depth = max(
             int(async_depth) if async_depth is not None else 2, 1)
@@ -239,6 +283,28 @@ class RaggedInferenceEngineV2:
         # device-resident decode-loop state while the pipeline runs
         # ahead of the host (None <=> host state is authoritative)
         self._dev: Optional[Dict[str, Any]] = None
+
+        # -- speculative decoding config (module docstring) --
+        from deepspeed_tpu.inference.config import SpeculationConfig
+
+        if speculation is None:
+            speculation = SpeculationConfig()
+        elif isinstance(speculation, str):
+            speculation = SpeculationConfig(mode=speculation)
+        elif isinstance(speculation, dict):
+            speculation = SpeculationConfig(**speculation)
+        self.spec_mode = speculation.mode
+        self.spec_k = int(speculation.k)
+        self.spec_ngram = int(speculation.ngram)
+        self._spec_block_cache: Dict[bool, Any] = {}
+        self._draft = None
+        self._draft_params: Any = {}
+        self._draft_cache: Any = {}
+        self._draft_unroll = False
+        self._draft_prefill = None
+        # host tracker: draft KV coverage per slot (positions < value
+        # hold correct draft K/V; reset on admit/evict/reap)
+        self._draft_len = np.zeros((max_seqs,), np.int64)
 
         from deepspeed_tpu.inference.common import normalize_params
 
@@ -306,6 +372,45 @@ class RaggedInferenceEngineV2:
         self.page_table = np.full((max_seqs, self.pages_per_seq), -1,
                                   np.int32)
         self.cache = self._init_cache()
+        if self.spec_mode == "draft":
+            if draft_model is None:
+                raise ValueError(
+                    "speculation.mode='draft' needs a draft model: pass "
+                    "draft_model=<small same-vocab llama-family module> "
+                    "and draft_params=... (the config's "
+                    "speculation.draft_model preset name is for CLIs to "
+                    "construct one)")
+            assert self.tp <= 1, (
+                "draft-model speculation does not compose with "
+                "tensor-parallel serving yet")
+            dmcfg = getattr(draft_model, "config", None)
+            assert (dataclasses.is_dataclass(dmcfg) and
+                    hasattr(dmcfg, "rope_theta") and
+                    hasattr(dmcfg, "paged_decode")), (
+                "draft model must be a llama-family model-zoo module "
+                "(the ragged paged decode path's requirement)")
+            assert dmcfg.vocab_size == mcfg.vocab_size, (
+                f"draft vocab {dmcfg.vocab_size} != target vocab "
+                f"{mcfg.vocab_size} — speculative verify compares token "
+                "ids, the models must share a tokenizer")
+            self._draft_unroll = bool(getattr(dmcfg, "scan_layers",
+                                              False))
+            self._draft_cfg = dataclasses.replace(
+                dmcfg, decode=True, ragged_decode=False,
+                paged_decode=True, max_cache_len=max_seq_len,
+                scan_layers=False, kv_page_size=self.page_size,
+                kv_num_pages=self.num_pages, tensor_parallel=False,
+                kv_cache_dtype="none")
+            self._draft = type(draft_model)(self._draft_cfg)
+            from deepspeed_tpu.parallel import tensor_parallel as tp_lib
+            dparams = normalize_params(
+                draft_model, draft_params,
+                plain_model=type(draft_model)(dataclasses.replace(
+                    dmcfg, decode=False)))
+            if tp_lib.has_partitioning(dparams):
+                dparams = tp_lib.unbox_params(dparams)
+            self._draft_params = jax.device_put(dparams)
+            self._draft_cache = self._init_cache(self._draft)
         self._uid = itertools.count()
         self.waiting: Deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * max_seqs
@@ -321,6 +426,8 @@ class RaggedInferenceEngineV2:
             f"decode_block={self.decode_block_size} "
             f"pipeline={self.pipeline} depth={self.async_depth} "
             f"harvest={self.harvest_interval} "
+            f"spec={self.spec_mode}"
+            f"{f'/k={self.spec_k}' if self.spec_mode != 'off' else ''} "
             f"(paged KV, fused SplitFuse step)", ranks=[0])
 
     # -- parameter / cache placement (TP) --------------------------------
@@ -457,8 +564,11 @@ class RaggedInferenceEngineV2:
 
     # -- compiled fused step ---------------------------------------------
 
-    def _init_cache(self):
-        """Zeroed page buffers for every layer (eval_shape, no params)."""
+    def _init_cache(self, model=None):
+        """Zeroed page buffers for every layer (eval_shape, no params);
+        ``model`` defaults to the target (the draft model gets its own,
+        smaller, pool tree)."""
+        model = model if model is not None else self.model
         dummy_meta = self._device_meta(
             np.zeros((self.max_seqs,), np.int32),
             np.full((self.max_seqs, self.pages_per_seq), -1, np.int32),
@@ -469,8 +579,8 @@ class RaggedInferenceEngineV2:
         pos = jnp.zeros((1, self.T), jnp.int32)
 
         def _init():
-            return self.model.init(jax.random.PRNGKey(0), ids,
-                                   positions=pos, ragged_meta=dummy_meta)
+            return model.init(jax.random.PRNGKey(0), ids,
+                              positions=pos, ragged_meta=dummy_meta)
 
         shapes = jax.eval_shape(_init)
         assert "cache" in shapes
@@ -641,6 +751,347 @@ class RaggedInferenceEngineV2:
             produced += int(new.size)
         return produced
 
+    # -- the speculative decode block (round-6 tentpole) ------------------
+
+    def _spec_grow_want(self, plen: int, rem: int) -> int:
+        """Token coverage one speculative block needs for a slot at
+        cache length ``plen`` with ``rem`` budget left: each of the
+        block's ticks WRITES k+1 KV rows ahead of the cursor regardless
+        of how many tokens are accepted, so pages must cover the
+        worst-case span (writes past ``max_seq_len`` route to the trash
+        page and need no backing)."""
+        K1 = self.spec_k + 1
+        ticks = min(self.decode_block_size, max(int(rem), 1))
+        return int(min(plen + ticks * K1, self.max_seq_len))
+
+    def _hist_array(self, reqs: List[Request]) -> np.ndarray:
+        """Host build of the device token-history buffer [S, max_len]:
+        ``hist[s, i]`` = the sequence's token at cache position ``i``
+        (prompt + generated — exact in the decode phase).  The n-gram
+        drafter matches/continues against it; rebuilt from host state at
+        every pipeline entry/harvest re-anchor."""
+        hist = np.zeros((self.max_seqs, self.max_seq_len), np.int32)
+        if self.spec_mode == "ngram":
+            for r in reqs:
+                seq = np.concatenate(
+                    [r.prompt, np.asarray(r.generated, np.int32)])
+                L = min(r.length, self.max_seq_len)
+                hist[r.slot, :L] = seq[:L]
+        return hist
+
+    def _draft_prefill_fn(self):
+        """ONE compiled chunked prefill program for the draft model's
+        paged KV catch-up (fixed [1, prefill_chunk] shape, one sequence
+        per call — entry-time cost, not steady-state)."""
+        if self._draft_prefill is not None:
+            return self._draft_prefill
+        from deepspeed_tpu.inference.common import unroll_scan_params
+
+        draft = self._draft
+        dunroll = self._draft_unroll
+
+        def run(dparams, dcache, ids, positions, kv_lens, page_row, cu,
+                dest):
+            if dunroll:
+                dparams = unroll_scan_params(dparams)
+            meta = {"kv_lens": kv_lens, "page_indices": page_row,
+                    "cu_q_lens": cu,
+                    "num_seqs": jnp.asarray([1], jnp.int32),
+                    "new_kv_dest": dest}
+            _, vars_ = draft.apply(
+                {"params": dparams, "cache": dcache}, ids,
+                positions=positions, mutable=["cache"], ragged_meta=meta)
+            return vars_["cache"]
+
+        self._draft_prefill = jax.jit(run, donate_argnums=(1,))
+        return self._draft_prefill
+
+    def _draft_catchup(self, reqs: List[Request]) -> None:
+        """Bring the draft model's paged KV up to each slot's cursor
+        (positions ``< length - 1``; the drafter itself processes the
+        cursor token).  No-op for slots already covered — inside a
+        decode phase the speculative block keeps draft KV in sync by
+        construction, so this only runs at admission/re-admission."""
+        if self.spec_mode != "draft":
+            return
+        st = self.host_stats
+        C = self.prefill_chunk
+        page = self.page_size
+        with st.stage("draft"):
+            fn = self._draft_prefill_fn()
+            for r in reqs:
+                target = r.length - 1
+                lo = int(self._draft_len[r.slot])
+                if lo >= target:
+                    continue
+                seq = np.concatenate(
+                    [r.prompt, np.asarray(r.generated, np.int32)])
+                while lo < target:
+                    take = min(C, target - lo)
+                    ids = np.zeros((C,), np.int32)
+                    ids[:take] = seq[lo:lo + take]
+                    pos = np.arange(lo, lo + C, dtype=np.int32)
+                    posc = np.minimum(pos, self.max_seq_len - 1)
+                    pg = self.page_table[
+                        r.slot, np.minimum(pos // page,
+                                           self.pages_per_seq - 1)]
+                    dest = np.where(
+                        np.arange(C) < take,
+                        np.maximum(pg, 0) * page + pos % page,
+                        0).astype(np.int32)
+                    st.meta_uploads += 6
+                    self._draft_cache = fn(
+                        self._draft_params, self._draft_cache,
+                        jnp.asarray(ids[None]), jnp.asarray(posc[None]),
+                        jnp.asarray([lo + take], jnp.int32),
+                        jnp.asarray(self.page_table[r.slot][None]),
+                        jnp.asarray([0, take], jnp.int32),
+                        jnp.asarray(dest))
+                    lo += take
+                self._draft_len[r.slot] = target
+
+    def _spec_block_fn(self, sampled: bool):
+        """The fused draft+verify+accept block: ``decode_block_size``
+        speculative ticks per dispatch in a ``lax.scan``.  Each tick
+        drafts k tokens (n-gram lookup over the history buffer, or a
+        k-step draft-model sub-scan sharing the target's page table),
+        scores all k+1 positions with the TARGET in one ragged chunk
+        per slot, and accepts/rolls back device-side
+        (:func:`~deepspeed_tpu.inference.sampling.speculative_verify`).
+        Rollback is position rollback: rows written for rejected
+        positions sit between the new cursor and the old write frontier,
+        a span the NEXT tick's scatter fully overwrites before attention
+        can reach it."""
+        if sampled in self._spec_block_cache:
+            return self._spec_block_cache[sampled]
+        from deepspeed_tpu.inference.common import (logits_of,
+                                                    unroll_scan_params)
+
+        model = self.model
+        unroll = self._unroll_params
+        wq = self._wq
+        native = self._wq_native
+        mode = self.spec_mode
+        draft = self._draft
+        dunroll = self._draft_unroll
+        S = self.max_seqs
+        Tt = self.decode_block_size
+        k = self.spec_k
+        K1 = k + 1
+        n = self.spec_ngram
+        page = self.page_size
+        pp = self.pages_per_seq
+        max_len = self.max_seq_len
+
+        def ngram_propose(hist, pos, last_tok):
+            """Prompt-lookup drafting: most recent earlier occurrence of
+            the trailing n-gram proposes the k tokens that followed it;
+            no match proposes the last token repeated (any proposal is
+            distribution-safe — bad drafts are simply rejected)."""
+            L = max_len
+            ar = jnp.arange(L, dtype=jnp.int32)
+            histp = jnp.pad(hist, ((0, 0), (0, n + k)))
+            tpos = jnp.clip(pos[:, None] - (n - 1) +
+                            jnp.arange(n, dtype=jnp.int32)[None, :],
+                            0, L - 1)
+            tail = jnp.take_along_axis(hist, tpos, axis=1)      # [S, n]
+            match = jnp.ones((S, L), bool)
+            for t in range(n):
+                match = match & (histp[:, t:t + L] == tail[:, t:t + 1])
+            valid = ar[None, :] <= (pos[:, None] - n)
+            score = jnp.where(match & valid, ar[None, :] + 1, 0)
+            best = jnp.argmax(score, axis=1)       # most recent match
+            found = jnp.any(match & valid, axis=1)
+            cidx = best[:, None] + n + jnp.arange(k,
+                                                  dtype=jnp.int32)[None, :]
+            cand = jnp.take_along_axis(histp, cidx, axis=1)     # [S, k]
+            fb = jnp.broadcast_to(last_tok[:, None], (S, k))
+            return jnp.where(found[:, None], cand, fb).astype(jnp.int32)
+
+        def run(params, dparams, cache, dcache, hist, last_tok, pos,
+                active, remaining, page_table, eos_ids, do_sample,
+                temperature, top_k, top_p, rng):
+            if wq:
+                from deepspeed_tpu.inference.quantization import \
+                    dequantize_param_tree
+
+                params = dequantize_param_tree(params, native_w8a8=native)
+            if unroll:
+                params = unroll_scan_params(params)
+            if mode == "draft" and dunroll:
+                dparams = unroll_scan_params(dparams)
+            rows = jnp.arange(S)
+
+            def draft_propose(dcache, last_tok, pos, active, key):
+                def dstep(carry, key_j):
+                    dcache, cur, dpos = carry
+                    dvalid = active & (dpos < max_len)
+                    dp = jnp.take_along_axis(
+                        jnp.maximum(page_table, 0),
+                        jnp.minimum(dpos // page, pp - 1)[:, None],
+                        axis=1)[:, 0]
+                    ddest = jnp.where(dvalid, dp * page + dpos % page, 0)
+                    dmeta = {"kv_lens": jnp.where(active, dpos + 1, 1),
+                             "page_indices": page_table,
+                             "cu_q_lens": jnp.arange(S + 1,
+                                                     dtype=jnp.int32),
+                             "num_seqs": jnp.asarray([S], jnp.int32),
+                             "new_kv_dest": ddest}
+                    dout, dvars = draft.apply(
+                        {"params": dparams, "cache": dcache}, cur[None],
+                        positions=jnp.where(dvalid, dpos, 0)[None],
+                        mutable=["cache"], ragged_meta=dmeta)
+                    dlg = logits_of(dout)[0].astype(jnp.float32)
+                    dgreedy = jnp.argmax(dlg, axis=-1).astype(jnp.int32)
+                    if sampled:
+                        flg = filter_logits_batched(dlg, temperature,
+                                                    top_k, top_p)
+                        qj = jax.nn.softmax(flg, axis=-1)
+                        samp = jax.random.categorical(
+                            key_j, flg, axis=-1).astype(jnp.int32)
+                        nxt = jnp.where(do_sample, samp, dgreedy)
+                        out = (nxt, qj)
+                    else:
+                        nxt = dgreedy
+                        out = (nxt,)
+                    return (dvars["cache"], nxt, dpos + 1), out
+
+                keys = jax.random.split(key, k)
+                (dcache, _, _), outs = jax.lax.scan(
+                    dstep, (dcache, last_tok, pos), keys)
+                d_toks = outs[0].T                              # [S, k]
+                q_probs = (outs[1].transpose(1, 0, 2) if sampled
+                           else None)
+                return dcache, d_toks, q_probs
+
+            def tick(carry, _):
+                (cache, dcache, hist, last_tok, pos, active, remaining,
+                 rng, prop, accd) = carry
+                rng, key_d, key_v = jax.random.split(rng, 3)
+                # ---- draft k proposals per slot ----
+                if mode == "ngram":
+                    # the cursor token joins the history before matching
+                    hist = hist.at[rows, jnp.where(active, pos,
+                                                   max_len)].set(
+                        last_tok, mode="drop")
+                    d_toks = ngram_propose(hist, pos, last_tok)
+                    q_probs = None
+                else:
+                    dcache, d_toks, q_probs = draft_propose(
+                        dcache, last_tok, pos, active, key_d)
+                # ---- verify: one ragged chunk of k+1 rows per slot ----
+                chunk = jnp.concatenate([last_tok[:, None], d_toks],
+                                        axis=1)                 # [S, K1]
+                cpos = (pos[:, None] +
+                        jnp.arange(K1, dtype=jnp.int32)[None, :])
+                valid = active[:, None] & (cpos < max_len)
+                dest_page = jnp.take_along_axis(
+                    jnp.maximum(page_table, 0),
+                    jnp.minimum(cpos // page, pp - 1), axis=1)
+                dest = jnp.where(valid,
+                                 dest_page * page + cpos % page, 0)
+                meta = {"kv_lens": jnp.where(active, pos + K1, 1),
+                        "page_indices": page_table,
+                        "cu_q_lens": jnp.arange(
+                            S + 1, dtype=jnp.int32) * K1,
+                        "num_seqs": jnp.asarray([S], jnp.int32),
+                        "new_kv_dest": dest.reshape(-1)}
+                out, vars_ = model.apply(
+                    {"params": params, "cache": cache},
+                    chunk.reshape(1, -1),
+                    positions=jnp.where(valid, cpos, 0).reshape(1, -1),
+                    mutable=["cache"], ragged_meta=meta)
+                cache = vars_["cache"]
+                logits = logits_of(out)[0].reshape(S, K1, -1)
+                out_toks, acc = speculative_verify(
+                    logits, d_toks, q_probs, key_v if sampled else None,
+                    do_sample, temperature, top_k, top_p)
+                # ---- emission clamp: budget, max_len, first eos ----
+                emit = jnp.minimum(acc + 1, remaining)
+                emit = jnp.minimum(emit, max_len - 1 - pos)
+                eos_hit = out_toks == eos_ids[:, None]
+                first_eos = jnp.argmax(eos_hit, axis=1)
+                emit = jnp.where(jnp.any(eos_hit, axis=1),
+                                 jnp.minimum(emit, first_eos + 1), emit)
+                emit = jnp.where(active, jnp.clip(emit, 1, K1), 0)
+                j = jnp.arange(K1, dtype=jnp.int32)[None, :]
+                mask_out = active[:, None] & (j < emit[:, None])
+                # ---- advance / roll back the carry ----
+                new_last = jnp.take_along_axis(
+                    out_toks, jnp.maximum(emit - 1, 0)[:, None],
+                    axis=1)[:, 0]
+                ended_eos = jnp.any(eos_hit & (j < emit[:, None]),
+                                    axis=1)
+                if mode == "ngram":
+                    hidx = jnp.where(mask_out, pos[:, None] + 1 + j,
+                                     max_len)
+                    hist = hist.at[rows[:, None], hidx].set(
+                        out_toks, mode="drop")
+                last_tok = jnp.where(active, new_last, last_tok)
+                pos = jnp.where(active, pos + emit, pos)
+                remaining = remaining - emit
+                prop = prop + jnp.sum(jnp.where(active, k, 0))
+                accd = accd + jnp.sum(jnp.where(active, emit - 1, 0))
+                active = (active & ~ended_eos & (remaining > 0) &
+                          (pos + 1 < max_len))
+                return (cache, dcache, hist, last_tok, pos, active,
+                        remaining, rng, prop, accd), (out_toks, mask_out)
+
+            carry0 = (cache, dcache, hist, last_tok, pos, active,
+                      remaining, rng, jnp.int32(0), jnp.int32(0))
+            carry, (toks, mask) = jax.lax.scan(tick, carry0, length=Tt)
+            (cache, dcache, hist, last_tok, pos, active, remaining, _,
+             prop, accd) = carry
+            # tick-major emission order, [Tt*K1, S] — _fold_block's shape
+            toks = toks.transpose(0, 2, 1).reshape(Tt * K1, S)
+            mask = mask.transpose(0, 2, 1).reshape(Tt * K1, S)
+            return (cache, dcache, hist, last_tok, pos, active,
+                    remaining, toks, mask, prop, accd)
+
+        fn = jax.jit(run, donate_argnums=(2, 3, 4))
+        self._spec_block_cache[sampled] = fn
+        return fn
+
+    def _step_spec_block(self, reqs: List[Request]) -> int:
+        """One speculative block, unpipelined (fresh uploads + one
+        blocking harvest per dispatch) — the ``pipeline=False`` spec
+        reference path."""
+        st = self.host_stats
+        with st.stage("plan"):
+            (last_tok, pos, active, remaining, eos_ids, do_sample,
+             temperature, top_k, top_p) = self._block_arrays(reqs)
+            sampled = bool(do_sample.any())
+            hist = self._hist_array(reqs)
+        self._draft_catchup(reqs)
+        self.rng, sub = jax.random.split(self.rng)
+        args = [self._upload(a) for a in
+                (hist, last_tok, pos, active, remaining, self.page_table,
+                 eos_ids, do_sample, temperature, top_k, top_p)]
+        with st.stage("verify"):
+            st.dispatches += 1
+            st.spec_dispatches += 1
+            (self.cache, self._draft_cache, _h, new_last, _p, _a, _r,
+             toks, mask, prop, accd) = self._spec_block_fn(sampled)(
+                self.params, self._draft_params, self.cache,
+                self._draft_cache, *args, sub)
+        st.ticks += self.decode_block_size
+        toks, mask, new_last, prop, accd = self._fetch(
+            (toks, mask, new_last, prop, accd))
+        st.harvests += 1
+        with st.stage("harvest"):
+            self._last_tokens = np.array(new_last)
+            produced = self._fold_block(reqs, np.asarray(toks),
+                                        np.asarray(mask))
+            st.spec_proposed += int(prop)
+            st.spec_accepted += int(accd)
+            st.spec_tokens += produced
+            for r in reqs:
+                self._maybe_finish(r)
+                if not r.done:
+                    self._draft_len[r.slot] = r.length - 1
+            self._reap()
+        return produced
+
     def _step_decode_block(self, reqs: List[Request]) -> int:
         """Run one on-device decode block and fold results back into the
         host request state (the ``pipeline=False`` path: fresh metadata
@@ -694,10 +1145,20 @@ class RaggedInferenceEngineV2:
                                      len(req.generated), 1))
         return self.allocator.can_allocate(need)
 
-    def _pipeline_start(self, reqs: List[Request]) -> None:
+    def _pipeline_start(self, reqs: List[Request],
+                        spec: bool = False) -> None:
         """Enter the pipelined decode loop: upload the decode-block
         carry and sampler metadata ONCE; subsequent blocks chain
-        device-resident state (zero steady-state uploads)."""
+        device-resident state (zero steady-state uploads).  With
+        ``spec`` the speculative block runs instead of the plain one and
+        the projection becomes per-slot BOUNDS (advance is 1..k+1 per
+        tick, data-dependent): ``plen``/``rem`` hold the slow bound
+        (1 token per tick — the guaranteed floor), ``plen_hi``/
+        ``rem_lo`` the fast bound (k+1 per tick); growth covers the
+        fast bound's write span and a harvest is forced as soon as the
+        fast bound says a finish is POSSIBLE."""
+        if spec:
+            self._draft_catchup(reqs)
         with self.host_stats.stage("plan"):
             (last_tok, pos, active, remaining, eos_ids, do_sample,
              temperature, top_k, top_p) = self._block_arrays(reqs)
@@ -728,8 +1189,13 @@ class RaggedInferenceEngineV2:
             "top_k": self._upload(top_k),
             "top_p": self._upload(top_p),
             "plen": plen, "rem": rem, "has_eos": has_eos,
+            "spec": spec,
             "pending": [],                # un-harvested (toks, mask)
         }
+        if spec:
+            self._dev["hist"] = self._upload(self._hist_array(reqs))
+            self._dev["plen_hi"] = plen.copy()
+            self._dev["rem_lo"] = rem.copy()
 
     def _pipeline_step(self) -> int:
         """One pipelined iteration: plan + dispatch block k+1 while the
@@ -737,23 +1203,31 @@ class RaggedInferenceEngineV2:
         dv = self._dev
         st = self.host_stats
         K = self.decode_block_size
+        spec = dv.get("spec", False)
+        K1 = self.spec_k + 1
         # a queued request became admittable (put_request arrived, or a
         # reap freed capacity): reconcile so the normal path admits it
         # exactly when the unpipelined engine would
         if self._admittable():
             return self._pipeline_harvest(teardown=True)
         with st.stage("plan"):
-            # grow pages to cover the next block — exact, because the
-            # projection is exact for every sequence that can reach this
-            # point un-harvested (see _pipeline_start)
+            # grow pages to cover the next block — exact for the plain
+            # block (the projection is exact for every sequence that can
+            # reach this point un-harvested, see _pipeline_start); for a
+            # speculative block the projection is the FAST bound, so
+            # growth covers the worst-case k+1-wide write span
             slots_active = [r.slot for r in dv["reqs"]
                             if dv["rem"][r.slot] > 0 and
                             dv["plen"][r.slot] < self.max_seq_len]
             grow_ok = bool(slots_active)
             table_dirty = False
             for s in slots_active:
-                want = int(min(dv["plen"][s] + min(K, dv["rem"][s]),
-                               self.max_seq_len))
+                if spec:
+                    want = self._spec_grow_want(int(dv["plen_hi"][s]),
+                                                int(dv["rem"][s]))
+                else:
+                    want = int(min(dv["plen"][s] + min(K, dv["rem"][s]),
+                                   self.max_seq_len))
                 before = self.allocator.owned(s)
                 if not self._ensure_pages(s, want):
                     grow_ok = False
@@ -766,29 +1240,58 @@ class RaggedInferenceEngineV2:
         if table_dirty:
             dv["page_table"] = self._upload(self.page_table)
         self.rng, sub = jax.random.split(self.rng)
-        with st.stage("dispatch"):
-            st.dispatches += 1
-            (self.cache, dv["last_tok"], dv["pos"], dv["active"],
-             dv["remaining"], toks, mask) = self._decode_block_fn(
-                dv["sampled"])(
-                self.params, self.cache, dv["last_tok"], dv["pos"],
-                dv["active"], dv["remaining"], dv["page_table"],
-                dv["eos_ids"], dv["do_sample"], dv["temperature"],
-                dv["top_k"], dv["top_p"], sub)
-        dv["pending"].append((toks, mask))
+        if spec:
+            with st.stage("verify"):
+                st.dispatches += 1
+                st.spec_dispatches += 1
+                (self.cache, self._draft_cache, dv["hist"],
+                 dv["last_tok"], dv["pos"], dv["active"],
+                 dv["remaining"], toks, mask, prop,
+                 accd) = self._spec_block_fn(dv["sampled"])(
+                    self.params, self._draft_params, self.cache,
+                    self._draft_cache, dv["hist"], dv["last_tok"],
+                    dv["pos"], dv["active"], dv["remaining"],
+                    dv["page_table"], dv["eos_ids"], dv["do_sample"],
+                    dv["temperature"], dv["top_k"], dv["top_p"], sub)
+            dv["pending"].append((toks, mask, prop, accd))
+        else:
+            with st.stage("dispatch"):
+                st.dispatches += 1
+                (self.cache, dv["last_tok"], dv["pos"], dv["active"],
+                 dv["remaining"], toks, mask) = self._decode_block_fn(
+                    dv["sampled"])(
+                    self.params, self.cache, dv["last_tok"], dv["pos"],
+                    dv["active"], dv["remaining"], dv["page_table"],
+                    dv["eos_ids"], dv["do_sample"], dv["temperature"],
+                    dv["top_k"], dv["top_p"], sub)
+            dv["pending"].append((toks, mask))
         st.ticks += K
         with st.stage("plan"):
             # advance the projection past this block and decide whether
             # the unpipelined engine could have reaped after it
             finish_possible = False
             for s in slots_active:
-                prod = int(min(K, dv["rem"][s],
-                               self.max_seq_len - dv["plen"][s]))
-                dv["rem"][s] -= prod
-                dv["plen"][s] += prod
-                if (dv["has_eos"][s] or dv["rem"][s] <= 0 or
-                        dv["plen"][s] >= self.max_seq_len):
-                    finish_possible = True
+                if spec:
+                    # bounds: per tick a slot advances 1..k+1 tokens
+                    slow = int(max(0, min(K, dv["rem_lo"][s])))
+                    fast = int(min(K * K1, max(dv["rem"][s], 0)))
+                    dv["plen"][s] = min(dv["plen"][s] + slow,
+                                        self.max_seq_len)
+                    dv["plen_hi"][s] = min(dv["plen_hi"][s] + fast,
+                                           self.max_seq_len)
+                    dv["rem"][s] -= slow
+                    dv["rem_lo"][s] -= K * K1
+                    if (dv["has_eos"][s] or dv["rem_lo"][s] <= 0 or
+                            dv["plen_hi"][s] >= self.max_seq_len):
+                        finish_possible = True
+                else:
+                    prod = int(min(K, dv["rem"][s],
+                                   self.max_seq_len - dv["plen"][s]))
+                    dv["rem"][s] -= prod
+                    dv["plen"][s] += prod
+                    if (dv["has_eos"][s] or dv["rem"][s] <= 0 or
+                            dv["plen"][s] >= self.max_seq_len):
+                        finish_possible = True
         if len(dv["pending"]) > self.async_depth:
             # bound device run-ahead without harvesting: wait for the
             # (now - depth)-th block; in-order execution keeps at most
@@ -808,9 +1311,11 @@ class RaggedInferenceEngineV2:
         dv = self._dev
         st = self.host_stats
         st.harvests += 1
-        toks_l, mask_l, last_tok = self._fetch((
-            [t for t, _ in dv["pending"]],
-            [m for _, m in dv["pending"]], dv["last_tok"]))
+        spec = dv.get("spec", False)
+        toks_l, mask_l, last_tok, extra = self._fetch((
+            [p[0] for p in dv["pending"]],
+            [p[1] for p in dv["pending"]], dv["last_tok"],
+            [p[2:] for p in dv["pending"]] if spec else []))
         with st.stage("harvest"):
             # np.array: device_get returns READ-ONLY views
             self._last_tokens = np.array(last_tok)
@@ -818,8 +1323,14 @@ class RaggedInferenceEngineV2:
             for toks, mask in zip(toks_l, mask_l):
                 produced += self._fold_block(
                     dv["reqs"], np.asarray(toks), np.asarray(mask))
+            if spec:
+                st.spec_proposed += sum(int(p) for p, _ in extra)
+                st.spec_accepted += sum(int(a) for _, a in extra)
+                st.spec_tokens += produced
             for r in dv["reqs"]:
                 self._maybe_finish(r)
+                if spec and not r.done:
+                    self._draft_len[r.slot] = max(r.length - 1, 0)
             changed = any(r.done for r in dv["reqs"])
             self._reap()
             dv["pending"] = []
@@ -827,11 +1338,15 @@ class RaggedInferenceEngineV2:
                 self._dev = None
             else:
                 # device carry stays authoritative; re-anchor the host
-                # projection on the now-exact lengths
+                # projection on the now-exact lengths (and the
+                # speculative fast/slow bounds collapse to exact)
                 for r in dv["reqs"]:
                     dv["plen"][r.slot] = r.length
                     dv["rem"][r.slot] = (r.max_new_tokens -
                                          len(r.generated))
+                if spec:
+                    np.copyto(dv["plen_hi"], dv["plen"])
+                    np.copyto(dv["rem_lo"], dv["rem"])
         return produced
 
     # -- the scheduler tick ----------------------------------------------
@@ -851,14 +1366,34 @@ class RaggedInferenceEngineV2:
         with st.stage("plan"):
             self._admit()
             live = [r for r in self.slots if r is not None and not r.done]
+            decoding_ready = bool(live) and all(
+                r.prefill_done >= r.ctx_len for r in live)
+            # speculation first: its block writes a k+1-wide span per
+            # tick, so it needs more page coverage than a plain block —
+            # when the pool can't back it, degrade to the plain decode
+            # block (greedy outputs are unchanged either way; the
+            # decision is taken from EXACT state, so pipelined and
+            # unpipelined runs degrade at the same steps)
+            spec_block = (decoding_ready and self.spec_mode != "off" and
+                          all(self._ensure_pages(
+                              r.slot,
+                              self._spec_grow_want(
+                                  r.length, r.max_new_tokens -
+                                  len(r.generated)))
+                              for r in live))
             all_decoding = (
-                self.decode_block_size > 1 and live and
-                all(r.prefill_done >= r.ctx_len for r in live) and
+                not spec_block and decoding_ready and
+                self.decode_block_size > 1 and
                 all(self._ensure_pages(
                     r.slot,
                     r.length + min(self.decode_block_size,
                                    r.max_new_tokens - len(r.generated)))
                     for r in live))
+        if spec_block:
+            if self.pipeline:
+                self._pipeline_start(live, spec=True)
+                return self._pipeline_step()
+            return self._step_spec_block(live)
         if all_decoding:
             if self.pipeline:
                 self._pipeline_start(live)
@@ -933,6 +1468,7 @@ class RaggedInferenceEngineV2:
             req.slot = i
             req.prefill_done = 0
             self.slots[i] = req
+            self._draft_len[i] = 0
             pages = self.allocator.allocate(i, need)
             self.page_table[i, :] = -1
             self.page_table[i, :len(pages)] = pages
@@ -962,6 +1498,7 @@ class RaggedInferenceEngineV2:
         self.allocator.free(r.slot)
         self.page_table[r.slot, :] = -1
         self.slots[r.slot] = None
+        self._draft_len[r.slot] = 0
         r.ctx = np.concatenate(
             [r.prompt, np.asarray(r.generated, np.int32)])
         r.prefill_done = 0
@@ -1116,6 +1653,7 @@ class RaggedInferenceEngineV2:
                 self.slots[i] = None
                 self.allocator.free(i)
                 self.page_table[i, :] = -1
+                self._draft_len[i] = 0
 
     # -- introspection ----------------------------------------------------
 

@@ -176,11 +176,20 @@ class MonitorMaster(Monitor):
         ``host_bound_fraction``) as ``Serving/*`` series.  A serving
         fleet whose ``Serving/host_bound_fraction`` climbs toward 1.0
         is wasting its accelerators on host scheduling — the signal the
-        pipelined host path exists to drive down."""
-        self.write_events([(f"Serving/{name}", float(value), step)
-                           for name, value in sorted(
-                               serving_stages.items())
-                           if isinstance(value, (int, float))])
+        pipelined host path exists to drive down.  One-level sub-dicts
+        (the ``speculation`` acceptance breakdown) flatten to
+        ``Serving/<group>/<name>`` series — a falling
+        ``Serving/speculation/acceptance_rate`` means the draft has
+        stopped earning its keep."""
+        events = []
+        for name, value in sorted(serving_stages.items()):
+            if isinstance(value, dict):
+                events += [(f"Serving/{name}/{k}", float(v), step)
+                           for k, v in sorted(value.items())
+                           if isinstance(v, (int, float))]
+            elif isinstance(value, (int, float)):
+                events.append((f"Serving/{name}", float(value), step))
+        self.write_events(events)
 
     def write_comm_health(self, straggler_report: dict, step: int) -> None:
         """Surface the cross-rank straggler report

