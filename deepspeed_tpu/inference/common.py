@@ -38,10 +38,15 @@ class HostStageStats:
     not device time) — and the ``spec_*`` counters.  When any
     speculative block ran, ``serving_stages()`` carries a
     ``speculation`` sub-dict with the acceptance breakdown.
+
+    KV tiering adds ``spill`` (page gather + device_get + handoff to
+    the tier store) and ``restore`` (tier fetch + verify + page upload
+    + scatter); the v2 engine additionally merges the tier store's own
+    flat stats as a ``kv_tiering`` sub-dict.
     """
 
     STAGES = ("plan", "upload", "dispatch", "device", "harvest", "draft",
-              "verify")
+              "verify", "spill", "restore")
 
     def __init__(self):
         self.reset()
@@ -73,7 +78,7 @@ class HostStageStats:
             for s in self.STAGES}
         host = sum(self.seconds[s] for s in
                    ("plan", "upload", "dispatch", "harvest", "draft",
-                    "verify"))
+                    "verify", "spill", "restore"))
         dev = self.seconds["device"]
         out["host_s"] = round(host, 4)
         out["device_wait_s"] = round(dev, 4)

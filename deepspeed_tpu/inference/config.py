@@ -66,6 +66,63 @@ class SpeculationConfig(ConfigModel):
         return self
 
 
+class KVTieringConfig(ConfigModel):
+    """``v2.kv_tiering`` subtree: host-RAM + NVMe spill tiers for the
+    paged-KV pool.
+
+    When the pool can't grow a scheduled sequence, the engine spills
+    the coldest non-scheduled sequence's pages to host RAM
+    (device_get into page-aligned pinned buffers) instead of evicting
+    it — restore is a page upload, not a re-prefill.  Host RAM
+    overflows into NVMe through the hardened bucketed AIO path
+    (qd-128, optional O_DIRECT, fallocate), every spilled page is
+    digested (``resilience/sdc.py``) at spill and verified on restore,
+    and NVMe->host prefetch for predicted next-scheduled sequences
+    runs under the decode block.
+
+    ``host_pages`` / ``nvme_pages``: per-tier budgets in KV pages
+    (0 disables that tier).  ``nvme_dir``: spill directory (required
+    when ``nvme_pages > 0``).  ``use_odirect``: O_DIRECT spill files
+    (off by default — dev containers often spill to tmpfs, where
+    O_DIRECT is unsupported).  ``prefetch``: overlap NVMe->host
+    restores with decode blocks.  ``verify``: digest-check every
+    restored page (re-read heals transient flips; persistent
+    corruption quarantines the page and the session re-prefills
+    loudly).  Tiering requires ``kv_reserve="on_demand"`` — spill
+    tiers ARE the on-demand model's overflow story."""
+
+    enabled: bool = False
+    host_pages: int = 256
+    nvme_pages: int = 0
+    nvme_dir: Optional[str] = None
+    use_odirect: bool = False
+    prefetch: bool = True
+    verify: bool = True
+    checksum: str = "sum64"
+    max_reread: int = 2
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.host_pages < 0 or self.nvme_pages < 0:
+            raise ValueError("kv_tiering tier budgets must be >= 0")
+        if self.enabled and self.host_pages == 0 and self.nvme_pages == 0:
+            raise ValueError(
+                "kv_tiering.enabled needs a nonzero host_pages or "
+                "nvme_pages budget")
+        if self.nvme_pages > 0 and not self.nvme_dir:
+            raise ValueError(
+                "kv_tiering.nvme_pages > 0 requires kv_tiering.nvme_dir")
+        if self.max_reread < 0:
+            raise ValueError("kv_tiering.max_reread must be >= 0")
+        from deepspeed_tpu.resilience.sdc import CHECKSUM_ALGOS
+
+        if self.checksum not in CHECKSUM_ALGOS:
+            raise ValueError(
+                f"kv_tiering.checksum must be one of {CHECKSUM_ALGOS}, "
+                f"got {self.checksum!r}")
+        return self
+
+
 class InferenceV2Config(ConfigModel):
     """``v2`` subtree: the serving host-path pipeline knobs.
 
@@ -84,6 +141,7 @@ class InferenceV2Config(ConfigModel):
     harvest_interval: int = 4
     speculation: SpeculationConfig = Field(
         default_factory=SpeculationConfig)
+    kv_tiering: KVTieringConfig = Field(default_factory=KVTieringConfig)
 
     @model_validator(mode="after")
     def _positive(self):

@@ -145,6 +145,10 @@ class Request:
     # prefill SOURCE: the prompt, or prompt + already-generated tokens
     # after an eviction (the continuation re-prefills its own output)
     ctx: Optional[np.ndarray] = None
+    # tiered-KV spill payload metadata while the sequence's pages sit
+    # in host RAM / NVMe (None <=> not spilled); the page bytes live in
+    # the engine's TieredKVStore keyed by uid
+    spilled: Optional[Dict[str, int]] = None
 
     @property
     def ctx_len(self) -> int:
@@ -184,6 +188,7 @@ class RaggedInferenceEngineV2:
                  harvest_interval: Optional[int] = None,
                  speculation: Any = None,
                  draft_model=None, draft_params: Any = None,
+                 kv_tiering: Any = None,
                  config: Any = None):
         """``kv_cache_dtype``: "none" | "fp8" | "int8" — paged KV pool
         storage format (reference fp_quantizer KV quantization).
@@ -213,7 +218,14 @@ class RaggedInferenceEngineV2:
         — speculative decoding on the decode-block path (module
         docstring).  ``mode="draft"`` additionally needs ``draft_model``
         (a small same-vocab llama-family zoo module) and its
-        ``draft_params``."""
+        ``draft_params``.
+        ``kv_tiering``: ``None`` (config subtree decides; off by
+        default), a dict (implies ``enabled=True`` unless it says
+        otherwise), or a
+        :class:`~deepspeed_tpu.inference.config.KVTieringConfig` —
+        host-RAM + NVMe spill tiers for the paged-KV pool
+        (:mod:`deepspeed_tpu.inference.kv_tiering`).  With tiering
+        disabled the engine is byte-for-byte the untiered engine."""
         mcfg = getattr(model, "config", None)
         assert dataclasses.is_dataclass(mcfg) and hasattr(mcfg, "decode"), \
             "ragged engine needs a model-zoo module with a decode config"
@@ -273,6 +285,8 @@ class RaggedInferenceEngineV2:
                                 else harvest_interval)
             speculation = (v2cfg.speculation if speculation is None
                            else speculation)
+            kv_tiering = (v2cfg.kv_tiering if kv_tiering is None
+                          else kv_tiering)
         self.pipeline = True if pipeline is None else bool(pipeline)
         self.async_depth = max(
             int(async_depth) if async_depth is not None else 2, 1)
@@ -419,6 +433,51 @@ class RaggedInferenceEngineV2:
         self._step_fn = None
         self._decode_block_cache: Dict[bool, Any] = {}
         self._last_tokens = np.zeros((max_seqs,), np.int32)
+
+        # -- tiered KV spill store (HBM -> host RAM -> NVMe) --
+        from deepspeed_tpu.inference.config import KVTieringConfig
+
+        if kv_tiering is None:
+            kv_tiering = KVTieringConfig()
+        elif isinstance(kv_tiering, dict):
+            kv_tiering = KVTieringConfig(**{"enabled": True, **kv_tiering})
+        self._tier_cfg = kv_tiering
+        self.tiering = None
+        self._tier_gather = None       # jitted fixed-shape page gather
+        self._tier_scatter = None      # jitted fixed-shape page scatter
+        self._sched_seq = 0            # step counter for victim coldness
+        self._last_sched = np.zeros((max_seqs,), np.int64)
+        self.spills = 0                # sessions spilled to the tiers
+        self.restores = 0              # sessions restored bit-identically
+        if kv_tiering.enabled:
+            assert self.kv_reserve == "on_demand", (
+                "kv_tiering requires kv_reserve='on_demand' — the spill "
+                "tiers ARE the on-demand model's overflow story; a "
+                "worst-case reservation could never admit what tiering "
+                "holds")
+            from deepspeed_tpu.inference.kv_tiering import TieredKVStore
+
+            leaves, self._cache_treedef = jax.tree_util.tree_flatten(
+                self.cache)
+            assert all(leaf.shape[0] == self.num_pages
+                       for leaf in leaves), (
+                "every paged-KV cache leaf must lead with the page dim")
+            self.tiering = TieredKVStore(
+                page_shapes=[leaf.shape[1:] for leaf in leaves],
+                page_dtypes=[np.dtype(leaf.dtype) for leaf in leaves],
+                pages_per_seq=self.pages_per_seq,
+                host_pages=kv_tiering.host_pages,
+                nvme_pages=kv_tiering.nvme_pages,
+                nvme_dir=kv_tiering.nvme_dir,
+                use_odirect=kv_tiering.use_odirect,
+                prefetch=kv_tiering.prefetch,
+                verify=kv_tiering.verify,
+                checksum=kv_tiering.checksum,
+                max_reread=kv_tiering.max_reread)
+        tier_note = ""
+        if self.tiering is not None:
+            tier_note = (f" kv_tiering=host:{kv_tiering.host_pages}"
+                         f"+nvme:{kv_tiering.nvme_pages}p")
         log_dist(
             f"RaggedInferenceEngineV2: max_seqs={max_seqs} "
             f"max_seq_len={max_seq_len} prefill_chunk={prefill_chunk} "
@@ -427,7 +486,8 @@ class RaggedInferenceEngineV2:
             f"pipeline={self.pipeline} depth={self.async_depth} "
             f"harvest={self.harvest_interval} "
             f"spec={self.spec_mode}"
-            f"{f'/k={self.spec_k}' if self.spec_mode != 'off' else ''} "
+            f"{f'/k={self.spec_k}' if self.spec_mode != 'off' else ''}"
+            f"{tier_note} "
             f"(paged KV, fused SplitFuse step)", ranks=[0])
 
     # -- parameter / cache placement (TP) --------------------------------
@@ -507,12 +567,29 @@ class RaggedInferenceEngineV2:
                 f"{total} exceeds the engine token budget "
                 f"max_seq_len={self.max_seq_len} — the request can never "
                 "be scheduled; shorten the prompt or raise max_seq_len")
-        if self.allocator.pages_for(total) > self.num_pages - 1:
-            raise ValueError(
-                f"request needs {self.allocator.pages_for(total)} KV "
-                f"pages but the engine owns {self.num_pages - 1} usable "
-                "pages — even after evicting every other sequence it "
-                "could never be scheduled; raise num_pages")
+        if self.tiering is None:
+            if self.allocator.pages_for(total) > self.num_pages - 1:
+                raise ValueError(
+                    f"request needs {self.allocator.pages_for(total)} KV "
+                    f"pages but the engine owns {self.num_pages - 1} "
+                    "usable pages — even after evicting every other "
+                    "sequence it could never be scheduled; raise "
+                    "num_pages")
+        else:
+            # spill tiers hold overflow non-destructively: a request is
+            # schedulable as long as its worst-case footprint fits the
+            # COMBINED capacity (other sessions spill instead of dying;
+            # max_new_tokens is a budget, not a promise).  The rejection
+            # names the tier budget that ran out.
+            cap = self.num_pages - 1 + self.tiering.budget_pages
+            if self.allocator.pages_for(total) > cap:
+                raise ValueError(
+                    f"request needs {self.allocator.pages_for(total)} KV "
+                    f"pages but HBM ({self.num_pages - 1} usable) + host "
+                    f"({self.tiering.host_budget}) + NVMe "
+                    f"({self.tiering.nvme_budget}) tiers hold only {cap} "
+                    "— it could never be scheduled; raise num_pages or "
+                    "the kv_tiering host_pages/nvme_pages budgets")
         req = Request(uid=next(self._uid), prompt=prompt, **kw)
         self.waiting.append(req)
         return req.uid
@@ -542,8 +619,22 @@ class RaggedInferenceEngineV2:
 
     def serving_stages(self) -> Dict[str, Any]:
         """Per-dispatch host-path breakdown + ``host_bound_fraction``
-        (see :class:`~deepspeed_tpu.inference.common.HostStageStats`)."""
-        return self.host_stats.serving_stages()
+        (see :class:`~deepspeed_tpu.inference.common.HostStageStats`);
+        with tiering on, the tier store's flat stats ride along as a
+        ``kv_tiering`` sub-dict (``MonitorMaster`` flattens it to
+        ``Serving/kv_tiering/<name>`` series)."""
+        out = self.host_stats.serving_stages()
+        if self.tiering is not None:
+            out["kv_tiering"] = self.tiering.stats()
+        return out
+
+    def close(self) -> None:
+        """Release tier-store resources (AIO handle, staging buffers,
+        digest pool, spill files).  Idempotent; a no-op with tiering
+        off."""
+        if self.tiering is not None:
+            self.tiering.close()
+            self.tiering = None
 
     # -- host<->device funnels (every transfer is counted/timed) ---------
 
@@ -729,6 +820,7 @@ class RaggedInferenceEngineV2:
         top_p = np.ones((S,), np.float32)
         for r in reqs:
             s = r.slot
+            self._last_sched[s] = self._sched_seq
             pos[s] = min(r.length - 1, self.max_seq_len - 1)
             active[s] = True
             remaining[s] = r.max_new_tokens - len(r.generated)
@@ -1137,12 +1229,15 @@ class RaggedInferenceEngineV2:
             return False
         req = self.waiting[0]
         ctx_len = req.ctx_len
+        rem = max(req.max_new_tokens - len(req.generated), 1)
         if self.kv_reserve == "worst_case":
             need = ctx_len + req.max_new_tokens - len(req.generated)
+        elif req.spilled is not None and req.prefill_done >= ctx_len:
+            # spilled decode-phase continuation: _admit allocates for
+            # its full restored length, not just the prompt
+            need = req.length + min(self.decode_block_size, rem)
         else:
-            need = ctx_len + min(self.decode_block_size,
-                                 max(req.max_new_tokens -
-                                     len(req.generated), 1))
+            need = ctx_len + min(self.decode_block_size, rem)
         return self.allocator.can_allocate(need)
 
     def _pipeline_start(self, reqs: List[Request],
@@ -1222,6 +1317,7 @@ class RaggedInferenceEngineV2:
             grow_ok = bool(slots_active)
             table_dirty = False
             for s in slots_active:
+                self._last_sched[s] = self._sched_seq
                 if spec:
                     want = self._spec_grow_want(int(dv["plen_hi"][s]),
                                                 int(dv["rem"][s]))
@@ -1292,6 +1388,14 @@ class RaggedInferenceEngineV2:
                     if (dv["has_eos"][s] or dv["rem"][s] <= 0 or
                             dv["plen"][s] >= self.max_seq_len):
                         finish_possible = True
+            if self.tiering is not None and finish_possible:
+                # the projection says a slot may free at the next
+                # harvest: start NVMe->host reads for the spilled
+                # sequences the FIFO queue would re-admit first, under
+                # the decode block the device is still running
+                self.tiering.prefetch(
+                    [q.uid for q in itertools.islice(self.waiting, 8)
+                     if q.spilled is not None])
         if len(dv["pending"]) > self.async_depth:
             # bound device run-ahead without harvesting: wait for the
             # (now - depth)-th block; in-order execution keeps at most
@@ -1360,6 +1464,7 @@ class RaggedInferenceEngineV2:
         tokens per sequence per host dispatch) — pipelined across
         dispatches when ``pipeline=True``; any prefilling sequence
         falls back to the fused SplitFuse tick."""
+        self._sched_seq += 1
         if self._dev is not None:
             return self._pipeline_step()
         st = self.host_stats
@@ -1410,12 +1515,25 @@ class RaggedInferenceEngineV2:
             if stalled and live:
                 if len(live) == 1 and not self.waiting:
                     raise RuntimeError(
-                        "KV pool too small for the only live sequence "
+                        ("HBM KV tier" if self.tiering is not None
+                         else "KV pool") +
+                        " too small for the only live sequence "
                         f"(uid={live[0].uid}, needs "
                         f"{pages_for(live[0].length + 1, self.page_size)}"
                         f" pages of {self.allocator.num_pages - 1}) — "
-                        "raise num_pages or lower max_new_tokens")
-                self._evict(max(stalled, key=lambda r: r.uid))
+                        "raise num_pages or lower max_new_tokens" +
+                        (" (spill tiers hold parked sessions, not the "
+                         "live working set)" if self.tiering is not None
+                         else ""))
+                if self.tiering is not None:
+                    # park the coldest stalled sequence in the spill
+                    # tiers (restore = page upload); destructive evict
+                    # only when the tiers are full
+                    victim = self._pick_victim(stalled)
+                    if not self._spill(victim):
+                        self._evict(victim)
+                else:
+                    self._evict(max(stalled, key=lambda r: r.uid))
             return 0
         (token_ids, positions, kv_lens, page_indices, cu_q_lens, num_seqs,
          new_kv_dest, sample_rows, samplers) = plan
@@ -1445,6 +1563,15 @@ class RaggedInferenceEngineV2:
                 # continuations (their ctx carries earlier tokens)
                 need = req.ctx_len + req.max_new_tokens - \
                     len(req.generated)
+            elif req.spilled is not None:
+                # spilled continuation: its cache rows come back via
+                # restore, not re-prefill — pages must cover the live
+                # rows plus the first decode block
+                rem = max(req.max_new_tokens - len(req.generated), 1)
+                if req.prefill_done < req.ctx_len:
+                    need = req.ctx_len + min(self.decode_block_size, rem)
+                else:
+                    need = req.length + min(self.decode_block_size, rem)
             else:
                 # on-demand (reference can_schedule): context + the
                 # first decode block; growth happens per block
@@ -1454,8 +1581,21 @@ class RaggedInferenceEngineV2:
             if self.allocator.pages_for(need) > self.num_pages - 1:
                 # defense in depth behind put_request's submit-time
                 # check: an unschedulable head would deadlock the FIFO
-                # queue forever — drop it and fail loudly
+                # queue forever — drop it and fail loudly.  The HBM
+                # bound stays hard with tiering on: a sequence's WORKING
+                # SET must be device-resident to decode; the tiers only
+                # hold whole parked sessions.
                 self.waiting.popleft()
+                if self.tiering is not None:
+                    self.tiering.drop(req.uid)
+                    raise ValueError(
+                        f"request uid={req.uid} needs "
+                        f"{self.allocator.pages_for(need)} KV pages to "
+                        f"admit ({need} tokens) but the HBM tier owns "
+                        f"{self.num_pages - 1} usable pages — a working "
+                        "set can only decode device-resident; raise "
+                        "num_pages (spill tiers hold parked sessions, "
+                        "not live ones)")
                 raise ValueError(
                     f"request uid={req.uid} needs "
                     f"{self.allocator.pages_for(need)} KV pages to admit "
@@ -1466,12 +1606,15 @@ class RaggedInferenceEngineV2:
                 break                      # FIFO: wait for pages to free
             self.waiting.popleft()
             req.slot = i
-            req.prefill_done = 0
+            if req.spilled is None:
+                req.prefill_done = 0       # spilled reqs keep their rows
             self.slots[i] = req
             self._draft_len[i] = 0
             pages = self.allocator.allocate(i, need)
             self.page_table[i, :] = -1
             self.page_table[i, :len(pages)] = pages
+            if req.spilled is not None:
+                self._restore(req)
 
     def _ensure_pages(self, slot: int, upto_tokens: int) -> bool:
         """Grow ``slot``'s page run to cover ``upto_tokens`` cache
@@ -1508,6 +1651,140 @@ class RaggedInferenceEngineV2:
         logger.info(f"ragged engine: evicted uid={r.uid} "
                     f"({r.ctx.size} ctx tokens) — KV pool exhausted; "
                     "requeued as continuation")
+
+    # -- tiered KV spill/restore (HBM <-> host RAM <-> NVMe) -------------
+
+    def _tier_jits(self):
+        """The two fixed-shape page-movement programs (compiled once,
+        first spill/restore — the zero-new-compilation guard covers the
+        steady state after that):
+
+        - gather: ``[pages_per_seq]`` page rows out of every cache leaf
+          (indices padded with the trash page 0 — always allocated).
+        - scatter: the same rows back in, donating the cache buffers;
+          pad indices point one past the pool and ``mode='drop'``
+          discards them, so a partial restore writes exactly its live
+          rows."""
+        if self._tier_gather is None:
+            def gather(cache, idx):
+                return jax.tree_util.tree_map(
+                    lambda l: jnp.take(l, idx, axis=0), cache)
+
+            def scatter(cache, idx, rows):
+                return jax.tree_util.tree_map(
+                    lambda l, r: l.at[idx].set(r, mode="drop"),
+                    cache, rows)
+
+            self._tier_gather = jax.jit(gather)
+            self._tier_scatter = jax.jit(scatter, donate_argnums=(0,))
+        return self._tier_gather, self._tier_scatter
+
+    def _live_tokens(self, r) -> int:
+        """Cache rows that hold real KV for ``r`` RIGHT NOW.  Decode
+        phase: the last sampled token's row is written by the NEXT tick
+        (at position length-1), so ``length - 1`` rows are live.
+        Prefill phase: exactly the prefilled prefix."""
+        if r.prefill_done >= r.ctx_len:
+            return r.length - 1
+        return r.prefill_done
+
+    def _spill(self, r) -> bool:
+        """Park ``r`` in the spill tiers instead of destroying its KV:
+        gather its live page rows, hand them (device_get) to the tier
+        store, and requeue it as a RESTORABLE continuation.  Returns
+        False when the tiers can't take it (caller falls back to
+        ``_evict``'s re-prefill).  Restore is bit-identical to never
+        having spilled: the exact cache rows come back, ``prefill_done``
+        and the pending last token are preserved."""
+        live = self._live_tokens(r)
+        n_live = pages_for(live, self.page_size) if live > 0 else 0
+        if (n_live == 0 or self.tiering is None or
+                not self.tiering.can_spill(n_live)):
+            return False
+        st = self.host_stats
+        with st.stage("spill"):
+            gather, _ = self._tier_jits()
+            idx = np.zeros((self.pages_per_seq,), np.int32)  # pad: trash
+            idx[:n_live] = self.page_table[r.slot, :n_live]
+            rows = jax.device_get(gather(self.cache, jnp.asarray(idx)))
+            try:
+                self.tiering.spill(
+                    r.uid,
+                    [np.asarray(leaf[:n_live]) for leaf in
+                     jax.tree_util.tree_leaves(rows)],
+                    n_live)
+            except RuntimeError:
+                return False               # tiers full: caller evicts
+            r.spilled = {"last_tok": int(self._last_tokens[r.slot]),
+                         "n_pages": n_live, "live_tokens": live}
+        from deepspeed_tpu.utils.logging import logger
+
+        self.allocator.free(r.slot)
+        self.page_table[r.slot, :] = -1
+        self.slots[r.slot] = None
+        self._draft_len[r.slot] = 0
+        r.slot = -1
+        self.waiting.append(r)             # back of the queue, like evict
+        self.spills += 1
+        logger.info(f"ragged engine: spilled uid={r.uid} ({n_live} pages,"
+                    f" {live} live tokens) to the KV tiers — restore is "
+                    "a page upload, not a re-prefill")
+        return True
+
+    def _restore(self, req) -> None:
+        """Upload ``req``'s spilled page rows into its freshly allocated
+        pages (slot already assigned by ``_admit``).  On unrecoverable
+        corruption (:class:`KVRestoreError` — the store already
+        quarantined the payload) the request falls back to a plain
+        re-prefill continuation at the FRONT of the queue, loudly."""
+        from deepspeed_tpu.inference.kv_tiering import KVRestoreError
+        from deepspeed_tpu.utils.logging import logger
+
+        st = self.host_stats
+        info = req.spilled
+        n = info["n_pages"]
+        try:
+            with st.stage("restore"):
+                arrs = self.tiering.restore(req.uid)
+                _, scatter = self._tier_jits()
+                # pad indices past the pool: mode='drop' discards them
+                idx = np.full((self.pages_per_seq,), self.num_pages,
+                              np.int32)
+                idx[:n] = self.page_table[req.slot, :n]
+                leaves = []
+                for a in arrs:
+                    full = np.zeros((self.pages_per_seq,) + a.shape[1:],
+                                    a.dtype)
+                    full[:n] = a
+                    leaves.append(jnp.asarray(full))
+                rows = jax.tree_util.tree_unflatten(self._cache_treedef,
+                                                    leaves)
+                self.cache = scatter(self.cache, jnp.asarray(idx), rows)
+            self._last_tokens[req.slot] = info["last_tok"]
+            req.spilled = None
+            self.restores += 1
+        except KVRestoreError as e:
+            self.allocator.free(req.slot)
+            self.page_table[req.slot, :] = -1
+            self.slots[req.slot] = None
+            self._draft_len[req.slot] = 0
+            req.ctx = np.concatenate(
+                [req.prompt, np.asarray(req.generated, np.int32)])
+            req.prefill_done = 0
+            req.spilled = None
+            req.slot = -1
+            self.waiting.appendleft(req)   # front: it already waited
+            logger.error(
+                f"ragged engine: restore of uid={req.uid} failed "
+                f"verification (page {e.page}; payload quarantined) — "
+                "re-prefilling the session from its own tokens")
+
+    def _pick_victim(self, stalled):
+        """Coldest page-stalled sequence: least-recently scheduled
+        (tie: youngest) — it has waited longest for pages and will wait
+        longest for them, so parking it frees the most useful HBM."""
+        return min(stalled,
+                   key=lambda r: (self._last_sched[r.slot], -r.uid))
 
     def _flat_dest(self, slot: int, pos: int) -> int:
         page = self.page_table[slot, pos // self.page_size]
@@ -1573,6 +1850,7 @@ class RaggedInferenceEngineV2:
         for r in [s for s in self.slots if s is not None]:
             if r.done or r.uid in stalled_uids:
                 continue
+            self._last_sched[r.slot] = self._sched_seq
             if r.prefill_done >= r.ctx_len:                 # decode: 1 tok
                 p = min(r.length - 1, self.max_seq_len - 1)
                 token_ids[t] = self._last_tokens[r.slot]

@@ -26,6 +26,11 @@ Instrumented sites (the stable surface; grep for ``faults.hook``):
                           corruption is modeled by ``count``)
 ``swap.read_item``        after each leafwise moment-shard read joins,
                           before verification (and per re-read)
+``kv.read_page``          per spilled-KV page per restore attempt
+                          (inference/kv_tiering.py), before the page's
+                          digest check — fires again per re-read, so
+                          ``count`` models transient (heals) vs
+                          persistent (quarantine + re-prefill) flips
 ``comm.all_reduce``       once per EAGER all_reduce call (comm/comm.py)
 ``comm.all_gather``       once per eager all_gather call
 ``comm.broadcast``        once per eager broadcast call
@@ -49,7 +54,7 @@ Fault kinds:
               collective (a slow rank; peers stall waiting for it)
 ``drop``      comm sites: skip the collective entirely on this rank,
               so peers hang in it (the collective-watchdog's quarry)
-``bitflip``   swap read sites: flip ``param`` random bit(s) of the
+``bitflip``   swap/kv read sites: flip ``param`` random bit(s) of the
               just-read buffer (silent host-buffer/DMA/media
               corruption — the SDC verifier's quarry).  Positions come
               from the injector's seeded rng; with ``count=1`` the

@@ -546,8 +546,10 @@ class NvmeOptimizerSwapper:
         self._bucket_sums: Dict[int, tuple] = {}   # kb -> (digest, nbytes)
         # (key, tag) -> ((m_digest, m_nbytes), (v_digest, v_nbytes))
         self._item_sums: Dict[tuple, tuple] = {}
-        self._sum_futs: Dict[tuple, Any] = {}      # in-flight digest jobs
-        self._sum_pool = None                      # lazy ThreadPoolExecutor
+        # in-flight digest jobs live on the shared bounded-async-stage
+        # substrate (keyed submit / selective pop / forced settle) —
+        # the executor inside stays unspun until the first deferred job
+        self._sdc_pool = None                      # lazy DigestPool
         # cumulative detection/recovery telemetry (surfaced through
         # stage_stats and MonitorMaster.write_sdc_health)
         self.sdc_counters: Dict[str, int] = {
@@ -620,15 +622,17 @@ class NvmeOptimizerSwapper:
     _SDC_DEFER_MIN = 4 << 20
 
     def _pool(self):
-        """Digest worker (lazy): numpy/zlib checksums release the GIL,
-        so write-side digests genuinely overlap the in-flight IO and
-        the device compute instead of extending the stream's wall."""
-        if self._sum_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
+        """Digest side pool (lazy), on the shared bounded-async-stage
+        substrate: numpy/zlib checksums release the GIL, so write-side
+        digests genuinely overlap the in-flight IO and the device
+        compute instead of extending the stream's wall."""
+        if self._sdc_pool is None:
+            from deepspeed_tpu.resilience.sdc import DigestPool
 
-            self._sum_pool = ThreadPoolExecutor(
-                max_workers=2, thread_name_prefix="dstpu-sdc")
-        return self._sum_pool
+            self._sdc_pool = DigestPool(
+                algo=self._sdc_algo, workers=2,
+                defer_min=self._SDC_DEFER_MIN)
+        return self._sdc_pool
 
     def _digest(self, arr) -> tuple:
         from deepspeed_tpu.resilience.sdc import digest
@@ -643,14 +647,13 @@ class NvmeOptimizerSwapper:
             return
         # the bucket's bytes changed: any per-item digests recorded by
         # an earlier spill/restore are stale now
+        pool = self._pool()
         for it in self._buckets[kb]["items"]:
             self._item_sums.pop((it["key"], it["tag"]), None)
-            self._sum_futs.pop(("i", it["key"], it["tag"]), None)
-        if defer and arr.nbytes >= self._SDC_DEFER_MIN:
-            self._sum_futs[("b", kb)] = self._pool().submit(
-                self._digest, arr)
-        else:
-            self._bucket_sums[kb] = self._digest(arr)
+            pool.discard(("i", it["key"], it["tag"]))
+        d = pool.note(("b", kb), arr, defer=defer)
+        if d is not None:
+            self._bucket_sums[kb] = d
 
     def _note_item_sums(self, key: str, tag: str, m, v,
                         defer: bool = True) -> None:
@@ -658,8 +661,8 @@ class NvmeOptimizerSwapper:
         if not self._sdc_verify:
             return
         if defer and m.nbytes + v.nbytes >= self._SDC_DEFER_MIN:
-            self._sum_futs[("i", key, tag)] = self._pool().submit(
-                lambda: (self._digest(m), self._digest(v)))
+            self._pool().submit(("i", key, tag),
+                                lambda: (self._digest(m), self._digest(v)))
         else:
             self._item_sums[(key, tag)] = (self._digest(m),
                                            self._digest(v))
@@ -669,24 +672,24 @@ class NvmeOptimizerSwapper:
         (save/spill/restore paths need the full picture; the per-read
         verify gates use the SELECTIVE lookups below instead, so they
         never block on digests of unrelated in-flight writes)."""
-        futs, self._sum_futs = self._sum_futs, {}
-        for k, fut in futs.items():
-            d = fut.result()
+        if self._sdc_pool is None:
+            return
+        for k, d in self._sdc_pool.settle().items():
             if k[0] == "b":
                 self._bucket_sums[k[1]] = d
             else:
                 self._item_sums[(k[1], k[2])] = d
 
     def _expected_bucket_sum(self, kb: int) -> Optional[tuple]:
-        fut = self._sum_futs.pop(("b", kb), None)
-        if fut is not None:
-            self._bucket_sums[kb] = fut.result()
+        if self._sdc_pool is not None and ("b", kb) in self._sdc_pool:
+            self._bucket_sums[kb] = self._sdc_pool.pop(("b", kb))
         return self._bucket_sums.get(kb)
 
     def _expected_item_sums(self, key: str, tag: str) -> Optional[tuple]:
-        fut = self._sum_futs.pop(("i", key, tag), None)
-        if fut is not None:
-            self._item_sums[(key, tag)] = fut.result()
+        if (self._sdc_pool is not None
+                and ("i", key, tag) in self._sdc_pool):
+            self._item_sums[(key, tag)] = self._sdc_pool.pop(
+                ("i", key, tag))
         return self._item_sums.get((key, tag))
 
     def _sdc_clear(self) -> None:
@@ -694,7 +697,8 @@ class NvmeOptimizerSwapper:
         verify (runs alongside ``_initialized/_bucket_ready`` clears)."""
         self._bucket_sums.clear()
         self._item_sums.clear()
-        self._sum_futs.clear()
+        if self._sdc_pool is not None:
+            self._sdc_pool.clear()
 
     def _quarantine_file(self, fname: str) -> str:
         """Move a checksum-failing swap file aside (never delete — the
@@ -995,10 +999,9 @@ class NvmeOptimizerSwapper:
             self.drain()
         except Exception:
             pass
-        if self._sum_pool is not None:
-            self._sum_pool.shutdown(wait=True)
-            self._sum_pool = None
-        self._sum_futs.clear()
+        if self._sdc_pool is not None:
+            self._sdc_pool.close()
+            self._sdc_pool = None
         shutil.rmtree(self.swap_dir, ignore_errors=True)
         try:
             atexit.unregister(self._atexit)

@@ -1,0 +1,232 @@
+"""Shared bounded-async-stage substrate for the host-side pipelines.
+
+Three subsystems grew the same machinery by hand: the NVMe moment
+stream (``runtime/swap_tensor.py`` — ``buffer_count`` read buffers with
+B-1 reads in flight, a bounded write-back window, deferred writes
+drained at forced points), the serving host path
+(``inference/v2/ragged_engine.py`` — a device-resident carry bounded by
+``async_depth`` with forced harvests), and the SDC digest side pool
+(``runtime/swap_tensor.py`` keyed futures with selective joins).  This
+module extracts the common skeleton so new pipelines (the tiered
+paged-KV store, for one) compose it instead of re-growing it:
+
+``BoundedAsyncStage``
+    a bounded window of keyed in-flight async operations.  Submitting
+    past the window's depth first joins the oldest op (back-pressure —
+    the swap stream's write-depth bound).  ``drain()`` is the forced-
+    drain point: joins EVERYTHING, collects results, raises the first
+    error only after all ops are reaped (the ``_drain_deferred``
+    invalidation contract — no op left silently in flight).  ``pop``
+    is the selective join the SDC verify gates need: joins exactly one
+    keyed op, never blocking on unrelated in-flight work.
+
+``HostBufferPool``
+    a fixed ring of page-aligned host staging buffers
+    (:func:`deepspeed_tpu.io.aio.aligned_empty` — the O_DIRECT
+    eligibility requirement) with the swap stream's reuse invariant:
+    a slot is only reissued once its previous tenant is released.
+
+``StageTimers``
+    per-stage wall timers + counters in the shape the existing
+    telemetry consumers expect (``stage_stats`` / ``serving_stages``
+    style ``<stage>_s`` floats), so substrate users feed
+    ``MonitorMaster`` without a new schema.
+
+The substrate is deliberately loop-free: no worker thread of its own.
+Asynchrony comes from whatever the caller submits (AIO ops, executor
+futures, device transfers) — the substrate only bounds, times, and
+drains it, which is why one abstraction fits IO rings and thread pools
+alike.
+"""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["BoundedAsyncStage", "HostBufferPool", "StageTimers"]
+
+
+class StageTimers:
+    """Accumulating wall timers + counters, one bucket per stage name.
+
+    ``snapshot()`` emits ``{f"{stage}_s": seconds}`` floats plus raw
+    counters — the exact shape ``stage_stats`` / ``serving_stages``
+    consumers (bench rows, ``MonitorMaster``) already flatten.
+    """
+
+    def __init__(self) -> None:
+        self.seconds: Dict[str, float] = {}
+        self.counters: Dict[str, int] = {}
+
+    @contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] = (self.seconds.get(name, 0.0)
+                                  + time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float) -> None:
+        self.seconds[name] = self.seconds.get(name, 0.0) + seconds
+
+    def count(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def snapshot(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {f"{k}_s": round(v, 6)
+                               for k, v in sorted(self.seconds.items())}
+        out.update(sorted(self.counters.items()))
+        return out
+
+    def reset(self) -> None:
+        self.seconds.clear()
+        self.counters.clear()
+
+
+class BoundedAsyncStage:
+    """Bounded window of keyed in-flight async operations.
+
+    Parameters
+    ----------
+    waiter:
+        ``waiter(op) -> result`` joins one submitted op (e.g.
+        ``aio_handle.wait`` or ``Future.result``).  It is the ONLY way
+        an op completes from the substrate's point of view.
+    depth:
+        max ops in flight.  ``submit`` past this first joins the
+        oldest op (back-pressure), recording the blocked time under
+        the ``submit_wait`` stage — the swap stream's write-depth
+        bound generalized.
+    timers:
+        optional shared :class:`StageTimers`; one is created if absent.
+    """
+
+    def __init__(self, waiter: Callable[[Any], Any], depth: int = 2,
+                 timers: Optional[StageTimers] = None,
+                 name: str = "stage") -> None:
+        self._waiter = waiter
+        self.depth = max(1, int(depth))
+        self.name = name
+        self.timers = timers if timers is not None else StageTimers()
+        # key -> (op, on_done) in submission order (the window IS the
+        # ordering — oldest-first joins keep slot-reuse invariants)
+        self._inflight: "OrderedDict[Any, Tuple[Any, Any]]" = OrderedDict()
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._inflight)
+
+    def __contains__(self, key: Any) -> bool:
+        return key in self._inflight
+
+    def keys(self) -> List[Any]:
+        return list(self._inflight)
+
+    # -- the three verbs -------------------------------------------------
+
+    def submit(self, key: Any, op: Any,
+               on_done: Optional[Callable[[Any], Any]] = None) -> None:
+        """Track ``op`` under ``key``; joins the oldest op first if the
+        window is full.  ``on_done(result)`` runs at join time (drain,
+        pop, or back-pressure) — the place buffer-release / metadata
+        folds live.  Re-submitting a live key joins the old op first
+        (a key names a logical slot; two ops on one slot would race)."""
+        if key in self._inflight:
+            self.pop(key)
+        while len(self._inflight) >= self.depth:
+            with self.timers.stage("submit_wait"):
+                self._join_oldest()
+        self._inflight[key] = (op, on_done)
+        self.timers.count("submitted")
+
+    def pop(self, key: Any, default: Any = None) -> Any:
+        """Selective join: complete exactly ``key``'s op (if live) and
+        return its result, never touching unrelated in-flight work —
+        the SDC verify-gate lookup."""
+        ent = self._inflight.pop(key, None)
+        if ent is None:
+            return default
+        return self._finish(key, ent)
+
+    def drain(self) -> List[Any]:
+        """Forced-drain point: join EVERYTHING in submission order.
+        Every op is reaped even when one fails; the first error is
+        re-raised after the sweep (the ``_drain_deferred`` contract —
+        callers at invalidation points must not leave ops racing a
+        reused buffer)."""
+        results, first_err = [], None
+        with self.timers.stage("drain"):
+            while self._inflight:
+                key, ent = next(iter(self._inflight.items()))
+                del self._inflight[key]
+                try:
+                    results.append(self._finish(key, ent))
+                except BaseException as e:   # noqa: BLE001 — re-raised
+                    if first_err is None:
+                        first_err = e
+        if first_err is not None:
+            raise first_err
+        return results
+
+    # -- internals -------------------------------------------------------
+
+    def _join_oldest(self) -> None:
+        key, ent = next(iter(self._inflight.items()))
+        del self._inflight[key]
+        self._finish(key, ent)
+
+    def _finish(self, key: Any, ent: Tuple[Any, Any]) -> Any:
+        op, on_done = ent
+        res = self._waiter(op)
+        self.timers.count("completed")
+        if on_done is not None:
+            res = on_done(res)
+        return res
+
+
+class HostBufferPool:
+    """Fixed ring of page-aligned host staging buffers.
+
+    Reuse invariant (the swap read-path's): ``acquire`` hands out the
+    ring slot AFTER the caller's ``release`` of its previous tenant —
+    here enforced by construction: ``acquire`` raises if every slot is
+    checked out, so a bounded pipeline (window depth < pool size) can
+    never scribble over bytes an in-flight op still owns.
+    """
+
+    def __init__(self, count: int, nbytes: int) -> None:
+        from deepspeed_tpu.io.aio import aligned_empty
+
+        self.count = max(1, int(count))
+        self.nbytes = int(nbytes)
+        self._bufs = [aligned_empty(self.nbytes) for _ in range(self.count)]
+        self._free = list(range(self.count))
+
+    @property
+    def free(self) -> int:
+        return len(self._free)
+
+    def acquire(self) -> Tuple[int, Any]:
+        """``(slot, buffer)``; the buffer is the caller's until
+        ``release(slot)``."""
+        if not self._free:
+            raise RuntimeError(
+                f"HostBufferPool exhausted ({self.count} slots all "
+                "checked out) — the in-flight window must drain before "
+                "reusing a staging buffer")
+        slot = self._free.pop()
+        return slot, self._bufs[slot]
+
+    def peek(self, slot: int) -> Any:
+        """The slot's buffer (the holder's view while checked out)."""
+        return self._bufs[slot]
+
+    def release(self, slot: int) -> None:
+        if slot in self._free:
+            raise RuntimeError(f"HostBufferPool slot {slot} double-freed")
+        self._free.append(slot)

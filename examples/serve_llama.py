@@ -38,6 +38,16 @@ def main() -> None:
                    help="model-zoo preset for --spec-mode draft "
                         "(random weights unless it matches "
                         "--checkpoint's family)")
+    p.add_argument("--kv-tiering", action="store_true",
+                   help="spill cold sequences' KV pages to host RAM "
+                        "(and NVMe, with --kv-nvme-pages) instead of "
+                        "evicting when the HBM pool fills")
+    p.add_argument("--kv-host-pages", type=int, default=256,
+                   help="host-RAM tier budget in KV pages")
+    p.add_argument("--kv-nvme-pages", type=int, default=0,
+                   help="NVMe tier budget in KV pages (0 = host only)")
+    p.add_argument("--kv-nvme-dir", default=None,
+                   help="directory for NVMe tier page files")
     args = p.parse_args()
 
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -61,13 +71,18 @@ def main() -> None:
                           vocab_size=cfg.vocab_size,
                           max_position_embeddings=cfg.max_position_embeddings)
         spec_kw = dict(draft_model=LlamaForCausalLM(dcfg))
+    tiering = None
+    if args.kv_tiering:
+        tiering = {"host_pages": args.kv_host_pages,
+                   "nvme_pages": args.kv_nvme_pages,
+                   "nvme_dir": args.kv_nvme_dir}
     engine = RaggedInferenceEngineV2(
         model, params=params, max_seqs=args.max_seqs,
         max_seq_len=args.max_seq_len, prefill_chunk=64,
         pipeline=not args.no_pipeline,
         harvest_interval=args.harvest_interval,
         speculation={"mode": args.spec_mode, "k": args.spec_k},
-        **spec_kw)
+        kv_tiering=tiering, **spec_kw)
 
     # a burst of variable-length "requests"
     rng = np.random.default_rng(0)
@@ -96,6 +111,14 @@ def main() -> None:
                        ("spec_dispatches", "draft_ms", "verify_ms",
                         "acceptance_rate", "mean_accepted_len",
                         "effective_tokens_per_dispatch")))
+    tier = stages.get("kv_tiering")
+    if tier:
+        print("kv tiering: " +
+              " ".join(f"{k}={tier[k]}" for k in
+                       ("spills", "restores", "pages_spilled",
+                        "pages_restored", "pages_verified", "demotions",
+                        "nvme_spills", "prefetch_hits")))
+        engine.close()
 
 
 if __name__ == "__main__":

@@ -14,7 +14,14 @@ exits NONZERO if
   ``self_draft`` where the draft IS the target), or
 - a pipelined run's dispatch accounting regresses to per-block syncs.
 
+With ``--kv-tiering`` it additionally gates the tiered paged-KV store:
+a deliberately tiny HBM pool forces spill/restore traffic, and the run
+exits NONZERO if the tiering-on greedy output diverges from the
+tiering-off reference, if no spill actually happened (the gate would
+be vacuous), or if any restored page skipped digest verification.
+
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py [--tokens 250]
+    JAX_PLATFORMS=cpu python scripts/serve_smoke.py --kv-tiering
 """
 import argparse
 import os
@@ -30,6 +37,10 @@ def main() -> int:
     p.add_argument("--tokens", type=int, default=250,
                    help="max_new_tokens per request (2 requests)")
     p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--kv-tiering", action="store_true",
+                   help="also gate the tiered paged-KV store (tiny "
+                        "pool, spill/restore parity + verified "
+                        "restores)")
     args = p.parse_args()
 
     import jax
@@ -108,11 +119,54 @@ def main() -> int:
               f"tokens_per_target_pass="
               f"{round(1 + spec.get('mean_accepted_len', 0), 3)} "
               f"spec_dispatches={spec.get('spec_dispatches')}")
+    if args.kv_tiering:
+        # tiny pool: four sequences cannot all stay HBM-resident, so
+        # the engine must spill/restore to finish them — and the
+        # output must still match the tiering-off run bit-for-bit
+        tier_kw = dict(max_seqs=4, page_size=16, num_pages=9,
+                       prefill_chunk=16, decode_block_size=4)
+        tier_prompts = [rng.integers(1, 64, size=(n,), dtype=np.int32)
+                        for n in (12, 20, 9, 16)]
+
+        def tier_run(tiering):
+            eng = RaggedInferenceEngineV2(
+                LlamaForCausalLM(cfg), params=params, max_seq_len=128,
+                kv_tiering=tiering, rng=jax.random.PRNGKey(args.seed),
+                **tier_kw)
+            outs = eng.generate_all(list(tier_prompts),
+                                    max_new_tokens=40)
+            return outs, eng
+
+        t_ref, _ = tier_run(None)
+        t_on, t_eng = tier_run({"host_pages": 64})
+        st = t_eng.tiering.stats()
+        ok = sorted(t_on) == sorted(t_ref) and all(
+            np.array_equal(t_on[u], t_ref[u]) for u in t_ref)
+        if not ok:
+            print("FAIL [kv-tiering]: tiering-on greedy output diverged "
+                  "from tiering-off")
+            failures += 1
+        if not st["spills"] > 0:
+            print("FAIL [kv-tiering]: no spill traffic — the gate ran "
+                  f"vacuously ({st})")
+            failures += 1
+        if st["pages_verified"] != st["pages_restored"]:
+            print("FAIL [kv-tiering]: unverified restore: "
+                  f"{st['pages_restored']} pages restored, only "
+                  f"{st['pages_verified']} digest-verified")
+            failures += 1
+        print(f"[kv-tiering] ok={ok} spills={st['spills']} "
+              f"restores={st['restores']} evictions={t_eng.evictions} "
+              f"pages_verified={st['pages_verified']}/"
+              f"{st['pages_restored']}")
+        t_eng.close()
     if failures:
         print(f"serve_smoke: {failures} failure(s)")
         return 1
     print("serve_smoke: all speculation modes bit-identical to spec-off, "
-          "acceptance healthy")
+          "acceptance healthy" +
+          (", kv tiering spill/restore exact and verified"
+           if args.kv_tiering else ""))
     return 0
 
 
