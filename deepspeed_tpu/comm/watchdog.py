@@ -75,12 +75,17 @@ class CollectiveWatchdog:
             pool.shutdown(wait=False)
             logger.error(f"collective watchdog: {what} exceeded "
                          f"{self.deadline_s:.1f}s deadline — failing fast")
-            raise CollectiveTimeout(
+            err = CollectiveTimeout(
                 f"{what} exceeded the {self.deadline_s:.1f}s collective "
                 "deadline (a peer rank dropped the collective, died "
                 "mid-collective, or the transport wedged); "
-                "resilience.comm.collective_timeout_s bounds this wait"
-            ) from None
+                "resilience.comm.collective_timeout_s bounds this wait")
+            from deepspeed_tpu.telemetry import flight
+
+            flight.dump_on_fault("collective_timeout", err,
+                                 extra={"what": what,
+                                        "deadline_s": self.deadline_s})
+            raise err from None
 
 
 _WATCHDOG = CollectiveWatchdog(

@@ -8,6 +8,8 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from deepspeed_tpu.telemetry import trace
+
 
 class HostStageStats:
     """Per-dispatch host-path breakdown for the serving engines.
@@ -69,7 +71,10 @@ class HostStageStats:
         try:
             yield
         finally:
-            self.seconds[name] += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.seconds[name] += dt
+            if trace.enabled:
+                trace.add_complete(name, t0, dt, cat="serving")
 
     def serving_stages(self) -> Dict[str, Any]:
         d = max(self.dispatches, 1)

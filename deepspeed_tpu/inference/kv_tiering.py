@@ -435,12 +435,18 @@ class TieredKVStore:
                     self.counters["reread_recovered"] += 1
             if not ok:
                 self._quarantine(ent, i)
-                raise KVRestoreError(
+                err = KVRestoreError(
                     ent.uid, i,
                     f"kv tiering: page {i} of spilled uid {ent.uid} "
                     f"failed {self.algo} verification after "
                     f"{tries} re-read(s) — payload quarantined, the "
                     "session must re-prefill")
+                from deepspeed_tpu.telemetry import flight
+
+                flight.dump_on_fault("kv_restore_error", err,
+                                     extra={"uid": int(ent.uid),
+                                            "page": int(i)})
+                raise err
             self.counters["pages_verified"] += 1
 
     def _quarantine(self, ent: _Entry, page: int) -> None:

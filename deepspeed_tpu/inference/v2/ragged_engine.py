@@ -110,6 +110,7 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
+import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
@@ -118,6 +119,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.inference.common import HostStageStats
+from deepspeed_tpu.telemetry import RequestLatencyTracker, trace
 from deepspeed_tpu.inference.paged import (PageAllocator,
                                            pages_for)
 from deepspeed_tpu.inference.sampling import (filter_logits_batched,
@@ -294,6 +296,9 @@ class RaggedInferenceEngineV2:
             int(harvest_interval) if harvest_interval is not None else 4,
             1)
         self.host_stats = HostStageStats()
+        # per-request lifecycle latency (TTFT/TPOT/queue-wait/spill-
+        # stall percentiles) — always on; independent of the tracer
+        self.request_latency = RequestLatencyTracker()
         # device-resident decode-loop state while the pipeline runs
         # ahead of the host (None <=> host state is authoritative)
         self._dev: Optional[Dict[str, Any]] = None
@@ -592,6 +597,10 @@ class RaggedInferenceEngineV2:
                     "the kv_tiering host_pages/nvme_pages budgets")
         req = Request(uid=next(self._uid), prompt=prompt, **kw)
         self.waiting.append(req)
+        self.request_latency.on_submit(req.uid)
+        if trace.enabled:
+            trace.event("request_submit", cat="request", uid=req.uid,
+                        prompt_len=int(prompt.size), max_new=max_new)
         return req.uid
 
     def get_outputs(self) -> List[Tuple[int, np.ndarray]]:
@@ -626,6 +635,7 @@ class RaggedInferenceEngineV2:
         out = self.host_stats.serving_stages()
         if self.tiering is not None:
             out["kv_tiering"] = self.tiering.stats()
+        out["requests"] = self.request_latency.summary()
         return out
 
     def close(self) -> None:
@@ -841,6 +851,10 @@ class RaggedInferenceEngineV2:
             new = toks[mask[:, r.slot], r.slot]
             r.generated.extend(int(t) for t in new)
             produced += int(new.size)
+            if new.size:
+                # harvest-time token visibility: the honest host-side
+                # TTFT/TPOT timestamp under the deferred-harvest pipeline
+                self.request_latency.on_tokens(r.uid, len(r.generated))
         return produced
 
     # -- the speculative decode block (round-6 tentpole) ------------------
@@ -1159,6 +1173,10 @@ class RaggedInferenceEngineV2:
         args = [self._upload(a) for a in
                 (hist, last_tok, pos, active, remaining, self.page_table,
                  eos_ids, do_sample, temperature, top_k, top_p)]
+        if trace.enabled:
+            trace.event("decode_block", cat="request",
+                        uids=[r.uid for r in reqs],
+                        ticks=self.decode_block_size, spec=True)
         with st.stage("verify"):
             st.dispatches += 1
             st.spec_dispatches += 1
@@ -1197,6 +1215,10 @@ class RaggedInferenceEngineV2:
         args = [self._upload(a) for a in
                 (last_tok, pos, active, remaining, self.page_table,
                  eos_ids, do_sample, temperature, top_k, top_p)]
+        if trace.enabled:
+            trace.event("decode_block", cat="request",
+                        uids=[r.uid for r in reqs],
+                        ticks=self.decode_block_size)
         with st.stage("dispatch"):
             st.dispatches += 1
             (cache, new_last, _pos, _active, _remaining, toks,
@@ -1336,6 +1358,11 @@ class RaggedInferenceEngineV2:
         if table_dirty:
             dv["page_table"] = self._upload(self.page_table)
         self.rng, sub = jax.random.split(self.rng)
+        if trace.enabled:
+            trace.event("decode_block", cat="request",
+                        uids=[r.uid for r in dv["reqs"]],
+                        ticks=self.decode_block_size, pipelined=True,
+                        spec=bool(spec))
         if spec:
             with st.stage("verify"):
                 st.dispatches += 1
@@ -1613,6 +1640,11 @@ class RaggedInferenceEngineV2:
             pages = self.allocator.allocate(i, need)
             self.page_table[i, :] = -1
             self.page_table[i, :len(pages)] = pages
+            self.request_latency.on_admit(req.uid)
+            if trace.enabled:
+                trace.event("request_admit", cat="request", uid=req.uid,
+                            slot=i, pages=len(pages),
+                            spilled=req.spilled is not None)
             if req.spilled is not None:
                 self._restore(req)
 
@@ -1648,6 +1680,9 @@ class RaggedInferenceEngineV2:
         r.slot = -1
         self.waiting.append(r)             # back of the queue: the freed
         self.evictions += 1                # pages go to older work first
+        if trace.enabled:
+            trace.event("request_evict", cat="request", uid=r.uid,
+                        ctx_tokens=int(r.ctx.size))
         logger.info(f"ragged engine: evicted uid={r.uid} "
                     f"({r.ctx.size} ctx tokens) — KV pool exhausted; "
                     "requeued as continuation")
@@ -1726,6 +1761,10 @@ class RaggedInferenceEngineV2:
         r.slot = -1
         self.waiting.append(r)             # back of the queue, like evict
         self.spills += 1
+        self.request_latency.on_spill(r.uid)
+        if trace.enabled:
+            trace.event("request_spill", cat="request", uid=r.uid,
+                        pages=int(n_live), live_tokens=int(live))
         logger.info(f"ragged engine: spilled uid={r.uid} ({n_live} pages,"
                     f" {live} live tokens) to the KV tiers — restore is "
                     "a page upload, not a re-prefill")
@@ -1743,6 +1782,7 @@ class RaggedInferenceEngineV2:
         st = self.host_stats
         info = req.spilled
         n = info["n_pages"]
+        t_restore0 = time.perf_counter()
         try:
             with st.stage("restore"):
                 arrs = self.tiering.restore(req.uid)
@@ -1763,6 +1803,11 @@ class RaggedInferenceEngineV2:
             self._last_tokens[req.slot] = info["last_tok"]
             req.spilled = None
             self.restores += 1
+            self.request_latency.on_restore_stall(
+                req.uid, time.perf_counter() - t_restore0)
+            if trace.enabled:
+                trace.event("request_restore", cat="request",
+                            uid=req.uid, pages=int(n))
         except KVRestoreError as e:
             self.allocator.free(req.slot)
             self.page_table[req.slot, :] = -1
@@ -1774,6 +1819,11 @@ class RaggedInferenceEngineV2:
             req.spilled = None
             req.slot = -1
             self.waiting.appendleft(req)   # front: it already waited
+            self.request_latency.on_restore_stall(
+                req.uid, time.perf_counter() - t_restore0)
+            if trace.enabled:
+                trace.event("request_restore_failed", cat="request",
+                            uid=req.uid, page=int(e.page))
             logger.error(
                 f"ragged engine: restore of uid={req.uid} failed "
                 f"verification (page {e.page}; payload quarantined) — "
@@ -1876,6 +1926,11 @@ class RaggedInferenceEngineV2:
                 new_kv_dest[t:t + take] = (pg * self.page_size +
                                            pos % self.page_size)
                 r.prefill_done += take
+                if trace.enabled:
+                    trace.event("prefill_chunk", cat="request",
+                                uid=r.uid, take=int(take),
+                                prefill_done=int(r.prefill_done),
+                                ctx_len=int(r.ctx_len))
                 page_indices[j] = self.page_table[r.slot]
                 kv_lens[j] = r.prefill_done
                 cu_q_lens[j + 1] = cu_q_lens[j] + take
@@ -1914,6 +1969,8 @@ class RaggedInferenceEngineV2:
                     r.generated.append(int(tok))
                     self._last_tokens[r.slot] = int(tok)
                     produced += 1
+                    self.request_latency.on_tokens(r.uid,
+                                                   len(r.generated))
                     self._maybe_finish(r)
         return produced
 
@@ -1932,6 +1989,10 @@ class RaggedInferenceEngineV2:
                 self.allocator.free(i)
                 self.page_table[i, :] = -1
                 self._draft_len[i] = 0
+                self.request_latency.on_finish(r.uid)
+                if trace.enabled:
+                    trace.event("request_reap", cat="request", uid=r.uid,
+                                tokens=len(r.generated))
 
     # -- introspection ----------------------------------------------------
 

@@ -24,29 +24,58 @@ class Monitor:
 
 
 class CSVMonitor(Monitor):
-    """``csv_monitor`` config subtree (reference ``csv_monitor.py:12``)."""
+    """``csv_monitor`` config subtree (reference ``csv_monitor.py:12``).
+
+    Per-series file handles stay open across ``write_events`` calls
+    (one ``open()`` per series for the process's lifetime, not one per
+    event — a serving-health flush emits dozens of series per step).
+    Rows are flushed per call so concurrent readers see them; ``close``
+    releases the handles.
+    """
 
     def __init__(self, config):
         super().__init__(config)
         self.output_path = getattr(config, "output_path", "") or "./csv_monitor"
         self.job_name = getattr(config, "job_name", "DeepSpeedTPUJobName")
-        self._files = {}
+        self._files = {}                   # series name -> (handle, writer)
         if self.enabled:
             os.makedirs(os.path.join(self.output_path, self.job_name),
                         exist_ok=True)
 
+    def _writer(self, name: str):
+        ent = self._files.get(name)
+        if ent is None:
+            fname = os.path.join(self.output_path, self.job_name,
+                                 name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname) or os.path.getsize(fname) == 0
+            f = open(fname, "a", newline="")
+            w = csv.writer(f)
+            if new:
+                w.writerow(["step", name])
+            ent = self._files[name] = (f, w)
+        return ent
+
     def write_events(self, events: List[Event]) -> None:
         if not self.enabled:
             return
+        touched = set()
         for name, value, step in events:
-            fname = os.path.join(self.output_path, self.job_name,
-                                 name.replace("/", "_") + ".csv")
-            new = not os.path.exists(fname)
-            with open(fname, "a", newline="") as f:
-                w = csv.writer(f)
-                if new:
-                    w.writerow(["step", name])
-                w.writerow([step, value])
+            f, w = self._writer(name)
+            w.writerow([step, value])
+            touched.add(name)
+        for name in touched:
+            self._files[name][0].flush()
+
+    def close(self) -> None:
+        for f, _ in self._files.values():
+            try:
+                f.close()
+            except Exception:
+                pass
+        self._files = {}
+
+    def __del__(self):  # best-effort: rows are already flushed per call
+        self.close()
 
 
 class TensorBoardMonitor(Monitor):
@@ -149,6 +178,11 @@ class MonitorMaster(Monitor):
         self.comet = CometMonitor(getattr(monitor_config, "comet", None))
         self.enabled = (self.tb.enabled or self.csv.enabled or
                         self.wandb.enabled or self.comet.enabled)
+
+    def close(self) -> None:
+        """Release writer resources (the CSV monitor's open per-series
+        handles; TB flushes per write already)."""
+        self.csv.close()
 
     def write_events(self, events: List[Event]) -> None:
         import jax

@@ -153,12 +153,17 @@ class DesyncDetector:
             f"{name}: " + ", ".join(f"rank{i}={v:.6g}"
                                     for i, v in enumerate(vals))
             for name, vals in bad)
-        raise GradientAnomalyError(
+        err = GradientAnomalyError(
             f"cross-rank desync at step {step}: {detail} — ranks hold "
             "different values for replica-identical state (a corrupted "
             "collective or diverged host-side stream). Abort and resume "
             "from the last verified checkpoint "
             "(resilience.comm.desync_interval controls this check).")
+        from deepspeed_tpu.telemetry import flight
+
+        flight.dump_on_fault("cross_rank_desync", err,
+                             extra={"step": int(step), "rank": int(rank)})
+        raise err
 
 
 # ---------------------------------------------------------------------------

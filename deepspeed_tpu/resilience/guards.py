@@ -49,9 +49,15 @@ class SkippedStepGuard:
             return
         self.consecutive += 1
         if self.consecutive >= self.bound:
-            raise GradientAnomalyError(
+            err = GradientAnomalyError(
                 f"{self.consecutive} consecutive steps produced non-finite "
                 f"gradients (through step {step}); the loss scaler cannot "
                 "recover from a divergent model. Inspect the data/loss and "
                 "resume from the last verified checkpoint "
                 "(resilience.max_consecutive_skips bounds this abort).")
+            from deepspeed_tpu.telemetry import flight
+
+            flight.dump_on_fault("gradient_anomaly", err,
+                                 extra={"step": int(step),
+                                        "consecutive": self.consecutive})
+            raise err

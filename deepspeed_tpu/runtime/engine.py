@@ -1748,6 +1748,12 @@ class DeepSpeedEngine:
         logger.error(f"collective timeout during training step: {e}")
         save_dir = self._preemption_save_dir
         if save_dir:
+            # the postmortem timeline lands NEXT TO the emergency
+            # checkpoint (the raise site already dumped to the default
+            # flight dir; this copy is the one operators find first)
+            from deepspeed_tpu.telemetry import flight
+
+            flight.dump_on_fault("collective_timeout", e, dir=save_dir)
             try:
                 path = self.emergency_checkpoint(save_dir)
                 logger.error(f"emergency checkpoint committed at {path}; "
@@ -1769,6 +1775,9 @@ class DeepSpeedEngine:
         logger.error(f"silent data corruption in the NVMe swap path: {e}")
         save_dir = self._preemption_save_dir
         if save_dir:
+            from deepspeed_tpu.telemetry import flight
+
+            flight.dump_on_fault("swap_corruption", e, dir=save_dir)
             try:
                 path = self.emergency_checkpoint(save_dir)
                 logger.error(f"emergency checkpoint committed at {path}; "
@@ -1795,6 +1804,11 @@ class DeepSpeedEngine:
             self.preempted = True
             logger.error(f"signal {signum}: preemption notice — taking "
                          "emergency checkpoint")
+            from deepspeed_tpu.telemetry import flight
+
+            flight.dump_on_fault("sigterm_preemption", dir=save_dir,
+                                 extra={"signal": int(signum),
+                                        "step": int(self.global_steps)})
             path = self.emergency_checkpoint(save_dir)
             logger.error(f"emergency checkpoint committed at {path}")
             if not exit_after:

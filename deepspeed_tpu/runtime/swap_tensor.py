@@ -556,7 +556,13 @@ class NvmeOptimizerSwapper:
             "verified": 0, "mismatches": 0, "rereads": 0,
             "reread_recovered": 0, "quarantined": 0, "restore_rejected": 0}
         # per-apply stage telemetry (see _apply_bucketed); engine surfaces
-        # it under wall_clock_breakdown and the bench infinity row
+        # it under wall_clock_breakdown and the bench infinity row.
+        # Accumulation routes through the shared StageTimers substrate
+        # (the one telemetry schema: <stage>_s floats + raw counters),
+        # which also re-emits each stage as a tracer span when tracing
+        # is on; stage_stats composes its snapshot with derived metrics
+        from deepspeed_tpu.utils.async_stage import StageTimers
+        self.stage_timers = StageTimers(cat="swap")
         self.stage_stats: Dict[str, Any] = {}
         # leafwise-stream IO accounting (incremented where reads/writes
         # are actually submitted; _apply_leafwise resets per apply and
@@ -760,10 +766,15 @@ class NvmeOptimizerSwapper:
 
         try:
             _reread()
-        except SwapCorruptionError:
+        except SwapCorruptionError as err:
             self._quarantine_file(fname)
             self._bucket_ready.discard(kb)
             self._bucket_sums.pop(kb, None)
+            from deepspeed_tpu.telemetry import flight
+
+            flight.dump_on_fault("swap_corruption", err,
+                                 extra={"bucket": int(kb),
+                                        "file": os.path.basename(fname)})
             raise
         self.sdc_counters["reread_recovered"] += 1
         logger.warning(f"NVMe swap: bucket {kb} re-read clean — "
@@ -821,10 +832,15 @@ class NvmeOptimizerSwapper:
 
         try:
             _reread()
-        except SwapCorruptionError:
+        except SwapCorruptionError as err:
             self._quarantine_file(fname)
             self._initialized.discard((key, tag))
             self._item_sums.pop((key, tag), None)
+            from deepspeed_tpu.telemetry import flight
+
+            flight.dump_on_fault("swap_corruption", err,
+                                 extra={"key": key,
+                                        "file": os.path.basename(fname)})
             raise
         self.sdc_counters["reread_recovered"] += 1
         logger.warning(f"NVMe swap: shard {key!r} re-read clean — "
@@ -1398,11 +1414,14 @@ class NvmeOptimizerSwapper:
                     # submitted when the read completed (usually done
                     # by now); mismatch re-reads, then quarantines +
                     # raises — corrupt bytes never reach the update
-                    t0 = _time.perf_counter()
-                    fut = verify_futs.pop(kb, None)
-                    self._verify_bucket_view(
-                        kb, view, got=fut.result() if fut else None)
-                    t_verify += _time.perf_counter() - t0
+                    if self._sdc_verify:
+                        t0 = _time.perf_counter()
+                        fut = verify_futs.pop(kb, None)
+                        self._verify_bucket_view(
+                            kb, view, got=fut.result() if fut else None)
+                        t_verify += _time.perf_counter() - t0
+                    else:
+                        self._verify_bucket_view(kb, view, got=None)
                     mv_in = view.reshape(2, b["n"])
                 ps = [leaves[idx[it["key"]]] for it in b["items"]]
                 gs = [flat_g[idx[it["key"]]] for it in b["items"]]
@@ -1457,15 +1476,21 @@ class NvmeOptimizerSwapper:
             if ok and err is not None:
                 raise err
         total = _time.perf_counter() - t_begin
+        st = self.stage_timers
+        st.reset()
+        # swap_verify is the main-thread residual of swap-in
+        # verification (the digest itself runs on the side pool under
+        # the read-ahead window; this is what it adds to the critical
+        # path)
+        for name, secs in (("swap_in_wait", t_in), ("bucket_update", t_up),
+                           ("swap_out_wait", t_out),
+                           ("swap_verify", t_verify), ("apply", total)):
+            st.add(name, secs)
+        st.count("bytes_read", int(bytes_read))
+        st.count("bytes_written", int(bytes_written))
+        st.count("buckets", nb)
         self.stage_stats = {
-            "swap_in_wait_s": round(t_in, 4),
-            "bucket_update_s": round(t_up, 4),
-            "swap_out_wait_s": round(t_out, 4),
-            # main-thread residual of swap-in verification (the digest
-            # itself runs on the side pool under the read-ahead window;
-            # this is what verification adds to the critical path)
-            "swap_verify_s": round(t_verify, 4),
-            "apply_s": round(total, 4),
+            **st.snapshot(),
             # fraction of the stream's wall NOT blocked on NVMe waits —
             # ~1.0 means the disk hides behind compute/transfers (or
             # vice versa); a low value localizes which stage starves via
@@ -1473,11 +1498,8 @@ class NvmeOptimizerSwapper:
             "overlap_efficiency": (round(1.0 - min(1.0, (t_in + t_out)
                                                    / total), 4)
                                    if total > 0 else None),
-            "bytes_read": int(bytes_read),
-            "bytes_written": int(bytes_written),
             "stream_gbps": (round((bytes_read + bytes_written)
                                   / total / 1e9, 3) if total > 0 else None),
-            "buckets": nb,
             "pipelined": pipelined,
             "sdc": dict(self.sdc_counters),   # cumulative
         }
@@ -1581,11 +1603,21 @@ class NvmeOptimizerSwapper:
         # reads/writes so the per-direction rates are indicative, the
         # combined stream_gbps exact)
         wall = _time.perf_counter() - t_apply0
+        st = self.stage_timers
+        st.reset()
+        # same schema as the bucketed path (StageTimers <stage>_s +
+        # counters): apply_s is the shared wall key; wall_s stays as a
+        # back-compat alias for the bench leafwise/multi-process rows
+        for name, secs in (("apply", wall),
+                           ("swap_verify", self._verify_wait_s)):
+            st.add(name, secs)
+        st.count("bytes_read", int(self._io_read_bytes))
+        st.count("bytes_written", int(self._io_write_bytes))
+        snap = st.snapshot()
         self.stage_stats = {
             "mode": "leafwise",
-            "wall_s": round(wall, 4),
-            "bytes_read": int(self._io_read_bytes),
-            "bytes_written": int(self._io_write_bytes),
+            **snap,
+            "wall_s": snap["apply_s"],
             "read_gbps": round(self._io_read_bytes / wall / 1e9, 6)
             if wall > 0 else 0.0,
             "write_gbps": round(self._io_write_bytes / wall / 1e9, 6)
@@ -1593,7 +1625,6 @@ class NvmeOptimizerSwapper:
             "stream_gbps": round((self._io_read_bytes
                                   + self._io_write_bytes) / wall / 1e9, 6)
             if wall > 0 else 0.0,
-            "swap_verify_s": round(self._verify_wait_s, 4),
             "sdc": dict(self.sdc_counters),   # cumulative
         }
         return jax.tree_util.tree_unflatten(

@@ -1,0 +1,33 @@
+"""Unified tracing + flight recorder.
+
+One span schema under every telemetry dialect in the tree:
+
+- ``trace`` — the process-wide :class:`~.tracer.Tracer` singleton.
+  ``with trace.span("swap_in_wait", bucket=3): ...`` when enabled;
+  a no-op singleton context manager (zero allocation) when disabled.
+- ``trace.export(path)`` — Chrome trace-event JSON for
+  https://ui.perfetto.dev.
+- ``flight.dump_on_fault(reason, exc)`` — dump the bounded span ring
+  to a self-describing JSONL on hard-failure paths.
+- :class:`RequestLatencyTracker` — per-request TTFT/TPOT/queue-wait/
+  spill-stall percentiles for the serving engines.
+
+Enable knobs: ``DSTPU_TRACE=1`` (env) or
+``telemetry.configure(enabled=True)``; ``DSTPU_TRACE_BUFFER`` sizes
+the per-thread rings; ``DSTPU_TRACE_ANNOTATE=1`` bridges spans into
+``jax.profiler`` device profiles; ``DSTPU_FLIGHT_DIR`` picks the
+flight-dump directory.
+
+Stdlib-only on import (jax is lazy) — safe to import from every layer.
+"""
+from deepspeed_tpu.telemetry.tracer import (Tracer, configure, get_tracer,
+                                            trace)
+from deepspeed_tpu.telemetry import flight
+from deepspeed_tpu.telemetry.flight import (dump_on_fault, last_dump_path,
+                                            read_flight_record)
+from deepspeed_tpu.telemetry.requests import (RequestLatencyTracker,
+                                              percentile)
+
+__all__ = ["Tracer", "trace", "get_tracer", "configure", "flight",
+           "dump_on_fault", "last_dump_path", "read_flight_record",
+           "RequestLatencyTracker", "percentile"]

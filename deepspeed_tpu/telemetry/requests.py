@@ -1,0 +1,140 @@
+"""Per-request serving latency: TTFT / TPOT / queue-wait / spill-stall.
+
+The ragged engine reports throughput (tok/s) but scale-out serving is
+gated on per-request percentiles — a batch that sustains 20k tok/s can
+still starve one request behind a spill storm.  The engine feeds this
+tracker from its lifecycle hooks (submit → admit → token folds →
+reap); ``summary()`` derives nearest-rank p50/p90/p99 over completed
+requests and returns a FLAT dict (``MonitorMaster.write_serving_health``
+flattens exactly one level of sub-dicts, so the shape must already be
+scalar-valued).
+
+Semantics under the pipelined host path: token timestamps are taken at
+HARVEST (when the host folds device tokens back into request state) —
+the honest host-visible latency, since the deferred-harvest pipeline
+means the host cannot observe a token earlier than that.
+
+- ``ttft``: first harvested token − submit
+- ``tpot``: (last − first token) / (tokens − 1), requests with ≥2 tokens
+- ``queue_wait``: first admit − submit
+- ``spill_stall``: accumulated restore-bracket seconds per request
+
+The tracker is always on (a few dict ops per request per harvest —
+noise next to a device dispatch), independent of the tracer's enabled
+flag, so the bench ragged row always carries ``request_latency``.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["RequestLatencyTracker", "percentile"]
+
+
+def percentile(values: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (ceil(q/100 * n)-th smallest) — hand
+    computable for test fixtures; no interpolation."""
+    if not values:
+        return None
+    vs = sorted(values)
+    n = len(vs)
+    rank = max(1, -(-int(q * n) // 100))          # ceil(q*n/100), >= 1
+    return vs[min(rank, n) - 1]
+
+
+class _Rec:
+    __slots__ = ("submit_t", "admit_t", "first_token_t", "last_token_t",
+                 "tokens", "spill_stall_s", "spills", "finish_t")
+
+    def __init__(self, submit_t: float):
+        self.submit_t = submit_t
+        self.admit_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None
+        self.last_token_t: Optional[float] = None
+        self.tokens = 0
+        self.spill_stall_s = 0.0
+        self.spills = 0
+        self.finish_t: Optional[float] = None
+
+
+class RequestLatencyTracker:
+    """Lifecycle-fed latency percentiles, keyed by request uid."""
+
+    PCTS = (50, 90, 99)
+
+    def __init__(self, clock: Callable[[], float] = time.perf_counter,
+                 max_completed: int = 4096):
+        self.clock = clock
+        self._live: Dict[Any, _Rec] = {}
+        self._done: deque = deque(maxlen=max_completed)
+        self.submitted = 0
+        self.finished = 0
+
+    # -- lifecycle hooks (called by the engine) --------------------------
+
+    def on_submit(self, uid: Any) -> None:
+        self._live[uid] = _Rec(self.clock())
+        self.submitted += 1
+
+    def on_admit(self, uid: Any) -> None:
+        r = self._live.get(uid)
+        if r is not None and r.admit_t is None:   # first admit only —
+            r.admit_t = self.clock()              # re-admits after evict
+            pass                                  # are not queue wait
+
+    def on_tokens(self, uid: Any, total_tokens: int) -> None:
+        """``total_tokens`` is the request's cumulative generated count
+        (idempotent — repeated calls with an unchanged count are no-ops)."""
+        r = self._live.get(uid)
+        if r is None or total_tokens <= r.tokens:
+            return
+        now = self.clock()
+        if r.first_token_t is None:
+            r.first_token_t = now
+        r.last_token_t = now
+        r.tokens = total_tokens
+
+    def on_spill(self, uid: Any) -> None:
+        r = self._live.get(uid)
+        if r is not None:
+            r.spills += 1
+
+    def on_restore_stall(self, uid: Any, seconds: float) -> None:
+        r = self._live.get(uid)
+        if r is not None:
+            r.spill_stall_s += float(seconds)
+
+    def on_finish(self, uid: Any) -> None:
+        r = self._live.pop(uid, None)
+        if r is None:
+            return
+        r.finish_t = self.clock()
+        self._done.append(r)
+        self.finished += 1
+
+    # -- derived metrics -------------------------------------------------
+
+    def summary(self) -> Dict[str, Any]:
+        """Flat percentile summary over completed requests (ms)."""
+        done = list(self._done)
+        series: Dict[str, List[float]] = {
+            "ttft_ms": [(r.first_token_t - r.submit_t) * 1e3 for r in done
+                        if r.first_token_t is not None],
+            "tpot_ms": [(r.last_token_t - r.first_token_t) * 1e3
+                        / (r.tokens - 1) for r in done
+                        if r.tokens >= 2 and r.first_token_t is not None],
+            "queue_wait_ms": [(r.admit_t - r.submit_t) * 1e3 for r in done
+                              if r.admit_t is not None],
+            "spill_stall_ms": [r.spill_stall_s * 1e3 for r in done
+                               if r.spills > 0],
+        }
+        out: Dict[str, Any] = {"completed": len(done),
+                               "submitted": self.submitted,
+                               "in_flight": len(self._live)}
+        for name, vals in series.items():
+            for q in self.PCTS:
+                v = percentile(vals, q)
+                out[f"{name}_p{q}"] = (None if v is None
+                                       else round(v, 4))
+        return out

@@ -1,0 +1,282 @@
+"""Span-based structured tracer — the single substrate under all
+existing telemetry dialects.
+
+Design constraints (the reason this is NOT just another timer class):
+
+- **Near-zero cost when disabled.**  ``trace.span(...)`` on a disabled
+  tracer returns a process-wide singleton no-op context manager: no
+  allocation, no string formatting, no clock read.  Adapters in the
+  legacy telemetry (``HostStageStats``, ``StageTimers``,
+  ``utils/timer.py``) guard their re-emit with ``if trace.enabled``,
+  so tracing off means the hot paths behave byte-for-byte as before.
+- **Thread-aware.**  Every span/event lands in a bounded per-thread
+  ring (``collections.deque(maxlen=...)``); threads never contend on a
+  lock in the record path (the lock only guards ring *registration*).
+  The serving host path, AIO callback threads, and the SDC digest pool
+  each get their own timeline row in the exported trace.
+- **Injectable clock.**  ``configure(clock=...)`` swaps the monotonic
+  source so tests drive deterministic timestamps.  The default is
+  ``time.perf_counter`` — the same clock every legacy dialect already
+  uses, which lets adapters hand us externally bracketed intervals
+  (``add_complete``) without a unit conversion.
+- **Standard viewer format.**  ``export(path)`` writes Chrome
+  trace-event JSON (the ``{"traceEvents": [...]}`` object form) that
+  opens directly in https://ui.perfetto.dev or ``chrome://tracing``.
+- **Flight-recorder substrate.**  The bounded rings double as the
+  postmortem buffer: ``snapshot()`` hands the recent timeline to
+  ``telemetry.flight.dump_on_fault`` on hard-failure paths.
+
+The module is stdlib-only (``jax`` imported lazily for the optional
+``TraceAnnotation`` bridge) so every layer of the codebase — comm
+watchdog, resilience guards, swap path, serving engines — can import
+it without cycles.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["Tracer", "get_tracer", "configure", "trace"]
+
+DEFAULT_BUFFER = 8192          # spans+events retained per thread
+_SCHEMA_VERSION = 1
+
+
+class _NullSpan:
+    """Singleton no-op context manager — the disabled-tracer fast path.
+
+    ``__slots__ = ()`` + module-level singleton means a disabled
+    ``trace.span(...)`` call allocates nothing and formats nothing.
+    """
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """A live span: records one complete ("X") event on exit."""
+
+    __slots__ = ("_tracer", "name", "cat", "attrs", "t0", "_ann")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 attrs: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.attrs = attrs
+        self.t0 = 0.0
+        self._ann = None
+
+    def __enter__(self):
+        tr = self._tracer
+        self.t0 = tr.clock()
+        if tr.annotate:
+            ann = tr._annotation_cls()
+            if ann is not None:
+                self._ann = ann(self.name)
+                self._ann.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        tr = self._tracer
+        t1 = tr.clock()
+        if self._ann is not None:
+            self._ann.__exit__(exc_type, exc, tb)
+        attrs = self.attrs
+        if exc_type is not None:
+            attrs = dict(attrs) if attrs else {}
+            attrs["error"] = exc_type.__name__
+        tr._append({
+            "ph": "X", "name": self.name, "cat": self.cat,
+            "ts": tr._us(self.t0), "dur": max(0.0, (t1 - self.t0) * 1e6),
+            "args": attrs or {},
+        })
+        return False
+
+
+class Tracer:
+    """Thread-aware span recorder with bounded per-thread rings.
+
+    One process-wide instance lives at ``telemetry.trace``; tests build
+    private instances with injected clocks.  All mutation of an
+    existing instance goes through :meth:`configure` so modules that
+    did ``from deepspeed_tpu.telemetry import trace`` at import time
+    observe runtime enable/disable.
+    """
+
+    def __init__(self, enabled: bool = False,
+                 buffer_size: int = DEFAULT_BUFFER,
+                 clock: Callable[[], float] = time.perf_counter,
+                 annotate: bool = False):
+        self.enabled = bool(enabled)
+        self.buffer_size = int(buffer_size)
+        self.clock = clock
+        self.annotate = bool(annotate)
+        self._epoch = clock()
+        self._lock = threading.Lock()
+        self._rings: Dict[int, deque] = {}
+        self._thread_names: Dict[int, str] = {}
+        self._local = threading.local()
+        self._annotation = None      # resolved lazily, cached
+
+    # -- configuration ---------------------------------------------------
+
+    def configure(self, enabled: Optional[bool] = None,
+                  buffer_size: Optional[int] = None,
+                  clock: Optional[Callable[[], float]] = None,
+                  annotate: Optional[bool] = None) -> "Tracer":
+        """Mutate in place (never replace — importers hold references)."""
+        with self._lock:
+            if clock is not None:
+                self.clock = clock
+                self._epoch = clock()
+            if buffer_size is not None and buffer_size != self.buffer_size:
+                self.buffer_size = int(buffer_size)
+                for tid, ring in list(self._rings.items()):
+                    self._rings[tid] = deque(ring, maxlen=self.buffer_size)
+                self._local = threading.local()
+            if annotate is not None:
+                self.annotate = bool(annotate)
+            if enabled is not None:
+                self.enabled = bool(enabled)
+        return self
+
+    # -- record path -----------------------------------------------------
+
+    def span(self, name: str, cat: str = "host", **attrs):
+        """``with trace.span("swap_in_wait", bucket=3): ...``
+
+        Disabled: returns the shared no-op singleton (no allocation)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, cat, attrs or None)
+
+    def event(self, name: str, cat: str = "host", **attrs) -> None:
+        """Instant event (Chrome ``ph: "i"``) — request lifecycle marks."""
+        if not self.enabled:
+            return
+        self._append({"ph": "i", "name": name, "cat": cat, "s": "t",
+                      "ts": self._us(self.clock()), "args": attrs or {}})
+
+    def add_complete(self, name: str, start: float, dur_s: float,
+                     cat: str = "host", **attrs) -> None:
+        """Record an externally bracketed interval (the adapter entry
+        point for legacy timers that already hold t0/dt from the SAME
+        clock as the tracer — ``time.perf_counter`` by default)."""
+        if not self.enabled:
+            return
+        self._append({"ph": "X", "name": name, "cat": cat,
+                      "ts": self._us(start),
+                      "dur": max(0.0, dur_s * 1e6), "args": attrs or {}})
+
+    def _append(self, ev: Dict[str, Any]) -> None:
+        ring = getattr(self._local, "ring", None)
+        if ring is None or ring.maxlen != self.buffer_size:
+            t = threading.current_thread()
+            with self._lock:
+                ring = self._rings.get(t.ident)
+                if ring is None or ring.maxlen != self.buffer_size:
+                    ring = deque(maxlen=self.buffer_size)
+                    self._rings[t.ident] = ring
+                self._thread_names[t.ident] = t.name
+            self._local.ring = ring
+        ev["tid"] = threading.get_ident()
+        ring.append(ev)
+
+    def _us(self, t: float) -> float:
+        return (t - self._epoch) * 1e6
+
+    def _annotation_cls(self):
+        """``jax.profiler.TraceAnnotation`` when available, else None —
+        bridges host spans into the device profile timeline."""
+        if self._annotation is None:
+            try:
+                from jax.profiler import TraceAnnotation
+                self._annotation = TraceAnnotation
+            except Exception:
+                self._annotation = False
+        return self._annotation or None
+
+    # -- read path -------------------------------------------------------
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Recent spans+events across all threads, ts-sorted (the
+        flight-recorder view — cheap enough for a failure path)."""
+        with self._lock:
+            rings = [(tid, list(ring)) for tid, ring in self._rings.items()]
+        out: List[Dict[str, Any]] = []
+        for _tid, evs in rings:
+            out.extend(evs)
+        out.sort(key=lambda e: e.get("ts", 0.0))
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rings.clear()
+            self._thread_names.clear()
+            self._local = threading.local()
+
+    def export(self, path: str) -> str:
+        """Write Chrome trace-event JSON (object form) to ``path``.
+
+        Opens in https://ui.perfetto.dev / ``chrome://tracing``.  Adds
+        process/thread-name metadata events so timeline rows are
+        labelled."""
+        pid = os.getpid()
+        events: List[Dict[str, Any]] = [{
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "ts": 0, "args": {"name": f"deepspeed_tpu pid={pid}"},
+        }]
+        with self._lock:
+            names = dict(self._thread_names)
+        for tid, tname in sorted(names.items()):
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid, "ts": 0, "args": {"name": tname}})
+        for ev in self.snapshot():
+            ev = dict(ev)
+            ev["pid"] = pid
+            events.append(ev)
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"schema": "deepspeed_tpu.telemetry",
+                             "version": _SCHEMA_VERSION}}
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# Process-wide singleton
+# ---------------------------------------------------------------------------
+
+def _env_truthy(name: str) -> bool:
+    return os.environ.get(name, "").strip().lower() in ("1", "true", "yes",
+                                                        "on")
+
+
+trace = Tracer(
+    enabled=_env_truthy("DSTPU_TRACE"),
+    buffer_size=int(os.environ.get("DSTPU_TRACE_BUFFER", DEFAULT_BUFFER)),
+    annotate=_env_truthy("DSTPU_TRACE_ANNOTATE"),
+)
+
+
+def get_tracer() -> Tracer:
+    return trace
+
+
+def configure(**kw) -> Tracer:
+    """``telemetry.configure(enabled=True, buffer_size=..., clock=...,
+    annotate=...)`` — mutates the process singleton in place."""
+    return trace.configure(**kw)

@@ -45,6 +45,8 @@ from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from deepspeed_tpu.telemetry import trace
+
 __all__ = ["BoundedAsyncStage", "HostBufferPool", "StageTimers"]
 
 
@@ -53,12 +55,15 @@ class StageTimers:
 
     ``snapshot()`` emits ``{f"{stage}_s": seconds}`` floats plus raw
     counters — the exact shape ``stage_stats`` / ``serving_stages``
-    consumers (bench rows, ``MonitorMaster``) already flatten.
+    consumers (bench rows, ``MonitorMaster``) already flatten.  When
+    the process tracer is enabled every bracket also lands as a span
+    (``cat`` labels the subsystem row in the exported trace).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, cat: str = "host") -> None:
         self.seconds: Dict[str, float] = {}
         self.counters: Dict[str, int] = {}
+        self.cat = cat
 
     @contextmanager
     def stage(self, name: str):
@@ -66,11 +71,17 @@ class StageTimers:
         try:
             yield
         finally:
-            self.seconds[name] = (self.seconds.get(name, 0.0)
-                                  + time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            self.seconds[name] = self.seconds.get(name, 0.0) + dt
+            if trace.enabled:
+                trace.add_complete(name, t0, dt, cat=self.cat)
 
     def add(self, name: str, seconds: float) -> None:
         self.seconds[name] = self.seconds.get(name, 0.0) + seconds
+        if trace.enabled:
+            # externally bracketed: anchor at now-dt (approximate start)
+            trace.add_complete(name, time.perf_counter() - seconds,
+                               seconds, cat=self.cat)
 
     def count(self, name: str, n: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + n

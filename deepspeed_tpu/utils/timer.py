@@ -13,6 +13,7 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional
 
+from deepspeed_tpu.telemetry import trace
 from deepspeed_tpu.utils.logging import logger
 
 FORWARD_MICRO_TIMER = "fwd_microstep"
@@ -60,6 +61,9 @@ class Timer:
         if record:
             self._record_count += 1
         self.started = False
+        if trace.enabled:
+            trace.add_complete(self.name, self._start_time,
+                               self.last_interval, cat="engine")
 
     def discard(self) -> None:
         """Abandon an in-flight interval without recording it (and without
@@ -75,6 +79,9 @@ class Timer:
         self.last_interval = seconds
         self._elapsed += seconds
         self._record_count += 1
+        if trace.enabled:
+            trace.add_complete(self.name, time.perf_counter() - seconds,
+                               seconds, cat="engine")
 
     def reset(self) -> None:
         self.started = False

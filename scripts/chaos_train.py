@@ -31,6 +31,15 @@ exits nonzero.
 
 ``--all`` = the base checkpoint-fault schedule + ``--comm`` + ``--sdc``.
 
+Every hard-failure class the soak exercises must additionally leave a
+PARSEABLE flight-recorder dump (``deepspeed_tpu/telemetry/flight.py``):
+the watchdog's ``CollectiveTimeout``, the swap path's
+``SwapCorruptionError`` (both the raise-site dump and the copy the
+engine places next to the emergency checkpoint), a SIGTERM preemption,
+and ``GradientAnomalyError`` from the skipped-step guard.  A missing,
+truncated, or mislabeled dump exits nonzero — the black box must
+survive the crash it exists to explain.
+
 Usage::
 
     python scripts/chaos_train.py --steps 30 --seed 0
@@ -61,6 +70,91 @@ from deepspeed_tpu.resilience import (FaultInjector,  # noqa: E402
 from deepspeed_tpu.resilience import faults as faults_mod  # noqa: E402
 
 FAULT_KINDS = ("torn", "crash", "oserror", "sigterm")
+
+
+def check_flight(reason: str, search_dir: str = None) -> int:
+    """Assert a parseable flight dump exists for ``reason``; returns the
+    number of failures (0 or 1).  ``search_dir=None`` checks the most
+    recent dump this process wrote; otherwise the newest matching
+    ``flight_<reason>_*.jsonl`` in ``search_dir`` (the copy the engine
+    places next to the emergency checkpoint)."""
+    from deepspeed_tpu.telemetry import flight
+
+    if search_dir is None:
+        path = flight.last_dump_path()
+        if path is None:
+            print(f"FAIL: no flight dump recorded for {reason!r}")
+            return 1
+    else:
+        cands = sorted(f for f in os.listdir(search_dir)
+                       if f.startswith(f"flight_{reason}_")
+                       and f.endswith(".jsonl"))
+        if not cands:
+            print(f"FAIL: no flight dump for {reason!r} in {search_dir}")
+            return 1
+        path = os.path.join(search_dir, cands[-1])
+    try:
+        header, events = flight.read_flight_record(path)
+    except (ValueError, OSError) as e:
+        print(f"FAIL: flight dump for {reason!r} unreadable/truncated: "
+              f"{e}")
+        return 1
+    if header.get("reason") != reason:
+        print(f"FAIL: flight dump reason {header.get('reason')!r} != "
+              f"{reason!r} ({path})")
+        return 1
+    print(f"  flight: {reason} dump parseable ({len(events)} events, "
+          f"{os.path.basename(path)})")
+    return 0
+
+
+def flight_fault_pass() -> int:
+    """GradientAnomalyError is the one dump-bearing class the fault
+    schedule cannot reach (no genuinely divergent model is trained);
+    exercise its guard directly and assert the dump."""
+    from deepspeed_tpu.resilience.guards import (GradientAnomalyError,
+                                                 SkippedStepGuard)
+
+    guard = SkippedStepGuard(bound=2)
+    failures = 1
+    try:
+        guard.update(True, step=1)
+        guard.update(True, step=2)
+        print("FAIL: SkippedStepGuard never raised at its bound")
+    except GradientAnomalyError:
+        failures = check_flight("gradient_anomaly")
+    return failures + kv_restore_fault_pass()
+
+
+def kv_restore_fault_pass() -> int:
+    """KVRestoreError is the serving-path dump-bearing class the training
+    fault schedule cannot reach; drive the tiered KV store to a
+    persistent-corruption quarantine directly and assert the dump."""
+    from deepspeed_tpu.inference.kv_tiering import (KVRestoreError,
+                                                    TieredKVStore)
+
+    shapes, dtypes = [(8, 4, 6), (8, 4)], [np.float32, np.float32]
+    nvme_dir = tempfile.mkdtemp(prefix="chaos_kv_")
+    st = TieredKVStore(page_shapes=shapes, page_dtypes=dtypes,
+                       pages_per_seq=4, host_pages=1, nvme_pages=8,
+                       nvme_dir=nvme_dir, max_reread=2)
+    rng = np.random.default_rng(17)
+    arrs = [rng.random((2,) + s).astype(d)
+            for s, d in zip(shapes, dtypes)]
+    try:
+        st.spill(4, arrs, 2)                 # oversized for host: NVMe
+        st._writes.drain()
+        with FaultInjector(seed=6) as inj:
+            inj.bitflip("kv.read_page", bits=1, count=10)
+            try:
+                st.restore(4)
+            except KVRestoreError:
+                return check_flight("kv_restore_error")
+        print("FAIL: persistent kv corruption never raised "
+              "KVRestoreError")
+        return 1
+    finally:
+        st.close()
 
 
 def build_schedule(seed: int, steps: int, n_faults: int,
@@ -173,6 +267,7 @@ def comm_fault_pass(seed: int) -> int:
         undetected += 1
     except Exception as e:
         print(f"  comm watchdog: deadline fired ({type(e).__name__})")
+        undetected += check_flight("collective_timeout")
     dist.log_summary(show_straggler=True)
     dist.comms_logger.enabled = False
     return undetected
@@ -255,6 +350,13 @@ def sdc_fault_pass(seed: int) -> int:
             print(f"  swap persistent bitflip: detected before use, "
                   f"{quarantined[0]} quarantined, emergency checkpoint "
                   f"{emergency[0]} committed")
+        # the raise site dumps to the default flight dir; the engine
+        # handler must place a second copy next to the emergency
+        # checkpoint
+        from deepspeed_tpu.telemetry import flight
+        undetected += check_flight("swap_corruption",
+                                   search_dir=flight.flight_dir())
+        undetected += check_flight("swap_corruption", search_dir=ckpt_dir)
     engine.uninstall_preemption_handler()
     engine.nvme_swapper.close()     # free the dead engine's swap files
 
@@ -296,6 +398,13 @@ def main(argv=None) -> int:
         args.comm = args.sdc = True
 
     ckpt_dir = args.dir or tempfile.mkdtemp(prefix="chaos_ckpt_")
+    # isolate this soak's flight dumps so the parseability assertions
+    # cannot be satisfied by stale files from an earlier run, and arm
+    # the tracer so every dump carries a timeline, not just a header
+    os.environ.setdefault("DSTPU_FLIGHT_DIR",
+                          tempfile.mkdtemp(prefix="chaos_flight_"))
+    from deepspeed_tpu import telemetry
+    telemetry.configure(enabled=True)
     schedule = build_schedule(args.seed, args.steps, args.faults,
                               args.save_interval)
     print(f"chaos_train: {args.steps} steps, schedule={schedule}, "
@@ -305,6 +414,7 @@ def main(argv=None) -> int:
     engine.install_preemption_handler(ckpt_dir, exit_after=False)
     n_scheduled = len(schedule)
     recovered = 0
+    sigterm_injected = False
     while engine.global_steps < args.steps:
         step = engine.global_steps
         engine.train_batch(batch=data_fn(step))
@@ -336,7 +446,14 @@ def main(argv=None) -> int:
         else:
             if kind is not None:
                 recovered += 1
+            if kind == "sigterm":
+                sigterm_injected = True
     engine.uninstall_preemption_handler()
+    flight_failures = 0
+    if sigterm_injected:
+        # the preemption handler dumps next to the emergency checkpoint
+        flight_failures += check_flight("sigterm_preemption",
+                                        search_dir=ckpt_dir)
 
     # final checkpoint must verify and reload at the final step
     engine.save_checkpoint(ckpt_dir, tag="final", async_save=False)
@@ -366,10 +483,17 @@ def main(argv=None) -> int:
             print(f"FAIL: {sdc_undetected} silent corruptions went "
                   "undetected")
             return 1
+    print("flight recorder pass:")
+    flight_failures += flight_fault_pass()
+    if flight_failures:
+        print(f"FAIL: {flight_failures} flight-recorder dump check(s) "
+              "failed")
+        return 1
     print(f"OK: {args.steps} steps, {n_scheduled} faults injected, "
           f"{recovered} recoveries, final checkpoint verified"
           + (", comm fault pass clean" if args.comm else "")
-          + (", sdc fault pass clean" if args.sdc else ""))
+          + (", sdc fault pass clean" if args.sdc else "")
+          + ", flight dumps parseable")
     return 0
 
 

@@ -20,14 +20,23 @@ exits NONZERO if the tiering-on greedy output diverges from the
 tiering-off reference, if no spill actually happened (the gate would
 be vacuous), or if any restored page skipped digest verification.
 
+With ``--trace`` it additionally gates the unified tracer: a serving
+run with ``DSTPU_TRACE``-style tracing enabled must export a
+schema-valid Chrome trace carrying both serving-stage spans and
+request lifecycle events, the engine must surface non-None TTFT/TPOT
+percentiles, and the tracer-on wall clock must stay within 5% of
+tracer-off (min of 3 runs each) — tracing is observability, not a tax.
+
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py [--tokens 250]
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --kv-tiering
+    JAX_PLATFORMS=cpu python scripts/serve_smoke.py --trace
 """
 import argparse
 import os
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np  # noqa: E402
 
@@ -41,6 +50,10 @@ def main() -> int:
                    help="also gate the tiered paged-KV store (tiny "
                         "pool, spill/restore parity + verified "
                         "restores)")
+    p.add_argument("--trace", action="store_true",
+                   help="also gate the unified tracer (schema-valid "
+                        "Chrome-trace export, request latency "
+                        "percentiles, <=5%% tracer-on wall overhead)")
     args = p.parse_args()
 
     import jax
@@ -160,13 +173,74 @@ def main() -> int:
               f"pages_verified={st['pages_verified']}/"
               f"{st['pages_restored']}")
         t_eng.close()
+    if args.trace:
+        import tempfile
+        import time
+
+        import trace_summarize
+
+        from deepspeed_tpu import telemetry
+
+        def timed(enabled):
+            telemetry.configure(enabled=enabled)
+            telemetry.trace.clear()
+            t0 = time.perf_counter()
+            _, eng = run("off")
+            return time.perf_counter() - t0, eng
+
+        # the reference run above already warmed the jit caches; min of
+        # 3 damps scheduler noise so the 5% gate measures the tracer,
+        # not the machine
+        off_wall = min(timed(False)[0] for _ in range(3))
+        on_wall, t_eng = float("inf"), None
+        for _ in range(3):
+            w, eng = timed(True)
+            if w < on_wall:
+                on_wall, t_eng = w, eng
+        trace_path = os.path.join(
+            tempfile.mkdtemp(prefix="serve_trace_"), "serve_trace.json")
+        telemetry.trace.export(trace_path)
+        telemetry.configure(enabled=False)
+        try:
+            events, _ = trace_summarize.load_events(trace_path)
+            problems = trace_summarize.validate_events(events)
+        except (ValueError, OSError) as e:
+            events, problems = [], [str(e)]
+        if problems:
+            for msg in problems[:5]:
+                print(f"FAIL [trace]: malformed trace: {msg}")
+            failures += 1
+        cats = {ev.get("cat") for ev in events}
+        for want in ("serving", "request"):
+            if want not in cats:
+                print(f"FAIL [trace]: no {want!r}-category events in "
+                      f"the export (cats={sorted(c for c in cats if c)})")
+                failures += 1
+        req = t_eng.serving_stages()["requests"]
+        for key in ("ttft_ms_p50", "ttft_ms_p99", "tpot_ms_p50",
+                    "queue_wait_ms_p50"):
+            if req.get(key) is None:
+                print(f"FAIL [trace]: request latency percentile "
+                      f"{key} is None ({req})")
+                failures += 1
+        overhead = (on_wall - off_wall) / off_wall
+        if overhead > 0.05:
+            print(f"FAIL [trace]: tracer-on wall regressed "
+                  f"{overhead * 100:.1f}% (off={off_wall:.3f}s "
+                  f"on={on_wall:.3f}s)")
+            failures += 1
+        print(f"[trace] events={len(events)} overhead="
+              f"{overhead * 100:+.1f}% ttft_p50={req.get('ttft_ms_p50')}ms "
+              f"tpot_p50={req.get('tpot_ms_p50')}ms exported={trace_path}")
     if failures:
         print(f"serve_smoke: {failures} failure(s)")
         return 1
     print("serve_smoke: all speculation modes bit-identical to spec-off, "
           "acceptance healthy" +
           (", kv tiering spill/restore exact and verified"
-           if args.kv_tiering else ""))
+           if args.kv_tiering else "") +
+          (", trace export valid within overhead budget"
+           if args.trace else ""))
     return 0
 
 

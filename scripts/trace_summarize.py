@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Summarize a Chrome-trace export or a flight-recorder dump.
+
+Turns the unified tracer's output (``trace.export(path)`` Chrome-trace
+JSON, loadable in ui.perfetto.dev, or a ``flight_*.jsonl`` postmortem
+dump) into a terminal report:
+
+- per-stage table: count / total / mean / p50 / p99 wall per
+  ``(cat, name)`` complete span, sorted by total time — the swap path
+  (``swap_in_wait``, ``bucket_update``, ...), serving host stages and
+  engine timers all land here because they share one span schema;
+- per-request lifecycle: for every ``cat="request"`` uid, the
+  submit → admit → prefill → decode → spill/restore → reap event
+  sequence with derived queue-wait and first-token timings;
+- ``--validate``: schema gate (used by ``serve_smoke.py --trace``) —
+  exits nonzero on a malformed trace instead of printing a report.
+
+Usage::
+
+    python scripts/trace_summarize.py /tmp/serve_trace.json
+    python scripts/trace_summarize.py /tmp/dstpu_flight/flight_*.jsonl
+    python scripts/trace_summarize.py --validate trace.json
+"""
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Tuple
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from deepspeed_tpu.telemetry import percentile, read_flight_record  # noqa: E402
+
+# the ph values the tracer emits: complete spans, instants, metadata
+_KNOWN_PH = {"X", "i", "M"}
+
+
+def load_events(path: str) -> Tuple[List[Dict[str, Any]], str]:
+    """Load events from either format; returns ``(events, kind)`` where
+    kind is ``"chrome"`` or ``"flight"``.  Raises ``ValueError`` on a
+    file that is neither."""
+    with open(path, "r", encoding="utf-8") as f:
+        first = f.readline()
+    try:
+        head = json.loads(first)
+    except json.JSONDecodeError:
+        head = None
+    if isinstance(head, dict) and head.get("record") == "flight":
+        _, events = read_flight_record(path)
+        return events, "flight"
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(f"{path}: not a Chrome-trace object "
+                         "(missing traceEvents)")
+    return doc["traceEvents"], "chrome"
+
+
+def validate_events(events: List[Dict[str, Any]]) -> List[str]:
+    """Schema check; returns a list of problems (empty = valid)."""
+    problems: List[str] = []
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in _KNOWN_PH:
+            problems.append(f"event {i}: unknown ph {ph!r}")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            problems.append(f"event {i}: missing name")
+        if ph == "M":
+            continue
+        for key in ("ts", "pid", "tid"):
+            if not isinstance(ev.get(key), (int, float)):
+                problems.append(f"event {i} ({ev.get('name')}): "
+                                f"non-numeric {key}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                problems.append(f"event {i} ({ev.get('name')}): "
+                                f"bad dur {dur!r}")
+        if len(problems) >= 20:
+            problems.append("... (stopping after 20 problems)")
+            break
+    return problems
+
+
+def summarize_spans(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Aggregate complete spans by ``(cat, name)``."""
+    groups: Dict[Tuple[str, str], List[float]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        key = (str(ev.get("cat", "")), ev["name"])
+        groups.setdefault(key, []).append(float(ev["dur"]))
+    rows = []
+    for (cat, name), durs in groups.items():
+        rows.append({
+            "cat": cat, "name": name, "count": len(durs),
+            "total_ms": sum(durs) / 1e3,
+            "mean_us": sum(durs) / len(durs),
+            "p50_us": percentile(durs, 50),
+            "p99_us": percentile(durs, 99),
+        })
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def summarize_requests(events: List[Dict[str, Any]]
+                       ) -> Dict[Any, Dict[str, Any]]:
+    """Reconstruct per-uid lifecycles from ``cat="request"`` instants
+    (and ``decode_block`` instants, whose ``uids`` list names every
+    request active in the block)."""
+    reqs: Dict[Any, Dict[str, Any]] = {}
+
+    def rec(uid):
+        return reqs.setdefault(uid, {"events": [], "decode_blocks": 0})
+
+    for ev in events:
+        if ev.get("cat") != "request" or ev.get("ph") != "i":
+            continue
+        args = ev.get("args", {})
+        name = ev["name"]
+        if name == "decode_block":
+            for uid in args.get("uids", []):
+                rec(uid)["decode_blocks"] += 1
+            continue
+        uid = args.get("uid")
+        if uid is None:
+            continue
+        r = rec(uid)
+        r["events"].append(name)
+        if name == "request_submit":
+            r["submit_ts"] = ev["ts"]
+        elif name == "request_admit" and "admit_ts" not in r:
+            r["admit_ts"] = ev["ts"]
+        elif name == "request_reap":
+            r["reap_ts"] = ev["ts"]
+            r["tokens"] = args.get("tokens")
+    for r in reqs.values():
+        if "submit_ts" in r and "admit_ts" in r:
+            r["queue_wait_ms"] = round(
+                (r["admit_ts"] - r["submit_ts"]) / 1e3, 3)
+        if "submit_ts" in r and "reap_ts" in r:
+            r["lifetime_ms"] = round(
+                (r["reap_ts"] - r["submit_ts"]) / 1e3, 3)
+    return reqs
+
+
+def print_report(path: str, events: List[Dict[str, Any]],
+                 kind: str) -> None:
+    print(f"{path}: {kind} file, {len(events)} events")
+    rows = summarize_spans(events)
+    if rows:
+        print(f"\n{'cat':<10} {'name':<28} {'count':>7} {'total_ms':>10} "
+              f"{'mean_us':>10} {'p50_us':>10} {'p99_us':>10}")
+        for r in rows:
+            print(f"{r['cat']:<10} {r['name']:<28} {r['count']:>7} "
+                  f"{r['total_ms']:>10.3f} {r['mean_us']:>10.1f} "
+                  f"{r['p50_us']:>10.1f} {r['p99_us']:>10.1f}")
+    reqs = summarize_requests(events)
+    if reqs:
+        print(f"\nrequests ({len(reqs)}):")
+        for uid in sorted(reqs, key=str):
+            r = reqs[uid]
+            seq = " -> ".join(r["events"]) or "(decode only)"
+            extras = " ".join(
+                f"{k}={r[k]}" for k in ("queue_wait_ms", "lifetime_ms",
+                                        "tokens", "decode_blocks")
+                if r.get(k) is not None)
+            print(f"  uid={uid}: {seq}  [{extras}]")
+    instants = sum(1 for ev in events if ev.get("ph") == "i"
+                   and ev.get("cat") != "request")
+    if instants:
+        print(f"\n{instants} non-request instant event(s)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("paths", nargs="+",
+                    help="Chrome-trace JSON or flight_*.jsonl dump(s)")
+    ap.add_argument("--validate", action="store_true",
+                    help="schema-check only; exit nonzero on a "
+                         "malformed file")
+    args = ap.parse_args(argv)
+    failures = 0
+    for path in args.paths:
+        try:
+            events, kind = load_events(path)
+        except (ValueError, OSError, json.JSONDecodeError) as e:
+            print(f"FAIL {path}: {e}")
+            failures += 1
+            continue
+        problems = validate_events(events)
+        if problems:
+            for p in problems:
+                print(f"FAIL {path}: {p}")
+            failures += 1
+            continue
+        if args.validate:
+            print(f"OK {path}: {kind}, {len(events)} events, "
+                  "schema valid")
+        else:
+            print_report(path, events, kind)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

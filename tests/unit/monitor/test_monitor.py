@@ -22,6 +22,42 @@ def test_csv_monitor_writes_rows(tmp_path):
     assert [r[1] for r in rows[1:]] == ["1.5", "1.2"]
 
 
+def test_csv_monitor_opens_each_series_once(tmp_path, monkeypatch):
+    """Regression: write_events used to open+close the file once PER
+    EVENT; per-series handles must stay open across flushes."""
+    import builtins
+
+    cfg = CSVConfig(enabled=True, output_path=str(tmp_path), job_name="j")
+    m = CSVMonitor(cfg)
+    opens = []
+    real_open = builtins.open
+
+    def counting_open(file, *a, **kw):
+        opens.append(str(file))
+        return real_open(file, *a, **kw)
+
+    monkeypatch.setattr(builtins, "open", counting_open)
+    for step in range(20):
+        m.write_events([("Train/loss", float(step), step),
+                        ("Train/lr", 0.1, step)])
+    csv_opens = [p for p in opens if p.endswith(".csv")]
+    assert len(csv_opens) == 2, (
+        f"expected one open per series, saw {len(csv_opens)}")
+    # rows are flushed per call — visible without close()
+    with real_open(tmp_path / "j" / "Train_loss.csv") as f:
+        rows = list(csv.reader(f))
+    assert len(rows) == 21 and rows[1] == ["0", "0.0"]
+    m.close()
+    # a fresh monitor appends (no duplicate header) after close
+    m2 = CSVMonitor(cfg)
+    m2.write_events([("Train/loss", 9.9, 99)])
+    m2.close()
+    with real_open(tmp_path / "j" / "Train_loss.csv") as f:
+        rows = list(csv.reader(f))
+    assert rows[0] == ["step", "Train/loss"] and rows[-1] == ["99", "9.9"]
+    assert sum(1 for r in rows if r[0] == "step") == 1
+
+
 class _FakeExperiment:
     def __init__(self):
         self.logged = []
