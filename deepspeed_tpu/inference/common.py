@@ -45,10 +45,15 @@ class HostStageStats:
     the tier store) and ``restore`` (tier fetch + verify + page upload
     + scatter); the v2 engine additionally merges the tier store's own
     flat stats as a ``kv_tiering`` sub-dict.
+
+    The prefix cache adds ``prefix`` (index lookup + token
+    verification + attach/COW bookkeeping at admission) and the
+    ``prefix_*`` counters; when the index saw any lookup the v2 engine
+    emits a ``prefix_cache`` sub-dict merging the index's own stats.
     """
 
     STAGES = ("plan", "upload", "dispatch", "device", "harvest", "draft",
-              "verify", "spill", "restore")
+              "verify", "spill", "restore", "prefix")
 
     def __init__(self):
         self.reset()
@@ -64,6 +69,11 @@ class HostStageStats:
         self.spec_proposed = 0    # draft tokens proposed (device count)
         self.spec_accepted = 0    # draft tokens accepted (device count)
         self.spec_tokens = 0      # tokens emitted by speculative blocks
+        self.prefix_hits = 0      # admissions that attached >=1 cached page
+        self.prefix_misses = 0    # admissions that attached nothing
+        self.prefix_hit_pages = 0   # cached pages attached
+        self.prefix_hit_tokens = 0  # prefill tokens skipped via the cache
+        self.prefix_cow_copies = 0  # copy-on-write page copies
 
     @contextmanager
     def stage(self, name: str):
@@ -83,7 +93,7 @@ class HostStageStats:
             for s in self.STAGES}
         host = sum(self.seconds[s] for s in
                    ("plan", "upload", "dispatch", "harvest", "draft",
-                    "verify", "spill", "restore"))
+                    "verify", "spill", "restore", "prefix"))
         dev = self.seconds["device"]
         out["host_s"] = round(host, 4)
         out["device_wait_s"] = round(dev, 4)

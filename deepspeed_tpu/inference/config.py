@@ -123,6 +123,40 @@ class KVTieringConfig(ConfigModel):
         return self
 
 
+class PrefixCacheConfig(ConfigModel):
+    """``v2.prefix_cache`` subtree: cross-request KV sharing over the
+    paged pool.
+
+    Token-id chunks are chain-hashed at page granularity; a new
+    request's prefill attaches read-only to every fully-matched page
+    already resident (refcounted, copy-on-write on first divergent
+    write) and computes only the non-cached suffix.  Stored token ids
+    are verified before attach, so a hash collision is a miss, never a
+    wrong share.
+
+    ``max_index_entries``: LRU bound on index entries (each holds one
+    page reference while resident).  ``min_match_pages``: shortest
+    prefix worth attaching (shorter matches prefill normally).
+    ``include_generated``: also register pages completed during decode
+    at request teardown — more reuse for multi-turn traffic, but those
+    pages were written by the decode-block program, whose KV bits are
+    not guaranteed identical to the fused prefill program's, so
+    bit-parity vs cache-off is only contracted while this is off."""
+
+    enabled: bool = False
+    max_index_entries: int = 1024
+    min_match_pages: int = 1
+    include_generated: bool = False
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.max_index_entries < 1:
+            raise ValueError("prefix_cache.max_index_entries must be >= 1")
+        if self.min_match_pages < 1:
+            raise ValueError("prefix_cache.min_match_pages must be >= 1")
+        return self
+
+
 class InferenceV2Config(ConfigModel):
     """``v2`` subtree: the serving host-path pipeline knobs.
 
@@ -142,6 +176,8 @@ class InferenceV2Config(ConfigModel):
     speculation: SpeculationConfig = Field(
         default_factory=SpeculationConfig)
     kv_tiering: KVTieringConfig = Field(default_factory=KVTieringConfig)
+    prefix_cache: PrefixCacheConfig = Field(
+        default_factory=PrefixCacheConfig)
 
     @model_validator(mode="after")
     def _positive(self):

@@ -40,7 +40,7 @@ import atexit
 import os
 import shutil
 import tempfile
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -52,6 +52,14 @@ from deepspeed_tpu.utils.logging import logger
 
 __all__ = ["TieredKVStore", "KVRestoreError"]
 
+# Payload key: a request uid (int) for sequence spills, or an opaque
+# string for payloads owned by other subsystems — the prefix cache
+# demotes index pages under their prefix-hash key ("pfx-<hash>"), so
+# one tier entry serves every future requester of that prefix.  Keys
+# of both types coexist in one store (dict keys; the spill filename
+# embeds the key via str()).
+Key = Union[int, str]
+
 _ALIGN = 4096                        # O_DIRECT / page alignment
 
 
@@ -59,7 +67,7 @@ class KVRestoreError(RuntimeError):
     """A spilled page failed verification beyond recovery; the payload
     is quarantined and the session must re-prefill."""
 
-    def __init__(self, uid: int, page: int, msg: str) -> None:
+    def __init__(self, uid: "Key", page: int, msg: str) -> None:
         super().__init__(msg)
         self.uid = uid
         self.page = page
@@ -71,7 +79,7 @@ class _Entry:
     __slots__ = ("uid", "n_pages", "state", "buf", "slot", "path",
                  "digests", "seq")
 
-    def __init__(self, uid: int, n_pages: int) -> None:
+    def __init__(self, uid: "Key", n_pages: int) -> None:
         self.uid = uid
         self.n_pages = n_pages
         self.state = "host"         # host | writing | nvme | reading
@@ -124,7 +132,7 @@ class TieredKVStore:
         self._used_bytes = used
 
         # tier state
-        self._entries: Dict[int, _Entry] = {}
+        self._entries: Dict[Key, _Entry] = {}
         self._host_used = 0          # pages resident in host buffers
         self._nvme_used = 0          # pages on (or being written to) NVMe
         self._seq = 0
@@ -200,12 +208,12 @@ class TieredKVStore:
             return False
         return self.free_pages() >= n_pages
 
-    def holds(self, uid: int) -> bool:
+    def holds(self, uid: Key) -> bool:
         return uid in self._entries
 
     # -- spill -----------------------------------------------------------
 
-    def spill(self, uid: int, arrs: List[np.ndarray],
+    def spill(self, uid: Key, arrs: List[np.ndarray],
               n_pages: int) -> None:
         """Take ownership of ``uid``'s pages (per-leaf
         ``[n_pages, ...]`` host arrays), digest them, and park them in
@@ -277,7 +285,7 @@ class TieredKVStore:
 
     # -- NVMe write-back -------------------------------------------------
 
-    def _fname(self, uid: int) -> str:
+    def _fname(self, uid: Key) -> str:
         return os.path.join(self.spill_dir, f"kv-{uid}.bin")
 
     def _nvme_spill(self, ent: _Entry) -> None:
@@ -323,7 +331,7 @@ class TieredKVStore:
 
     # -- prefetch --------------------------------------------------------
 
-    def prefetch(self, uids: Sequence[int]) -> int:
+    def prefetch(self, uids: Sequence[Key]) -> int:
         """Issue async NVMe->host reads for predicted next-scheduled
         spilled sequences; returns how many were started.  Runs under
         the decode block so restores overlap device work."""
@@ -348,7 +356,7 @@ class TieredKVStore:
 
     # -- restore ---------------------------------------------------------
 
-    def restore(self, uid: int) -> List[np.ndarray]:
+    def restore(self, uid: Key) -> List[np.ndarray]:
         """Hand back ``uid``'s pages as per-leaf ``[n_pages, ...]``
         arrays, each page verified against its spill-time digest (when
         ``verify``).  Drops the entry on success — the pages are HBM's
@@ -493,7 +501,7 @@ class TieredKVStore:
         self._digests.discard(ent.uid)
         ent.buf = None
 
-    def drop(self, uid: int) -> None:
+    def drop(self, uid: Key) -> None:
         """Discard a spilled payload (session finished or re-prefills)."""
         ent = self._entries.get(uid)
         if ent is not None:

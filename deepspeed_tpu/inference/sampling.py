@@ -14,6 +14,21 @@ import jax
 import jax.numpy as jnp
 
 
+def position_keys(base: jax.Array, seeds: jax.Array,
+                  positions: jax.Array) -> jax.Array:
+    """Per-row PRNG keys [B, 2] for scheduling-invariant sampling: the
+    key for one drawn token is a pure function of (engine base key,
+    request seed, cache position), so the SAME token of the SAME
+    request samples identically no matter how requests were batched,
+    chunked, evicted, or prefix-cache-skipped along the way.  This is
+    what lets seeded sampling stay bit-identical between prefix-cache
+    on and off runs, whose dispatch sequences differ."""
+    def one(seed, pos):
+        return jax.random.fold_in(jax.random.fold_in(base, seed), pos)
+
+    return jax.vmap(one)(seeds, positions)
+
+
 def sample_logits(logits: jax.Array, rng: Optional[jax.Array], *,
                   do_sample: bool = False, temperature: float = 1.0,
                   top_k: int = 0, top_p: float = 1.0) -> jax.Array:
@@ -22,6 +37,9 @@ def sample_logits(logits: jax.Array, rng: Optional[jax.Array], *,
     ``do_sample``/``top_k`` are static (change recompiles); temperature and
     top_p are folded in as constants of the compiled program too since they
     arrive as Python floats.
+
+    ``rng`` may be one key (shared draw over the batch) or per-row keys
+    ``[B, 2]`` from :func:`position_keys` (detected by ndim).
     """
     logits = logits.astype(jnp.float32)
     if not do_sample:
@@ -41,6 +59,9 @@ def sample_logits(logits: jax.Array, rng: Optional[jax.Array], *,
         cutoff = jnp.take_along_axis(sorted_logits, kth_idx, axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     assert rng is not None, "sampling needs an rng"
+    if rng.ndim == 2:                                    # per-row keys
+        return jax.vmap(lambda k, l: jax.random.categorical(k, l))(
+            rng, logits).astype(jnp.int32)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -82,14 +103,19 @@ def sample_logits_batched(logits: jax.Array, rng: Optional[jax.Array],
     per request).
 
     ``rng=None`` compiles the pure-greedy program (no sort).  ``top_k <= 0``
-    and ``top_p >= 1`` disable their filters per row.
+    and ``top_p >= 1`` disable their filters per row.  ``rng`` may be one
+    key or per-row keys ``[S, 2]`` (:func:`position_keys`).
     """
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if rng is None:
         return greedy
     lg = filter_logits_batched(logits, temperature, top_k, top_p)
-    sampled = jax.random.categorical(rng, lg, axis=-1).astype(jnp.int32)
+    if rng.ndim == 2:                                    # per-row keys
+        sampled = jax.vmap(lambda k, l: jax.random.categorical(k, l))(
+            rng, lg).astype(jnp.int32)
+    else:
+        sampled = jax.random.categorical(rng, lg, axis=-1).astype(jnp.int32)
     return jnp.where(do_sample, sampled, greedy)
 
 

@@ -122,7 +122,10 @@ from deepspeed_tpu.inference.common import HostStageStats
 from deepspeed_tpu.telemetry import RequestLatencyTracker, trace
 from deepspeed_tpu.inference.paged import (PageAllocator,
                                            pages_for)
+from deepspeed_tpu.inference.prefix_cache import (ROOT_HASH,
+                                                  PrefixCacheIndex)
 from deepspeed_tpu.inference.sampling import (filter_logits_batched,
+                                              position_keys,
                                               sample_logits,
                                               sample_logits_batched,
                                               speculative_verify)
@@ -149,8 +152,16 @@ class Request:
     ctx: Optional[np.ndarray] = None
     # tiered-KV spill payload metadata while the sequence's pages sit
     # in host RAM / NVMe (None <=> not spilled); the page bytes live in
-    # the engine's TieredKVStore keyed by uid
-    spilled: Optional[Dict[str, int]] = None
+    # the engine's TieredKVStore keyed by uid.  With a prefix cache,
+    # "shared_pages" records shared-prefix pages held resident by
+    # spill-holds instead of spilling (only private pages hit the tiers)
+    spilled: Optional[Dict[str, Any]] = None
+    # prefix-cache registration cursor: pc_pages full pages of ctx are
+    # in the index, pc_parent is the chain hash at that point, and
+    # pc_cached counts prefill tokens this admission skipped via attach
+    pc_parent: int = ROOT_HASH
+    pc_pages: int = 0
+    pc_cached: int = 0
 
     @property
     def ctx_len(self) -> int:
@@ -191,6 +202,7 @@ class RaggedInferenceEngineV2:
                  speculation: Any = None,
                  draft_model=None, draft_params: Any = None,
                  kv_tiering: Any = None,
+                 prefix_cache: Any = None,
                  config: Any = None):
         """``kv_cache_dtype``: "none" | "fp8" | "int8" — paged KV pool
         storage format (reference fp_quantizer KV quantization).
@@ -227,7 +239,18 @@ class RaggedInferenceEngineV2:
         :class:`~deepspeed_tpu.inference.config.KVTieringConfig` —
         host-RAM + NVMe spill tiers for the paged-KV pool
         (:mod:`deepspeed_tpu.inference.kv_tiering`).  With tiering
-        disabled the engine is byte-for-byte the untiered engine."""
+        disabled the engine is byte-for-byte the untiered engine.
+        ``prefix_cache``: ``None`` (config subtree decides; off by
+        default), a bool, a dict (implies ``enabled=True``), or a
+        :class:`~deepspeed_tpu.inference.config.PrefixCacheConfig` —
+        cross-request KV sharing over the paged pool
+        (:mod:`deepspeed_tpu.inference.prefix_cache`): admission
+        attaches fully-matched resident prefix pages read-only and
+        prefills only the non-cached suffix; the first divergent write
+        copy-on-writes.  Greedy outputs are bit-identical to
+        cache-off, and seeded sampling too, because sampling keys are
+        position-keyed (:func:`~deepspeed_tpu.inference.sampling.position_keys`)
+        rather than drawn from a dispatch-ordered stream."""
         mcfg = getattr(model, "config", None)
         assert dataclasses.is_dataclass(mcfg) and hasattr(mcfg, "decode"), \
             "ragged engine needs a model-zoo module with a decode config"
@@ -273,6 +296,11 @@ class RaggedInferenceEngineV2:
         self.kv_reserve = kv_reserve
         self.evictions = 0
         self.rng = rng if rng is not None else jax.random.PRNGKey(0)
+        # base key for position-keyed sampling (sampling.position_keys):
+        # derived from the pristine engine rng BEFORE any split, so a
+        # drawn token's key depends only on (engine seed, uid, position)
+        # — never on how dispatches happened to be scheduled
+        self._sample_base = jax.random.fold_in(self.rng, 0x5EED)
 
         if config is not None:
             from deepspeed_tpu.inference.config import \
@@ -289,6 +317,8 @@ class RaggedInferenceEngineV2:
                            else speculation)
             kv_tiering = (v2cfg.kv_tiering if kv_tiering is None
                           else kv_tiering)
+            prefix_cache = (v2cfg.prefix_cache if prefix_cache is None
+                            else prefix_cache)
         self.pipeline = True if pipeline is None else bool(pipeline)
         self.async_depth = max(
             int(async_depth) if async_depth is not None else 2, 1)
@@ -479,10 +509,38 @@ class RaggedInferenceEngineV2:
                 verify=kv_tiering.verify,
                 checksum=kv_tiering.checksum,
                 max_reread=kv_tiering.max_reread)
+        # -- cross-request prefix cache over the paged pool --
+        from deepspeed_tpu.inference.config import PrefixCacheConfig
+
+        if prefix_cache is None:
+            prefix_cache = PrefixCacheConfig()
+        elif isinstance(prefix_cache, bool):
+            prefix_cache = PrefixCacheConfig(enabled=prefix_cache)
+        elif isinstance(prefix_cache, dict):
+            prefix_cache = PrefixCacheConfig(
+                **{"enabled": True, **prefix_cache})
+        self._pfx_cfg = prefix_cache
+        self._pfx: Optional[PrefixCacheIndex] = None
+        self._cow_jit = None           # jitted fixed-shape page copy
+        if prefix_cache.enabled:
+            self._pfx = PrefixCacheIndex(
+                self.allocator, self.page_size,
+                max_entries=prefix_cache.max_index_entries,
+                min_match_pages=prefix_cache.min_match_pages)
+            if self.tiering is not None:
+                # under pool pressure, cold single-ref prefix pages
+                # demote into the tier store keyed by prefix hash (one
+                # restore serves every waiter) instead of being dropped
+                self._pfx.demote = self._pfx_demote
+                self._pfx.drop_spilled = self.tiering.drop
         tier_note = ""
         if self.tiering is not None:
             tier_note = (f" kv_tiering=host:{kv_tiering.host_pages}"
                          f"+nvme:{kv_tiering.nvme_pages}p")
+        if self._pfx is not None:
+            tier_note += (f" prefix_cache=max:"
+                          f"{prefix_cache.max_index_entries}"
+                          f"/min:{prefix_cache.min_match_pages}p")
         log_dist(
             f"RaggedInferenceEngineV2: max_seqs={max_seqs} "
             f"max_seq_len={max_seq_len} prefill_chunk={prefill_chunk} "
@@ -635,13 +693,29 @@ class RaggedInferenceEngineV2:
         out = self.host_stats.serving_stages()
         if self.tiering is not None:
             out["kv_tiering"] = self.tiering.stats()
+        if self._pfx is not None:
+            st = self.host_stats
+            pc = self._pfx.stats()
+            pc.update(hit_requests=st.prefix_hits,
+                      miss_requests=st.prefix_misses,
+                      hit_tokens=st.prefix_hit_tokens,
+                      cow_copies=st.prefix_cow_copies)
+            out["prefix_cache"] = pc
         out["requests"] = self.request_latency.summary()
         return out
 
     def close(self) -> None:
         """Release tier-store resources (AIO handle, staging buffers,
-        digest pool, spill files).  Idempotent; a no-op with tiering
-        off."""
+        digest pool, spill files) and prefix-cache holds.  Idempotent;
+        a no-op with tiering and the prefix cache off."""
+        for r in self.waiting:
+            if r.spilled is not None:
+                for p in r.spilled.get("shared_pages", ()):
+                    self.allocator.decref(int(p))
+                r.spilled["shared_pages"] = []
+        if self._pfx is not None:
+            self._pfx.clear()
+            self._pfx = None
         if self.tiering is not None:
             self.tiering.close()
             self.tiering = None
@@ -759,10 +833,11 @@ class RaggedInferenceEngineV2:
 
         wq = self._wq
         native = self._wq_native
+        sample_base = self._sample_base
 
         def run(params, cache, last_tok, pos, active, remaining,
                 page_table, eos_ids, do_sample, temperature, top_k, top_p,
-                rng):
+                seeds, rng):
             if wq:
                 from deepspeed_tpu.inference.quantization import \
                     dequantize_param_tree
@@ -788,9 +863,14 @@ class RaggedInferenceEngineV2:
                     positions=jnp.where(active, pos, 0)[None],
                     mutable=["cache"], ragged_meta=meta)
                 logits = logits_of(out)[0]              # [S, V]
-                rng, sub = jax.random.split(rng)
+                rng, _ = jax.random.split(rng)
+                # position-keyed per-row keys: the draw at cache position
+                # `pos` is the same bits the fused tick's host sampler
+                # would use, no matter how this block was scheduled
+                sub = (position_keys(sample_base, seeds, pos)
+                       if sampled else None)
                 nxt = sample_logits_batched(
-                    logits, sub if sampled else None, do_sample,
+                    logits, sub, do_sample,
                     temperature, top_k, top_p)
                 produced = active
                 nxt = jnp.where(active, nxt, last_tok)
@@ -828,6 +908,7 @@ class RaggedInferenceEngineV2:
         temperature = np.ones((S,), np.float32)
         top_k = np.zeros((S,), np.int32)
         top_p = np.ones((S,), np.float32)
+        seeds = np.zeros((S,), np.int32)   # per-row sampling seed (uid)
         for r in reqs:
             s = r.slot
             self._last_sched[s] = self._sched_seq
@@ -840,8 +921,9 @@ class RaggedInferenceEngineV2:
             temperature[s] = r.temperature
             top_k[s] = r.top_k
             top_p[s] = r.top_p
+            seeds[s] = r.uid
         return (last_tok, pos, active, remaining, eos_ids, do_sample,
-                temperature, top_k, top_p)
+                temperature, top_k, top_p, seeds)
 
     def _fold_block(self, reqs: List[Request], toks: np.ndarray,
                     mask: np.ndarray) -> int:
@@ -1164,8 +1246,10 @@ class RaggedInferenceEngineV2:
         reference path."""
         st = self.host_stats
         with st.stage("plan"):
+            # the spec block keeps the global rng stream (its rejection-
+            # sampling keys are not position-keyed), so seeds is unused
             (last_tok, pos, active, remaining, eos_ids, do_sample,
-             temperature, top_k, top_p) = self._block_arrays(reqs)
+             temperature, top_k, top_p, _seeds) = self._block_arrays(reqs)
             sampled = bool(do_sample.any())
             hist = self._hist_array(reqs)
         self._draft_catchup(reqs)
@@ -1209,12 +1293,12 @@ class RaggedInferenceEngineV2:
         st = self.host_stats
         with st.stage("plan"):
             (last_tok, pos, active, remaining, eos_ids, do_sample,
-             temperature, top_k, top_p) = self._block_arrays(reqs)
+             temperature, top_k, top_p, seeds) = self._block_arrays(reqs)
             sampled = bool(do_sample.any())
         self.rng, sub = jax.random.split(self.rng)
         args = [self._upload(a) for a in
                 (last_tok, pos, active, remaining, self.page_table,
-                 eos_ids, do_sample, temperature, top_k, top_p)]
+                 eos_ids, do_sample, temperature, top_k, top_p, seeds)]
         if trace.enabled:
             trace.event("decode_block", cat="request",
                         uids=[r.uid for r in reqs],
@@ -1250,17 +1334,51 @@ class RaggedInferenceEngineV2:
         if not self.waiting or not any(s is None for s in self.slots):
             return False
         req = self.waiting[0]
+        need = self._admit_need(req)
+        fresh, entries = self._fresh_pages_needed(req, need, touch=False)
+        avail = self.allocator.free_pages
+        if self._pfx is not None:
+            avail += self._pfx.reclaimable(
+                exclude={e.key for e in entries})
+        return fresh <= avail
+
+    def _admit_need(self, req: Request) -> int:
+        """Token coverage ``_admit`` reserves for ``req`` — ONE formula
+        shared with ``_admittable`` so the pipelined loop reconciles at
+        precisely the steps where ``pipeline=False`` would admit."""
         ctx_len = req.ctx_len
         rem = max(req.max_new_tokens - len(req.generated), 1)
         if self.kv_reserve == "worst_case":
-            need = ctx_len + req.max_new_tokens - len(req.generated)
-        elif req.spilled is not None and req.prefill_done >= ctx_len:
+            # worst case INCLUDING re-prefilled output for evicted
+            # continuations (their ctx carries earlier tokens)
+            return ctx_len + req.max_new_tokens - len(req.generated)
+        if req.spilled is not None and req.prefill_done >= ctx_len:
             # spilled decode-phase continuation: _admit allocates for
             # its full restored length, not just the prompt
-            need = req.length + min(self.decode_block_size, rem)
-        else:
-            need = ctx_len + min(self.decode_block_size, rem)
-        return self.allocator.can_allocate(need)
+            return req.length + min(self.decode_block_size, rem)
+        # on-demand (reference can_schedule): context + the first
+        # decode block; growth happens per block
+        return ctx_len + min(self.decode_block_size, rem)
+
+    def _fresh_pages_needed(self, req: Request, need: int,
+                            touch: bool = False):
+        """Free pages an admission of ``req`` would consume, after
+        prefix-cache attaches (resident matched pages cost nothing; a
+        FULL match costs one extra page for the COW re-prefill) and
+        spill-hold re-attaches.  Returns ``(fresh, matched_entries)``.
+        ``touch=False`` for probes — LRU order must not move until the
+        admission actually happens."""
+        total = self.allocator.pages_for(need)
+        if req.spilled is not None:
+            return total - len(req.spilled.get("shared_pages", ())), []
+        if self._pfx is None:
+            return total, []
+        ctx = req.ctx if req.ctx is not None else req.prompt
+        entries = self._pfx.match(ctx, touch=touch)
+        resident = sum(1 for e in entries if e.state == "resident")
+        full = bool(entries) and (
+            len(entries) * self.page_size == ctx.size)
+        return total - resident + (1 if full else 0), entries
 
     def _pipeline_start(self, reqs: List[Request],
                         spec: bool = False) -> None:
@@ -1278,7 +1396,7 @@ class RaggedInferenceEngineV2:
             self._draft_catchup(reqs)
         with self.host_stats.stage("plan"):
             (last_tok, pos, active, remaining, eos_ids, do_sample,
-             temperature, top_k, top_p) = self._block_arrays(reqs)
+             temperature, top_k, top_p, seeds) = self._block_arrays(reqs)
             S = self.max_seqs
             # exact host projection of per-slot cache length and token
             # budget — for eos-free sequences the device's active/
@@ -1313,6 +1431,8 @@ class RaggedInferenceEngineV2:
             self._dev["hist"] = self._upload(self._hist_array(reqs))
             self._dev["plen_hi"] = plen.copy()
             self._dev["rem_lo"] = rem.copy()
+        else:
+            self._dev["seeds"] = self._upload(seeds)
 
     def _pipeline_step(self) -> int:
         """One pipelined iteration: plan + dispatch block k+1 while the
@@ -1386,7 +1506,7 @@ class RaggedInferenceEngineV2:
                     self.params, self.cache, dv["last_tok"], dv["pos"],
                     dv["active"], dv["remaining"], dv["page_table"],
                     dv["eos_ids"], dv["do_sample"], dv["temperature"],
-                    dv["top_k"], dv["top_p"], sub)
+                    dv["top_k"], dv["top_p"], dv["seeds"], sub)
             dv["pending"].append((toks, mask))
         st.ticks += K
         with st.stage("plan"):
@@ -1585,26 +1705,7 @@ class RaggedInferenceEngineV2:
             req = self.waiting[0]
             if req.ctx is None:
                 req.ctx = req.prompt
-            if self.kv_reserve == "worst_case":
-                # worst case INCLUDING re-prefilled output for evicted
-                # continuations (their ctx carries earlier tokens)
-                need = req.ctx_len + req.max_new_tokens - \
-                    len(req.generated)
-            elif req.spilled is not None:
-                # spilled continuation: its cache rows come back via
-                # restore, not re-prefill — pages must cover the live
-                # rows plus the first decode block
-                rem = max(req.max_new_tokens - len(req.generated), 1)
-                if req.prefill_done < req.ctx_len:
-                    need = req.ctx_len + min(self.decode_block_size, rem)
-                else:
-                    need = req.length + min(self.decode_block_size, rem)
-            else:
-                # on-demand (reference can_schedule): context + the
-                # first decode block; growth happens per block
-                need = req.ctx_len + min(self.decode_block_size,
-                                         max(req.max_new_tokens -
-                                             len(req.generated), 1))
+            need = self._admit_need(req)
             if self.allocator.pages_for(need) > self.num_pages - 1:
                 # defense in depth behind put_request's submit-time
                 # check: an unschedulable head would deadlock the FIFO
@@ -1629,24 +1730,108 @@ class RaggedInferenceEngineV2:
                     f"({need} tokens) but the engine owns "
                     f"{self.num_pages - 1} usable pages — it can never "
                     "be scheduled, even after full eviction")
-            if not self.allocator.can_allocate(need):
+            fresh, probe = self._fresh_pages_needed(req, need,
+                                                    touch=False)
+            avail = self.allocator.free_pages
+            if self._pfx is not None:
+                avail += self._pfx.reclaimable(
+                    exclude={e.key for e in probe})
+            if fresh > avail:
                 break                      # FIFO: wait for pages to free
             self.waiting.popleft()
             req.slot = i
             if req.spilled is None:
                 req.prefill_done = 0       # spilled reqs keep their rows
+                req.pc_parent, req.pc_pages, req.pc_cached = ROOT_HASH, \
+                    0, 0
             self.slots[i] = req
             self._draft_len[i] = 0
-            pages = self.allocator.allocate(i, need)
             self.page_table[i, :] = -1
-            self.page_table[i, :len(pages)] = pages
+            if not self._attach_and_allocate(req, need):
+                # a tombstone revival failed mid-attach and the shrunken
+                # match needs more fresh pages than the pool holds —
+                # undo and retry this head next step (rare: the probe
+                # assumed the revivals would land)
+                self.allocator.free(i)
+                self.page_table[i, :] = -1
+                self.slots[i] = None
+                req.slot = -1
+                self.waiting.appendleft(req)
+                break
             self.request_latency.on_admit(req.uid)
             if trace.enabled:
                 trace.event("request_admit", cat="request", uid=req.uid,
-                            slot=i, pages=len(pages),
+                            slot=i, pages=self.allocator.owned(i),
+                            cached_pages=req.pc_pages
+                            if req.spilled is None else 0,
                             spilled=req.spilled is not None)
             if req.spilled is not None:
                 self._restore(req)
+
+    def _attach_and_allocate(self, req: Request, need: int) -> bool:
+        """Build slot ``req.slot``'s page run for an admission covering
+        ``need`` tokens: prefix-cache attaches (and tombstone revivals)
+        first, spill-hold re-attaches for a restoring request, COW of
+        the last page on a FULL prefix match, then fresh pages for the
+        remainder.  False when revival failures shrank the match below
+        what the pool can cover (caller undoes the admission)."""
+        i = req.slot
+        st = self.host_stats
+        attached = 0
+        full = False
+        if req.spilled is not None:
+            shared = [int(p) for p in req.spilled.get("shared_pages", ())]
+            if shared:
+                self.allocator.attach(i, shared)
+                self.page_table[i, :len(shared)] = shared
+                attached = len(shared)
+        elif self._pfx is not None:
+            with st.stage("prefix"):
+                entries = self._pfx.match(req.ctx, touch=True)
+                pages_att: List[int] = []
+                parent = ROOT_HASH
+                for e in entries:
+                    if e.state == "spilled" and not self._pfx_revive(e):
+                        break
+                    pages_att.append(int(e.page))
+                    parent = e.key
+                attached = len(pages_att)
+                if attached:
+                    self.allocator.attach(i, pages_att)
+                    self.page_table[i, :attached] = pages_att
+                    full = attached * self.page_size == req.ctx_len
+                    # the matched pages are already prefilled: schedule
+                    # only the suffix.  A FULL match still re-prefills
+                    # its LAST token through the fused program (after a
+                    # COW below) so the first sampled token's logits
+                    # come from the same compiled program as a cache-off
+                    # run — the bit-parity contract
+                    req.prefill_done = attached * self.page_size - (
+                        1 if full else 0)
+                    req.pc_parent = parent
+                    req.pc_pages = attached
+                    req.pc_cached = req.prefill_done
+                    st.prefix_hits += 1
+                    st.prefix_hit_pages += attached
+                    st.prefix_hit_tokens += req.prefill_done
+                else:
+                    st.prefix_misses += 1
+        grow_n = self.allocator.pages_for(need) - attached
+        want_free = grow_n + (1 if full else 0)
+        self._reclaim_for(want_free)
+        if want_free > self.allocator.free_pages:
+            return False
+        if full:
+            # the write frontier (position ctx_len - 1) lands in the
+            # last matched page: make it private before the re-prefill
+            old, new = self.allocator.cow(i, attached - 1)
+            if new != old:
+                self._cow_copy(old, new)
+                self.page_table[i, attached - 1] = new
+        if grow_n > 0:
+            pages = self.allocator.grow(i, grow_n)
+            self.page_table[i, attached:attached + grow_n] = pages
+        return True
 
     def _ensure_pages(self, slot: int, upto_tokens: int) -> bool:
         """Grow ``slot``'s page run to cover ``upto_tokens`` cache
@@ -1657,11 +1842,23 @@ class RaggedInferenceEngineV2:
         have = self.allocator.owned(slot)
         if need <= have:
             return True
+        self._reclaim_for(need - have)
         if need - have > self.allocator.free_pages:
             return False
         pages = self.allocator.grow(slot, need - have)
         self.page_table[slot, have:have + len(pages)] = pages
         return True
+
+    def _reclaim_for(self, n_pages: int) -> None:
+        """Ask the prefix index to give back cold single-reference pages
+        when the free list can't cover ``n_pages``.  Safe against any
+        page a live slot uses: those hold a slot reference on top of the
+        index's, so the index never reclaims them."""
+        if self._pfx is None:
+            return
+        short = n_pages - self.allocator.free_pages
+        if short > 0:
+            self._pfx.reclaim(short)
 
     def _evict(self, r) -> None:
         """Requeue ``r`` as a CONTINUATION: its pages return to the
@@ -1677,6 +1874,7 @@ class RaggedInferenceEngineV2:
         r.ctx = np.concatenate(
             [r.prompt, np.asarray(r.generated, np.int32)])
         r.prefill_done = 0
+        r.pc_parent, r.pc_pages, r.pc_cached = ROOT_HASH, 0, 0
         r.slot = -1
         self.waiting.append(r)             # back of the queue: the freed
         self.evictions += 1                # pages go to older work first
@@ -1733,25 +1931,44 @@ class RaggedInferenceEngineV2:
         and the pending last token are preserved."""
         live = self._live_tokens(r)
         n_live = pages_for(live, self.page_size) if live > 0 else 0
-        if (n_live == 0 or self.tiering is None or
-                not self.tiering.can_spill(n_live)):
+        if n_live == 0 or self.tiering is None:
             return False
+        # shared-prefix pages (refcount > 1: the prefix index or another
+        # sequence also holds them) are maximally hot — they never leave
+        # HBM.  Take a spill-hold (+1 ref) on the maximal shared PREFIX
+        # of the live run and spill only the private suffix; no owner
+        # ever writes a shared page (COW precedes any write), so the
+        # rows stay valid for re-attach at restore
+        j = 0
+        while j < n_live and self.allocator.refcount(
+                int(self.page_table[r.slot, j])) > 1:
+            j += 1
+        n_priv = n_live - j
+        if n_priv > 0 and not self.tiering.can_spill(n_priv):
+            return False
+        shared = [int(p) for p in self.page_table[r.slot, :j]]
         st = self.host_stats
         with st.stage("spill"):
-            gather, _ = self._tier_jits()
-            idx = np.zeros((self.pages_per_seq,), np.int32)  # pad: trash
-            idx[:n_live] = self.page_table[r.slot, :n_live]
-            rows = jax.device_get(gather(self.cache, jnp.asarray(idx)))
-            try:
-                self.tiering.spill(
-                    r.uid,
-                    [np.asarray(leaf[:n_live]) for leaf in
-                     jax.tree_util.tree_leaves(rows)],
-                    n_live)
-            except RuntimeError:
-                return False               # tiers full: caller evicts
+            if n_priv > 0:
+                gather, _ = self._tier_jits()
+                idx = np.zeros((self.pages_per_seq,),
+                               np.int32)               # pad: trash
+                idx[:n_priv] = self.page_table[r.slot, j:n_live]
+                rows = jax.device_get(gather(self.cache,
+                                             jnp.asarray(idx)))
+                try:
+                    self.tiering.spill(
+                        r.uid,
+                        [np.asarray(leaf[:n_priv]) for leaf in
+                         jax.tree_util.tree_leaves(rows)],
+                        n_priv)
+                except RuntimeError:
+                    return False           # tiers full: caller evicts
             r.spilled = {"last_tok": int(self._last_tokens[r.slot]),
-                         "n_pages": n_live, "live_tokens": live}
+                         "n_pages": n_priv, "live_tokens": live,
+                         "shared_pages": shared}
+            for p in shared:
+                self.allocator.incref(p)   # spill-hold survives free()
         from deepspeed_tpu.utils.logging import logger
 
         self.allocator.free(r.slot)
@@ -1781,41 +1998,52 @@ class RaggedInferenceEngineV2:
 
         st = self.host_stats
         info = req.spilled
-        n = info["n_pages"]
-        t_restore0 = time.perf_counter()
+        n = info["n_pages"]                 # private pages in the tiers
+        shared = info.get("shared_pages", [])
+        jn = len(shared)                    # shared prefix re-attached by
+        t_restore0 = time.perf_counter()    # _attach_and_allocate
         try:
-            with st.stage("restore"):
-                arrs = self.tiering.restore(req.uid)
-                _, scatter = self._tier_jits()
-                # pad indices past the pool: mode='drop' discards them
-                idx = np.full((self.pages_per_seq,), self.num_pages,
-                              np.int32)
-                idx[:n] = self.page_table[req.slot, :n]
-                leaves = []
-                for a in arrs:
-                    full = np.zeros((self.pages_per_seq,) + a.shape[1:],
-                                    a.dtype)
-                    full[:n] = a
-                    leaves.append(jnp.asarray(full))
-                rows = jax.tree_util.tree_unflatten(self._cache_treedef,
-                                                    leaves)
-                self.cache = scatter(self.cache, jnp.asarray(idx), rows)
+            if n > 0:
+                with st.stage("restore"):
+                    arrs = self.tiering.restore(req.uid)
+                    _, scatter = self._tier_jits()
+                    # pad indices past the pool: mode='drop' drops them
+                    idx = np.full((self.pages_per_seq,), self.num_pages,
+                                  np.int32)
+                    idx[:n] = self.page_table[req.slot, jn:jn + n]
+                    leaves = []
+                    for a in arrs:
+                        full = np.zeros(
+                            (self.pages_per_seq,) + a.shape[1:], a.dtype)
+                        full[:n] = a
+                        leaves.append(jnp.asarray(full))
+                    rows = jax.tree_util.tree_unflatten(
+                        self._cache_treedef, leaves)
+                    self.cache = scatter(self.cache, jnp.asarray(idx),
+                                         rows)
             self._last_tokens[req.slot] = info["last_tok"]
             req.spilled = None
+            # release the spill-holds: the slot attach owns its refs now
+            for p in shared:
+                self.allocator.decref(p)
             self.restores += 1
             self.request_latency.on_restore_stall(
                 req.uid, time.perf_counter() - t_restore0)
             if trace.enabled:
                 trace.event("request_restore", cat="request",
-                            uid=req.uid, pages=int(n))
+                            uid=req.uid, pages=int(n),
+                            shared_pages=int(jn))
         except KVRestoreError as e:
             self.allocator.free(req.slot)
             self.page_table[req.slot, :] = -1
             self.slots[req.slot] = None
             self._draft_len[req.slot] = 0
+            for p in shared:
+                self.allocator.decref(p)
             req.ctx = np.concatenate(
                 [req.prompt, np.asarray(req.generated, np.int32)])
             req.prefill_done = 0
+            req.pc_parent, req.pc_pages, req.pc_cached = ROOT_HASH, 0, 0
             req.spilled = None
             req.slot = -1
             self.waiting.appendleft(req)   # front: it already waited
@@ -1828,6 +2056,118 @@ class RaggedInferenceEngineV2:
                 f"ragged engine: restore of uid={req.uid} failed "
                 f"verification (page {e.page}; payload quarantined) — "
                 "re-prefilling the session from its own tokens")
+
+    def _pfx_demote(self, e) -> bool:
+        """Index-LRU hook: move a single-reference prefix page's KV into
+        the tiered store under the entry's PREFIX-HASH key (not a uid —
+        one restore serves every future waiter).  Returns False when the
+        tiers can't take it, in which case the index drops the entry."""
+        if self.tiering is None or not self.tiering.can_spill(1):
+            return False
+        st = self.host_stats
+        with st.stage("spill"):
+            gather, _ = self._tier_jits()
+            idx = np.zeros((self.pages_per_seq,), np.int32)  # pad: trash
+            idx[0] = e.page
+            rows = jax.device_get(gather(self.cache, jnp.asarray(idx)))
+            try:
+                self.tiering.spill(
+                    PrefixCacheIndex.tier_key(e.key),
+                    [np.asarray(leaf[:1]) for leaf in
+                     jax.tree_util.tree_leaves(rows)],
+                    1)
+            except RuntimeError:
+                return False
+        if trace.enabled:
+            trace.event("prefix_demote", cat="request",
+                        key=PrefixCacheIndex.tier_key(e.key))
+        return True
+
+    def _pfx_revive(self, e) -> bool:
+        """Bring a demoted prefix page back into a fresh pool page so an
+        admission can attach it.  On failure the tombstone is dropped —
+        the requester falls back to computing that page itself."""
+        from deepspeed_tpu.inference.kv_tiering import KVRestoreError
+
+        if self.tiering is None:
+            self._pfx._drop(e)
+            return False
+        if self.allocator.free_pages < 1:
+            return False
+        st = self.host_stats
+        page = self.allocator.take_page()
+        try:
+            with st.stage("restore"):
+                arrs = self.tiering.restore(
+                    PrefixCacheIndex.tier_key(e.key))
+                _, scatter = self._tier_jits()
+                idx = np.full((self.pages_per_seq,), self.num_pages,
+                              np.int32)
+                idx[0] = page
+                leaves = []
+                for a in arrs:
+                    full = np.zeros((self.pages_per_seq,) + a.shape[1:],
+                                    a.dtype)
+                    full[:1] = a
+                    leaves.append(jnp.asarray(full))
+                rows = jax.tree_util.tree_unflatten(self._cache_treedef,
+                                                    leaves)
+                self.cache = scatter(self.cache, jnp.asarray(idx), rows)
+        except KVRestoreError:
+            self.allocator.decref(page)
+            self._pfx._drop(e)             # payload quarantined: forget
+            return False
+        self._pfx.revive(e, page)
+        if trace.enabled:
+            trace.event("prefix_revive", cat="request",
+                        key=PrefixCacheIndex.tier_key(e.key),
+                        page=int(page))
+        return True
+
+    def _cow_copy(self, src: int, dst: int) -> None:
+        """Fixed-shape device copy of one page row across every cache
+        leaf — the copy half of copy-on-write.  ``src``/``dst`` are
+        traced int32 operands, so every COW reuses one compiled
+        program."""
+        st = self.host_stats
+        if self._cow_jit is None:
+            self._cow_jit = jax.jit(
+                lambda cache, s, d: jax.tree_util.tree_map(
+                    lambda l: l.at[d].set(l[s]), cache),
+                donate_argnums=(0,))
+        with st.stage("prefix"):
+            self.cache = self._cow_jit(self.cache, jnp.int32(src),
+                                       jnp.int32(dst))
+        st.prefix_cow_copies += 1
+        if trace.enabled:
+            trace.event("prefix_cow", cat="request", src=int(src),
+                        dst=int(dst))
+
+    def audit_kv_sharing(self) -> Dict[str, int]:
+        """Refcount-conservation audit: every physical page's refcount
+        must equal the number of holders that can reach it — slot
+        page-table rows, resident prefix-index entries, and spill-holds
+        on parked requests' shared prefixes.  Spilled payloads (tiers)
+        hold no pool pages by construction.  Delegates the per-page
+        equality to :meth:`PageAllocator.audit`."""
+        external: Dict[int, int] = {}
+        if self._pfx is not None:
+            for e in self._pfx._entries.values():
+                if e.state == "resident":
+                    external[e.page] = external.get(e.page, 0) + 1
+        for r in self.waiting:
+            if r.spilled is not None:
+                for p in r.spilled.get("shared_pages", ()):
+                    external[p] = external.get(p, 0) + 1
+        for s, r in enumerate(self.slots):
+            if r is None:
+                continue
+            row = [int(p) for p in self.page_table[s] if p >= 0]
+            owned = self.allocator.owned_pages(s)
+            assert row == owned, (
+                f"slot {s}: page-table row {row} != allocator "
+                f"ownership {owned}")
+        return self.allocator.audit(external=external)
 
     def _pick_victim(self, stalled):
         """Coldest page-stalled sequence: least-recently scheduled
@@ -1882,8 +2222,13 @@ class RaggedInferenceEngineV2:
                 continue                   # batch-budget-limited, not stalled
             if not self._ensure_pages(r.slot, r.prefill_done + take):
                 # partial growth: cover what the pool allows this tick
+                # (cold prefix-index pages count — _ensure_pages
+                # reclaims them on demand)
                 coverable = (self.allocator.owned(r.slot) +
-                             self.allocator.free_pages) * self.page_size
+                             self.allocator.free_pages +
+                             (self._pfx.reclaimable()
+                              if self._pfx is not None else 0)
+                             ) * self.page_size
                 take = min(take, coverable - r.prefill_done)
                 if take <= 0:
                     self._stalled.append(r)     # page-limited
@@ -1926,6 +2271,19 @@ class RaggedInferenceEngineV2:
                 new_kv_dest[t:t + take] = (pg * self.page_size +
                                            pos % self.page_size)
                 r.prefill_done += take
+                if self._pfx is not None:
+                    # publish every freshly completed full page to the
+                    # prefix index (chain-hash it onto the request's
+                    # registered prefix); identical in both pipeline
+                    # modes — registration keys off prefill progress,
+                    # not dispatch timing
+                    page = self.page_size
+                    while (r.pc_pages + 1) * page <= r.prefill_done:
+                        k = r.pc_pages
+                        r.pc_parent = self._pfx.register(
+                            r.pc_parent, r.ctx[k * page:(k + 1) * page],
+                            int(self.page_table[r.slot, k]))
+                        r.pc_pages += 1
                 if trace.enabled:
                     trace.event("prefill_chunk", cat="request",
                                 uid=r.uid, take=int(take),
@@ -1935,6 +2293,9 @@ class RaggedInferenceEngineV2:
                 kv_lens[j] = r.prefill_done
                 cu_q_lens[j + 1] = cu_q_lens[j] + take
                 finishes = r.prefill_done >= r.ctx_len
+                if finishes:
+                    self.request_latency.on_prefill_done(
+                        r.uid, r.ctx_len - r.pc_cached, r.pc_cached)
                 sample_rows[j] = t + take - 1
                 samplers.append((r, j, finishes))
                 t += take
@@ -1959,7 +2320,16 @@ class RaggedInferenceEngineV2:
             rows = np.asarray([j for _, j in pairs])
             sub = None
             if do_sample:
-                self.rng, sub = jax.random.split(self.rng)
+                # (uid, position)-keyed streams: the draw for token n of
+                # request u is the same whatever else is co-batched, so
+                # seeded sampling is reproducible under prefix-cache
+                # admission reordering (same convention as the decode
+                # block's per-tick keys)
+                sub = position_keys(
+                    self._sample_base,
+                    jnp.asarray([r.uid for r, _ in pairs], jnp.int32),
+                    jnp.asarray([r.length - 1 for r, _ in pairs],
+                                jnp.int32))
             dev_toks = sample_logits(
                 sel_logits[rows], sub, do_sample=do_sample,
                 temperature=temp, top_k=top_k, top_p=top_p)
@@ -1984,6 +2354,24 @@ class RaggedInferenceEngineV2:
     def _reap(self) -> None:
         for i, r in enumerate(self.slots):
             if r is not None and r.done:
+                if (self._pfx is not None
+                        and self._pfx_cfg.include_generated):
+                    # opt-in: publish full pages of generated tokens
+                    # before the refs drop.  Decode pages come from a
+                    # different compiled program than fused prefill, so
+                    # the bit-parity contract is waived for hits on them
+                    # (documented on the config knob).
+                    seq = np.concatenate(
+                        [r.ctx, np.asarray(r.generated, np.int32)[
+                            r.ctx_len - r.prompt.size:]])
+                    written = r.length - 1   # last token never written
+                    page = self.page_size
+                    while (r.pc_pages + 1) * page <= written:
+                        k = r.pc_pages
+                        r.pc_parent = self._pfx.register(
+                            r.pc_parent, seq[k * page:(k + 1) * page],
+                            int(self.page_table[i, k]))
+                        r.pc_pages += 1
                 self.finished.append(r)
                 self.slots[i] = None
                 self.allocator.free(i)

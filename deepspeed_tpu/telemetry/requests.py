@@ -14,10 +14,16 @@ HARVEST (when the host folds device tokens back into request state) —
 the honest host-visible latency, since the deferred-harvest pipeline
 means the host cannot observe a token earlier than that.
 
-- ``ttft``: first harvested token − submit
+- ``ttft``: first harvested token − submit (clamped at submit — a
+  prefix-cache hit whose prefill is fully skipped can emit in the same
+  scheduler tick it was admitted; the sample must be ≥ 0, never
+  missing or negative)
 - ``tpot``: (last − first token) / (tokens − 1), requests with ≥2 tokens
 - ``queue_wait``: first admit − submit
 - ``spill_stall``: accumulated restore-bracket seconds per request
+- ``prefill``: admit → prefill-complete span, plus per-request counts
+  of prefill tokens actually computed vs skipped via the prefix cache
+  (a full prefix hit records a ~zero-length span, not a hole)
 
 The tracker is always on (a few dict ops per request per harvest —
 noise next to a device dispatch), independent of the tracer's enabled
@@ -45,7 +51,8 @@ def percentile(values: List[float], q: float) -> Optional[float]:
 
 class _Rec:
     __slots__ = ("submit_t", "admit_t", "first_token_t", "last_token_t",
-                 "tokens", "spill_stall_s", "spills", "finish_t")
+                 "tokens", "spill_stall_s", "spills", "finish_t",
+                 "prefill_end_t", "prefill_computed", "prefill_cached")
 
     def __init__(self, submit_t: float):
         self.submit_t = submit_t
@@ -56,6 +63,9 @@ class _Rec:
         self.spill_stall_s = 0.0
         self.spills = 0
         self.finish_t: Optional[float] = None
+        self.prefill_end_t: Optional[float] = None
+        self.prefill_computed = 0
+        self.prefill_cached = 0
 
 
 class RequestLatencyTracker:
@@ -89,11 +99,27 @@ class RequestLatencyTracker:
         r = self._live.get(uid)
         if r is None or total_tokens <= r.tokens:
             return
-        now = self.clock()
+        # clamp at submit so a fully-skipped prefill (prefix-cache hit
+        # emitting in its admission tick) records TTFT >= 0 even under
+        # a coarse injected clock
+        now = max(self.clock(), r.submit_t)
         if r.first_token_t is None:
             r.first_token_t = now
         r.last_token_t = now
         r.tokens = total_tokens
+
+    def on_prefill_done(self, uid: Any, computed_tokens: int,
+                        cached_tokens: int = 0) -> None:
+        """Prefill finished for ``uid``: ``computed_tokens`` went
+        through the model, ``cached_tokens`` were skipped via the
+        prefix cache.  First call wins (evict/re-prefill churn keeps
+        the original span)."""
+        r = self._live.get(uid)
+        if r is None or r.prefill_end_t is not None:
+            return
+        r.prefill_end_t = max(self.clock(), r.submit_t)
+        r.prefill_computed = int(computed_tokens)
+        r.prefill_cached = int(cached_tokens)
 
     def on_spill(self, uid: Any) -> None:
         r = self._live.get(uid)
@@ -128,10 +154,18 @@ class RequestLatencyTracker:
                               if r.admit_t is not None],
             "spill_stall_ms": [r.spill_stall_s * 1e3 for r in done
                                if r.spills > 0],
+            "prefill_ms": [(r.prefill_end_t - r.admit_t) * 1e3
+                           for r in done
+                           if r.prefill_end_t is not None
+                           and r.admit_t is not None],
         }
         out: Dict[str, Any] = {"completed": len(done),
                                "submitted": self.submitted,
-                               "in_flight": len(self._live)}
+                               "in_flight": len(self._live),
+                               "prefill_computed_tokens": sum(
+                                   r.prefill_computed for r in done),
+                               "prefill_cached_tokens": sum(
+                                   r.prefill_cached for r in done)}
         for name, vals in series.items():
             for q in self.PCTS:
                 v = percentile(vals, q)

@@ -48,6 +48,11 @@ def main() -> None:
                    help="NVMe tier budget in KV pages (0 = host only)")
     p.add_argument("--kv-nvme-dir", default=None,
                    help="directory for NVMe tier page files")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="share identical token prefixes across "
+                        "requests: matched KV pages attach read-only "
+                        "(copy-on-write on divergence) so repeated "
+                        "system prompts skip their prefill")
     args = p.parse_args()
 
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -82,15 +87,21 @@ def main() -> None:
         pipeline=not args.no_pipeline,
         harvest_interval=args.harvest_interval,
         speculation={"mode": args.spec_mode, "k": args.spec_k},
-        kv_tiering=tiering, **spec_kw)
+        kv_tiering=tiering, prefix_cache=args.prefix_cache, **spec_kw)
 
-    # a burst of variable-length "requests"
+    # a burst of variable-length "requests"; with --prefix-cache they
+    # share a common system prompt so later admissions hit the index
     rng = np.random.default_rng(0)
+    sys_prompt = (rng.integers(1, cfg.vocab_size, size=(64,),
+                               dtype=np.int32)
+                  if args.prefix_cache else np.zeros((0,), np.int32))
     for n in (5, 17, 9, 30, 12, 7):
-        uid = engine.put_request(
-            rng.integers(1, cfg.vocab_size, size=(n,), dtype=np.int32),
-            max_new_tokens=args.max_new_tokens)
-        print(f"queued request {uid} (prompt {n} tokens)")
+        prompt = np.concatenate(
+            [sys_prompt,
+             rng.integers(1, cfg.vocab_size, size=(n,), dtype=np.int32)])
+        uid = engine.put_request(prompt,
+                                 max_new_tokens=args.max_new_tokens)
+        print(f"queued request {uid} (prompt {prompt.size} tokens)")
 
     step = 0
     while engine.has_work():
@@ -118,6 +129,17 @@ def main() -> None:
                        ("spills", "restores", "pages_spilled",
                         "pages_restored", "pages_verified", "demotions",
                         "nvme_spills", "prefetch_hits")))
+    pc = stages.get("prefix_cache")
+    if pc:
+        rl = engine.request_latency.summary()
+        print("prefix cache: " +
+              " ".join(f"{k}={pc[k]}" for k in
+                       ("hit_rate", "hit_requests", "miss_requests",
+                        "hit_tokens", "cow_copies", "entries",
+                        "demotions", "revivals")) +
+              f" prefill_computed={rl['prefill_computed_tokens']}"
+              f" prefill_cached={rl['prefill_cached_tokens']}")
+    if tier or pc:
         engine.close()
 
 

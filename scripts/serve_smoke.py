@@ -20,6 +20,13 @@ exits NONZERO if the tiering-on greedy output diverges from the
 tiering-off reference, if no spill actually happened (the gate would
 be vacuous), or if any restored page skipped digest verification.
 
+With ``--prefix-cache`` it additionally gates the cross-request prefix
+cache: a shared-system-prompt workload must produce greedy output
+bit-identical to the cache-off reference, must actually HIT the index
+(nonzero hit rate — the gate would be vacuous otherwise), and
+``audit_kv_sharing()`` (per-page refcount conservation over slots,
+index entries, and spill-holds) must hold after the drain.
+
 With ``--trace`` it additionally gates the unified tracer: a serving
 run with ``DSTPU_TRACE``-style tracing enabled must export a
 schema-valid Chrome trace carrying both serving-stage spans and
@@ -29,6 +36,7 @@ tracer-off (min of 3 runs each) — tracing is observability, not a tax.
 
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py [--tokens 250]
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --kv-tiering
+    JAX_PLATFORMS=cpu python scripts/serve_smoke.py --prefix-cache
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --trace
 """
 import argparse
@@ -50,6 +58,10 @@ def main() -> int:
                    help="also gate the tiered paged-KV store (tiny "
                         "pool, spill/restore parity + verified "
                         "restores)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="also gate the cross-request prefix cache "
+                        "(shared-prompt parity vs cache-off, nonzero "
+                        "hit rate, refcount-audit conservation)")
     p.add_argument("--trace", action="store_true",
                    help="also gate the unified tracer (schema-valid "
                         "Chrome-trace export, request latency "
@@ -173,6 +185,57 @@ def main() -> int:
               f"pages_verified={st['pages_verified']}/"
               f"{st['pages_restored']}")
         t_eng.close()
+    if args.prefix_cache:
+        # shared-system-prompt workload: 8 sessions over 4 seats share
+        # two full pages of system prompt, one repeats another verbatim
+        # (full match -> copy-on-write) — later waves must attach the
+        # first wave's pages, and greedy output must not move a bit
+        pc_kw = dict(max_seqs=4, page_size=16, num_pages=21,
+                     prefill_chunk=16, decode_block_size=4,
+                     kv_reserve="on_demand")
+        sys_prompt = rng.integers(1, 64, size=(32,), dtype=np.int32)
+        pc_prompts = [
+            np.concatenate([sys_prompt,
+                            rng.integers(1, 64, size=(16,),
+                                         dtype=np.int32)])
+            for _ in range(7)]
+        pc_prompts.append(pc_prompts[0].copy())      # full-match/COW
+
+        def pc_run(prefix):
+            eng = RaggedInferenceEngineV2(
+                LlamaForCausalLM(cfg), params=params, max_seq_len=128,
+                prefix_cache=prefix, rng=jax.random.PRNGKey(args.seed),
+                **pc_kw)
+            outs = eng.generate_all(list(pc_prompts),
+                                    max_new_tokens=24)
+            return outs, eng
+
+        p_ref, _ = pc_run(False)
+        p_on, p_eng = pc_run(True)
+        pc = p_eng.serving_stages()["prefix_cache"]
+        ok = sorted(p_on) == sorted(p_ref) and all(
+            np.array_equal(p_on[u], p_ref[u]) for u in p_ref)
+        if not ok:
+            print("FAIL [prefix-cache]: cache-on greedy output diverged "
+                  "from cache-off")
+            failures += 1
+        if not pc["hit_requests"] > 0 or not pc["hit_rate"] > 0:
+            print("FAIL [prefix-cache]: zero hit rate — the gate ran "
+                  f"vacuously ({pc})")
+            failures += 1
+        try:
+            p_eng.audit_kv_sharing()
+        except AssertionError as e:
+            print(f"FAIL [prefix-cache]: refcount audit failed: {e}")
+            failures += 1
+        rl = p_eng.request_latency.summary()
+        print(f"[prefix-cache] ok={ok} hit_rate={pc['hit_rate']} "
+              f"hit_requests={pc['hit_requests']} "
+              f"hit_tokens={pc['hit_tokens']} "
+              f"cow_copies={pc['cow_copies']} "
+              f"prefill_computed={rl['prefill_computed_tokens']} "
+              f"prefill_cached={rl['prefill_cached_tokens']}")
+        p_eng.close()
     if args.trace:
         import tempfile
         import time
@@ -239,6 +302,8 @@ def main() -> int:
           "acceptance healthy" +
           (", kv tiering spill/restore exact and verified"
            if args.kv_tiering else "") +
+          (", prefix cache exact with nonzero hit rate and clean "
+           "refcount audit" if args.prefix_cache else "") +
           (", trace export valid within overhead budget"
            if args.trace else ""))
     return 0

@@ -276,9 +276,14 @@ class TestEngineTiering:
             steps += 1
             eng.allocator.audit()
             eng.tiering.audit()
+            # refcount conservation: every page's refcount == number of
+            # page-table rows (+ external holders) referencing it
+            eng.audit_kv_sharing()
         assert eng.spills > 0
         a = eng.tiering.audit()
         assert a["sessions"] == 0, "drained run leaves no spilled payload"
+        fin = eng.audit_kv_sharing()
+        assert fin["referenced"] == 0, "drained run leaves no live refs"
         eng.close()
 
     def test_persistent_corruption_reprefills_exactly(self, params):
