@@ -963,6 +963,150 @@ def bench_ragged(args) -> None:
     detail["kv_fp8_cache_bytes_ratio"] = round(
         qeng.cache_bytes() / max(base_eng.cache_bytes(), 1), 3)
 
+    # quantized KV as a pool format (the kv_quant tentpole): capacity at
+    # a FIXED HBM byte budget, spill traffic vs the full-width control,
+    # and quality measured (not assumed) — per-tick logit error and
+    # greedy divergence under teacher forcing, so the numbers isolate
+    # KV quantization from trajectory divergence.
+    kq_page = 16
+    kq_pps = _pages_for(12 + t_new, kq_page)    # pages per session
+    kq_budget = RaggedInferenceEngineV2(
+        model, {"params": params}, max_seqs=4, max_seq_len=t_maxlen,
+        prefill_chunk=16, decode_block_size=4, page_size=kq_page,
+        num_pages=1 + 2 * kq_pps).cache_bytes()  # fp pool, ~2 sessions
+
+    def _kq_capacity(fmt):
+        """Serve the 8-session workload on a pool sized by the SAME
+        byte budget; resident capacity and evictions tell the story."""
+        eng = RaggedInferenceEngineV2(
+            model, {"params": params}, max_seqs=4, max_seq_len=t_maxlen,
+            prefill_chunk=16, decode_block_size=4, page_size=kq_page,
+            kv_pool_bytes=kq_budget, kv_cache_dtype=fmt)
+        eng.generate_all(list(t_prompts), max_new_tokens=t_new)
+        return {"num_pages": eng.num_pages,
+                "resident_sessions": max(1, (eng.num_pages - 1) //
+                                         kq_pps),
+                "evictions": eng.evictions,
+                "pool_bytes": eng.cache_bytes()}
+
+    def _kq_spill(fmt):
+        """Tiering-on run: every spilled page carries the pool's
+        storage format, so bytes_spilled measures the NVMe/host traffic
+        the format saves."""
+        eng = RaggedInferenceEngineV2(
+            model, {"params": params}, max_seqs=4, max_seq_len=t_maxlen,
+            prefill_chunk=16, decode_block_size=4, page_size=kq_page,
+            num_pages=1 + 2 * kq_pps, kv_cache_dtype=fmt,
+            kv_tiering={"host_pages": 64})
+        eng.generate_all(list(t_prompts), max_new_tokens=t_new)
+        st = eng.tiering.stats()
+        out = {"spills": eng.spills,
+               "bytes_spilled": st["bytes_spilled"],
+               "pages_verified": st["pages_verified"]}
+        eng.close()
+        return out
+
+    def _kq_quality(fmt, n_seqs=6, gen=40, prompt_len=8):
+        """Teacher-forced lockstep decode: the quantized pool replays
+        the fp pool's greedy token stream tick for tick, comparing
+        logits at every position."""
+        from deepspeed_tpu.inference.common import unroll_scan_params
+        qrng = np.random.default_rng(17)
+        pp_q = t_maxlen // kq_page
+        kq_unroll = bool(getattr(cfg, "scan_layers", False))
+
+        def _mk(pool_fmt):
+            pcfg = _dc.replace(
+                cfg, decode=True, ragged_decode=False, paged_decode=True,
+                max_cache_len=t_maxlen, scan_layers=False,
+                kv_page_size=kq_page, kv_num_pages=pp_q + 1,
+                tensor_parallel=False, kv_cache_dtype=pool_fmt)
+            pmodel = type(model)(pcfg)
+
+            @jax.jit
+            def tick(cache, tok, pos):
+                # one sequence on contiguous pages 1..pp: flat KV row
+                # for position p is page_size + p
+                meta = {"kv_lens": (pos + 1)[None].astype(jnp.int32),
+                        "page_indices": jnp.arange(
+                            1, pp_q + 1, dtype=jnp.int32)[None],
+                        "cu_q_lens": jnp.asarray([0, 1], jnp.int32),
+                        "num_seqs": jnp.asarray([1], jnp.int32),
+                        "new_kv_dest": (kq_page + pos)[None].astype(
+                            jnp.int32)}
+                p = (unroll_scan_params(params) if kq_unroll
+                     else params)
+                out, mut = pmodel.apply(
+                    {"params": p, "cache": cache}, tok[None, None],
+                    positions=pos[None, None], ragged_meta=meta,
+                    mutable=["cache"])
+                logits = out[0] if isinstance(out, tuple) else out
+                return logits[0, 0], mut["cache"]
+
+            meta0 = {"kv_lens": np.zeros((1,), np.int32),
+                     "page_indices": np.full((1, pp_q), -1, np.int32),
+                     "cu_q_lens": np.zeros((2,), np.int32),
+                     "num_seqs": np.zeros((1,), np.int32),
+                     "new_kv_dest": np.zeros((1,), np.int32)}
+            shapes = jax.eval_shape(lambda: pmodel.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
+                positions=jnp.zeros((1, 1), jnp.int32),
+                ragged_meta=meta0))
+            zero = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+            return tick, zero
+
+        f_tick, f_zero = _mk("none")
+        q_tick, q_zero = _mk(fmt)
+        max_err, errs, diverged, compared = 0.0, [], 0, 0
+        for _ in range(n_seqs):
+            prompt = qrng.integers(0, cfg.vocab_size, prompt_len,
+                                   dtype=np.int32)
+            f_cache, q_cache = f_zero, q_zero
+            tok = None
+            for p in range(prompt_len + gen - 1):
+                t_in = (jnp.asarray(prompt[p], jnp.int32)
+                        if p < prompt_len else tok)
+                pos = jnp.asarray(p, jnp.int32)
+                fl, f_cache = f_tick(f_cache, t_in, pos)
+                ql, q_cache = q_tick(q_cache, t_in, pos)
+                err = float(jnp.max(jnp.abs(fl - ql)))
+                errs.append(err)
+                max_err = max(max_err, err)
+                if p >= prompt_len - 1:
+                    compared += 1
+                    diverged += int(int(jnp.argmax(fl)) !=
+                                    int(jnp.argmax(ql)))
+                    tok = jnp.argmax(fl).astype(jnp.int32)
+        return {"logit_max_abs_err": round(max_err, 5),
+                "logit_mean_abs_err": round(
+                    float(np.mean(errs)), 5),
+                "greedy_tokens_compared": compared,
+                "greedy_divergence_rate": round(
+                    diverged / max(compared, 1), 4)}
+
+    full_cap = _kq_capacity("none")
+    full_spill = _kq_spill("none")
+    kq = {"hbm_byte_budget": kq_budget, "page_size": kq_page,
+          "sessions": t_sessions, "full_width": {
+              **full_cap, "spill": full_spill}}
+    for fmt in ("int8", "fp8"):
+        cap = _kq_capacity(fmt)
+        spill = _kq_spill(fmt)
+        kq[fmt] = {
+            **cap, "spill": spill,
+            "resident_sessions_vs_full_width": round(
+                cap["resident_sessions"] /
+                max(full_cap["resident_sessions"], 1), 2),
+            "spill_bytes_vs_full_width": round(
+                spill["bytes_spilled"] /
+                max(full_spill["bytes_spilled"], 1), 3),
+            "quality": _kq_quality(fmt)}
+    from deepspeed_tpu.inference.paged import kv_dequant_path
+    kq["dequant_path"] = kv_dequant_path(
+        cfg.hidden_size // cfg.num_attention_heads)
+    detail["kv_quant"] = kq
+
     if on_tpu:
         # weight-BOUND quantized serving: this config's 0.38 GB model is
         # per-tick-overhead-bound (quantization cannot speed it up — the

@@ -123,6 +123,39 @@ class HostStageStats:
         return out
 
 
+def kv_quant_block(cache, fmt: str, dequant_path: str,
+                   num_pages: int) -> Dict[str, Any]:
+    """``serving_stages()['kv_quant']`` sub-dict for a quantized paged
+    pool: exact byte accounting (1-byte payload pages vs fp32 scale
+    rows), the dequant-free read route taken on this backend, and
+    written-scale statistics.  Fetches the scale leaves — call at
+    stats/report time, never in the serving hot loop."""
+    leaves = jax.tree_util.tree_leaves(cache)
+    payload = sum(leaf.size * leaf.dtype.itemsize for leaf in leaves
+                  if np.dtype(leaf.dtype).itemsize == 1)
+    scale_bytes = sum(leaf.size * leaf.dtype.itemsize for leaf in leaves
+                      if np.dtype(leaf.dtype).itemsize != 1)
+    scales = [np.asarray(jax.device_get(leaf)).ravel() for leaf in leaves
+              if np.dtype(leaf.dtype).itemsize != 1]
+    flat = (np.concatenate(scales) if scales
+            else np.zeros((0,), np.float32))
+    # the quant write path floors every written scale at the smallest
+    # normal f32, so exact zeros are rows never written
+    nz = flat[flat != 0.0]
+    return {
+        "format": fmt,
+        "dequant_path": dequant_path,
+        "pool_bytes": payload + scale_bytes,
+        "payload_bytes": payload,
+        "scale_bytes": scale_bytes,
+        "num_pages": int(num_pages),
+        "scale_rows_written": int(nz.size),
+        "scale_min": float(nz.min()) if nz.size else 0.0,
+        "scale_max": float(nz.max()) if nz.size else 0.0,
+        "scale_mean": float(nz.mean()) if nz.size else 0.0,
+    }
+
+
 def logits_of(out):
     """Models may return (logits, aux) tuples (e.g. Mixtral's router
     loss); serving wants the logits."""

@@ -173,6 +173,12 @@ class InferenceV2Config(ConfigModel):
     pipeline: bool = True
     async_depth: int = 2
     harvest_interval: int = 4
+    # KV pool storage format: "none" keeps full-width pages; "int8" /
+    # "fp8" (alias "fp8_e4m3") persist 1-byte pages with per-(row, head)
+    # fp32 scales, read dequant-free by the quantized attention variants
+    # (ops/ragged_paged_quant.py on TPU, the gathered-pages XLA
+    # reference elsewhere) — the pool is never materialized full-width.
+    kv_cache_dtype: str = "none"
     speculation: SpeculationConfig = Field(
         default_factory=SpeculationConfig)
     kv_tiering: KVTieringConfig = Field(default_factory=KVTieringConfig)
@@ -185,6 +191,10 @@ class InferenceV2Config(ConfigModel):
             raise ValueError("async_depth must be >= 1")
         if self.harvest_interval < 1:
             raise ValueError("harvest_interval must be >= 1")
+        if self.kv_cache_dtype not in ("none", "int8", "fp8", "fp8_e4m3"):
+            raise ValueError(
+                "kv_cache_dtype must be none|int8|fp8|fp8_e4m3, got "
+                f"{self.kv_cache_dtype!r}")
         return self
 
 

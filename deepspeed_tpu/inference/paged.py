@@ -288,6 +288,68 @@ def ref_paged_attention(q: jax.Array, pages: jax.Array, kv_lens: jax.Array,
     return jnp.where(token_valid[:, None, None], y, 0.0).astype(q.dtype)
 
 
+def ref_paged_attention_quant(q: jax.Array, pages: jax.Array,
+                              scales: jax.Array, kv_lens: jax.Array,
+                              page_indices: jax.Array, cu_q_lens: jax.Array,
+                              num_seqs: jax.Array, *, sm_scale: float,
+                              sliding_window=None) -> jax.Array:
+    """Dequant-free XLA read path for a QUANTIZED page pool: gather each
+    sequence's attended pages (still 1-byte) through ``page_indices``,
+    dequantize ONLY the gathered operand, then masked attention.  The
+    dequantized intermediate is ``[S, pp*page, ...]`` — bounded by the
+    pages sequences actually attend, never the ``[P, ...]`` pool
+    (``test_paged_quant.py`` pins that on the traced jaxpr).  Rows
+    gathered in page-table order sit at their kv position directly, so
+    masking is a plain ``row < kv_len`` + causal bound.
+
+    q: ``[T, H, D]``; pages: ``[P, page, 2*Hkv, D]`` int8/fp8_e4m3;
+    scales: ``[P, page, 2*Hkv]`` fp32.  O(T * pp * page_size) — the
+    same test-scale contract as :func:`ref_paged_attention`, but over
+    per-sequence attended rows instead of the whole pool.
+    """
+    T, H, D = q.shape
+    P, page, combined, _ = pages.shape
+    Hkv = combined // 2
+    S, pp = page_indices.shape
+    R = pp * page                          # attended rows per sequence
+
+    safe = jnp.maximum(page_indices, 0).reshape(-1)       # [S*pp]
+    g_pages = jnp.take(pages, safe, axis=0)               # quantized
+    g_scales = jnp.take(scales, safe, axis=0)
+    kv = (g_pages.astype(jnp.float32) *
+          g_scales[..., None]).reshape(S, R, combined, D)
+    k_g = kv[:, :, 0::2, :]                               # [S, R, Hkv, D]
+    v_g = kv[:, :, 1::2, :]
+
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+    seq_of_t = jnp.sum((t_idx[:, None] >= cu_q_lens[None, 1:]).astype(
+        jnp.int32), axis=1)                               # [T]
+    token_valid = t_idx < cu_q_lens[num_seqs[0]]
+    seq_of_t = jnp.minimum(seq_of_t, S - 1)
+
+    q_len = cu_q_lens[1:] - cu_q_lens[:-1]                # [S]
+    q_pos = (jnp.take(kv_lens - q_len, seq_of_t) +
+             (t_idx - jnp.take(cu_q_lens[:-1], seq_of_t)))  # [T]
+    r_idx = jnp.arange(R, dtype=jnp.int32)
+    kv_len_t = jnp.take(kv_lens, seq_of_t)                # [T]
+    mask = ((r_idx[None, :] <= q_pos[:, None]) &
+            (r_idx[None, :] < kv_len_t[:, None]) &
+            token_valid[:, None])                         # [T, R]
+    if sliding_window is not None:
+        mask = mask & (r_idx[None, :] > q_pos[:, None] - sliding_window)
+
+    groups = H // Hkv
+    k_t = jnp.repeat(jnp.take(k_g, seq_of_t, axis=0), groups, axis=2)
+    v_t = jnp.repeat(jnp.take(v_g, seq_of_t, axis=0), groups, axis=2)
+    att = jnp.einsum("thd,trhd->htr", q.astype(jnp.float32),
+                     k_t) * sm_scale
+    att = jnp.where(mask[None], att, jnp.float32(-0.7 * np.finfo(
+        np.float32).max))
+    p = jax.nn.softmax(att, axis=-1)
+    y = jnp.einsum("htr,trhd->thd", p, v_t)
+    return jnp.where(token_valid[:, None, None], y, 0.0).astype(q.dtype)
+
+
 # ---------------------------------------------------------------------------
 # Flax-side: write new KV into pages, attend
 # ---------------------------------------------------------------------------
@@ -308,7 +370,9 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
 
     # KV-cache quantization (reference csrc/fp_quantizer selective_dequant
     # + inference v2 KV configs): pages persist in fp8 e4m3 or int8 with a
-    # per-(row, head) fp32 scale; dequantized transiently at attention
+    # per-(row, head) fp32 scale and are READ quantized — per-tile
+    # register dequant in ops/ragged_paged_quant.py (TPU) or the
+    # gathered-pages XLA reference below; never a full-width pool operand
     kv_quant = getattr(cfg, "kv_cache_dtype", "none") or "none"
     if kv_quant in ("fp8", "fp8_e4m3"):
         store_dtype, qmax = jnp.float8_e4m3fn, float(
@@ -339,7 +403,13 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
             jnp.float32)
         cf = combined.astype(jnp.float32)
         absmax = jnp.max(jnp.abs(cf), axis=-1)         # [T, 2Hkv]
-        scale = jnp.maximum(absmax, 1e-12) / qmax
+        # floor the QUOTIENT at the smallest normal f32, not absmax at
+        # an arbitrary 1e-12: fp8's qmax=448 can push absmax/qmax
+        # subnormal, and a subnormal scale's reciprocal overflows
+        # qv = cf/scale to inf before the store-dtype cast.  For any
+        # absmax >= 1e-12 this is bit-identical to the old floor.
+        scale = jnp.maximum(absmax / qmax,
+                            jnp.float32(np.finfo(np.float32).tiny))
         qv = cf / scale[..., None]
         if store_dtype == jnp.int8:
             qv = jnp.clip(jnp.round(qv), -qmax, qmax)
@@ -349,15 +419,13 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
         flat_s = flat_s.at[ragged_meta["new_kv_dest"]].set(scale,
                                                            mode="drop")
         scales_var.value = flat_s.reshape(P, page, 2 * Hkv)
-        pages_var.value = flat.reshape(P, page, 2 * Hkv, D)
-        # transient per-tick dequant.  The PERSISTENT pool (what bounds
-        # concurrent sequences) is 1-byte; the dequantized operand is
-        # temporary — XLA fuses it into the reference attention's reads,
-        # but the Pallas kernel path materializes it for the tick (a
-        # quantized-pages kernel variant would remove that; future work)
-        pages = (flat.astype(jnp.float32) *
-                 flat_s[..., None]).astype(k.dtype).reshape(
-                     P, page, 2 * Hkv, D)
+        pages = flat.reshape(P, page, 2 * Hkv, D)
+        pages_var.value = pages
+        # NO transient dequant: the quantized pool is read directly by
+        # the dequant-free attention variants below — per-tile register
+        # dequant in the Pallas kernel on TPU, gathered-pages dequant
+        # (O(attended rows), never O(pool)) in the XLA reference
+        kv_scales = scales_var.value
 
     qt = q[0].transpose(1, 0, 2)                       # [T, H, D]
     sm_scale = float(1.0 / np.sqrt(D))
@@ -390,6 +458,21 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
             qt, pages, kv_lens, page_indices, cu_q_lens, num_seqs,
             sm_scale=sm_scale, sliding_window=window)
 
+    def attend_quant(qt, pages, scales, kv_lens, page_indices, cu_q_lens,
+                     num_seqs):
+        # quantized pool: both routes read the 1-byte pages + scale rows
+        # directly (see kv_dequant_path for the route the engine reports)
+        if kv_dequant_path(D) == "pallas-quant":
+            from deepspeed_tpu.ops.ragged_paged_quant import \
+                ragged_paged_attention_quant
+
+            return ragged_paged_attention_quant(
+                qt, pages, scales, kv_lens, page_indices, cu_q_lens,
+                num_seqs, sm_scale=sm_scale, sliding_window=window)
+        return ref_paged_attention_quant(
+            qt, pages, scales, kv_lens, page_indices, cu_q_lens, num_seqs,
+            sm_scale=sm_scale, sliding_window=window)
+
     # TP serving (reference v2 sharding/attn.py: heads split over the TP
     # group): attention is embarrassingly parallel over heads, so under a
     # >1 `tensor` mesh axis run it shard_map-manual over `tensor` with q
@@ -406,16 +489,45 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
             f"TP serving requires heads divisible by tp={tp} "
             f"(H={H}, Hkv={Hkv})")
         mesh = resolve_mesh(None, "tensor")
-        y = _shard_map_compat(
-            attend, mesh=mesh,
-            in_specs=(P(None, "tensor", None),
-                      P(None, None, "tensor", None), P(), P(), P(), P()),
-            out_specs=P(None, "tensor", None),
-            axis_names={"tensor"}, check_vma=False)(
-                qt, pages, kv_lens, page_indices, cu_q_lens, num_seqs)
-    else:
+        if qmax is None:
+            y = _shard_map_compat(
+                attend, mesh=mesh,
+                in_specs=(P(None, "tensor", None),
+                          P(None, None, "tensor", None), P(), P(), P(),
+                          P()),
+                out_specs=P(None, "tensor", None),
+                axis_names={"tensor"}, check_vma=False)(
+                    qt, pages, kv_lens, page_indices, cu_q_lens, num_seqs)
+        else:
+            # the scale buffer shards its combined-head dim with the pool
+            y = _shard_map_compat(
+                attend_quant, mesh=mesh,
+                in_specs=(P(None, "tensor", None),
+                          P(None, None, "tensor", None),
+                          P(None, None, "tensor"), P(), P(), P(), P()),
+                out_specs=P(None, "tensor", None),
+                axis_names={"tensor"}, check_vma=False)(
+                    qt, pages, kv_scales, kv_lens, page_indices,
+                    cu_q_lens, num_seqs)
+    elif qmax is None:
         y = attend(qt, pages, kv_lens, page_indices, cu_q_lens, num_seqs)
+    else:
+        y = attend_quant(qt, pages, kv_scales, kv_lens, page_indices,
+                         cu_q_lens, num_seqs)
     return y.transpose(1, 0, 2)[None]                  # [1, H, T, D]
+
+
+def kv_dequant_path(head_dim: int) -> str:
+    """Which dequant-free read path a quantized pool takes on this
+    backend: the Pallas quantized-pages kernel
+    (:mod:`deepspeed_tpu.ops.ragged_paged_quant`; TPU, head_dim 128) or
+    the gathered-pages XLA reference
+    (:func:`ref_paged_attention_quant`).  Neither materializes a
+    full-width pool operand.  The engine reports this in its
+    ``serving_stages()['kv_quant']`` block."""
+    if jax.default_backend() == "tpu" and head_dim == 128:
+        return "pallas-quant"
+    return "xla-gather"
 
 
 def _serving_tp(cfg) -> int:

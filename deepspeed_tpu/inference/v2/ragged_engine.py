@@ -132,6 +132,34 @@ from deepspeed_tpu.inference.sampling import (filter_logits_batched,
 from deepspeed_tpu.utils.logging import log_dist
 
 
+def _paged_kv_page_bytes(model, mcfg, page_size: int,
+                         kv_cache_dtype: str) -> int:
+    """Exact device bytes ONE KV page costs across every cache leaf —
+    for a quantized pool that is the 1-byte payload page plus its fp32
+    scale rows.  Measured by an eval_shape probe of a 2-page pool
+    rather than guessed from the layer count, so any model-zoo cache
+    layout (extra leaves, fused layers) is accounted automatically."""
+    probe_cfg = dataclasses.replace(
+        mcfg, decode=True, ragged_decode=False, paged_decode=True,
+        max_cache_len=2 * page_size, scan_layers=False,
+        kv_page_size=page_size, kv_num_pages=2,
+        tensor_parallel=False, kv_cache_dtype=kv_cache_dtype)
+    probe = type(model)(probe_cfg)
+    meta = {"kv_lens": jnp.zeros((1,), jnp.int32),
+            "page_indices": jnp.full((1, 2), -1, jnp.int32),
+            "cu_q_lens": jnp.zeros((2,), jnp.int32),
+            "num_seqs": jnp.zeros((1,), jnp.int32),
+            "new_kv_dest": jnp.zeros((4,), jnp.int32)}
+    ids = jnp.zeros((1, 4), jnp.int32)
+    pos = jnp.zeros((1, 4), jnp.int32)
+    shapes = jax.eval_shape(lambda: probe.init(
+        jax.random.PRNGKey(0), ids, positions=pos, ragged_meta=meta))
+    total = sum(int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+                for leaf in jax.tree_util.tree_leaves(shapes["cache"]))
+    assert total % 2 == 0, total
+    return total // 2
+
+
 @dataclasses.dataclass
 class Request:
     uid: int
@@ -193,7 +221,8 @@ class RaggedInferenceEngineV2:
                  rng: Optional[jax.Array] = None, page_size: int = 64,
                  num_pages: Optional[int] = None, topology=None,
                  decode_block_size: int = 8,
-                 kv_cache_dtype: str = "none",
+                 kv_cache_dtype: Optional[str] = None,
+                 kv_pool_bytes: Optional[int] = None,
                  quantize_weights: Optional[str] = None,
                  kv_reserve: str = "on_demand",
                  pipeline: Optional[bool] = None,
@@ -201,11 +230,25 @@ class RaggedInferenceEngineV2:
                  harvest_interval: Optional[int] = None,
                  speculation: Any = None,
                  draft_model=None, draft_params: Any = None,
+                 draft_kv_cache_dtype: Optional[str] = None,
                  kv_tiering: Any = None,
                  prefix_cache: Any = None,
                  config: Any = None):
-        """``kv_cache_dtype``: "none" | "fp8" | "int8" — paged KV pool
-        storage format (reference fp_quantizer KV quantization).
+        """``kv_cache_dtype``: ``None`` (config subtree
+        ``v2.kv_cache_dtype`` decides; "none" by default) | "none" |
+        "fp8" | "int8" — paged KV pool storage format (reference
+        fp_quantizer KV quantization).  Quantized pools are read
+        dequant-free: the Pallas quantized-pages kernel on TPU at
+        head_dim 128, the gathered-pages XLA reference elsewhere
+        (:func:`~deepspeed_tpu.inference.paged.kv_dequant_path`).
+        ``kv_pool_bytes``: size the pool by a device byte budget instead
+        of page count — ``num_pages`` becomes the exact number of pages
+        (payload + scale rows) that fit, so the same HBM budget holds
+        ~2x the pages when quantized.  Ignored when ``num_pages`` is
+        given explicitly.
+        ``draft_kv_cache_dtype``: storage format for the draft model's
+        pool under ``speculation.mode='draft'``; default ``None``
+        follows the target pool's resolved ``kv_cache_dtype``.
         ``quantize_weights``: None | "int8" | "fp8" | "fp6" | "w8a8" —
         weights persist quantized in HBM and dequantize in-jit at use
         (reference FP6-LLM cuda_linear / int8 quantized inference);
@@ -271,8 +314,47 @@ class RaggedInferenceEngineV2:
         self.tp = (topology.tensor_parallel_size
                    if topology is not None else 1)
 
+        # config-sourced knobs resolve BEFORE pool sizing: the resolved
+        # kv_cache_dtype decides the per-page byte cost a kv_pool_bytes
+        # budget divides by (kwarg > config > default, as for every
+        # other v2 knob)
+        if config is not None:
+            from deepspeed_tpu.inference.config import \
+                load_inference_config
+
+            v2cfg = load_inference_config(config).v2
+            pipeline = v2cfg.pipeline if pipeline is None else pipeline
+            async_depth = (v2cfg.async_depth if async_depth is None
+                           else async_depth)
+            harvest_interval = (v2cfg.harvest_interval
+                                if harvest_interval is None
+                                else harvest_interval)
+            speculation = (v2cfg.speculation if speculation is None
+                           else speculation)
+            kv_tiering = (v2cfg.kv_tiering if kv_tiering is None
+                          else kv_tiering)
+            prefix_cache = (v2cfg.prefix_cache if prefix_cache is None
+                            else prefix_cache)
+            kv_cache_dtype = (v2cfg.kv_cache_dtype
+                              if kv_cache_dtype is None
+                              else kv_cache_dtype)
+        kv_cache_dtype = ("none" if kv_cache_dtype is None
+                          else str(kv_cache_dtype))
+        assert kv_cache_dtype in ("none", "int8", "fp8", "fp8_e4m3"), (
+            f"kv_cache_dtype must be none|int8|fp8|fp8_e4m3, got "
+            f"{kv_cache_dtype!r}")
+        self.kv_cache_dtype = kv_cache_dtype
+
         self.page_size = int(page_size)
         self.pages_per_seq = pages_for(max_seq_len, self.page_size)
+        if num_pages is None and kv_pool_bytes is not None:
+            # byte-accounted sizing: probe the exact per-page device
+            # cost (quantized pools count the 1-byte payload AND the
+            # fp32 scale rows) and fit as many pages as the budget holds
+            # — page 0 is the trash page, so >= 2 keeps one usable
+            page_bytes = _paged_kv_page_bytes(
+                model, mcfg, self.page_size, kv_cache_dtype)
+            num_pages = max(2, int(kv_pool_bytes) // page_bytes)
         if num_pages is None:
             # full provisioning: every slot can reach max_seq_len. Callers
             # serving long-max_len traffic shrink this — memory then
@@ -302,23 +384,6 @@ class RaggedInferenceEngineV2:
         # — never on how dispatches happened to be scheduled
         self._sample_base = jax.random.fold_in(self.rng, 0x5EED)
 
-        if config is not None:
-            from deepspeed_tpu.inference.config import \
-                load_inference_config
-
-            v2cfg = load_inference_config(config).v2
-            pipeline = v2cfg.pipeline if pipeline is None else pipeline
-            async_depth = (v2cfg.async_depth if async_depth is None
-                           else async_depth)
-            harvest_interval = (v2cfg.harvest_interval
-                                if harvest_interval is None
-                                else harvest_interval)
-            speculation = (v2cfg.speculation if speculation is None
-                           else speculation)
-            kv_tiering = (v2cfg.kv_tiering if kv_tiering is None
-                          else kv_tiering)
-            prefix_cache = (v2cfg.prefix_cache if prefix_cache is None
-                            else prefix_cache)
         self.pipeline = True if pipeline is None else bool(pipeline)
         self.async_depth = max(
             int(async_depth) if async_depth is not None else 2, 1)
@@ -444,12 +509,22 @@ class RaggedInferenceEngineV2:
                 "ids, the models must share a tokenizer")
             self._draft_unroll = bool(getattr(dmcfg, "scan_layers",
                                               False))
+            # the draft pool defaults to the target pool's storage
+            # format — self-draft speculation gets the same capacity
+            # win unless the caller overrides draft_kv_cache_dtype
+            draft_fmt = (self.kv_cache_dtype
+                         if draft_kv_cache_dtype is None
+                         else str(draft_kv_cache_dtype))
+            assert draft_fmt in ("none", "int8", "fp8", "fp8_e4m3"), (
+                f"draft_kv_cache_dtype must be none|int8|fp8|fp8_e4m3, "
+                f"got {draft_fmt!r}")
+            self.draft_kv_cache_dtype = draft_fmt
             self._draft_cfg = dataclasses.replace(
                 dmcfg, decode=True, ragged_decode=False,
                 paged_decode=True, max_cache_len=max_seq_len,
                 scan_layers=False, kv_page_size=self.page_size,
                 kv_num_pages=self.num_pages, tensor_parallel=False,
-                kv_cache_dtype="none")
+                kv_cache_dtype=draft_fmt)
             self._draft = type(draft_model)(self._draft_cfg)
             from deepspeed_tpu.parallel import tensor_parallel as tp_lib
             dparams = normalize_params(
@@ -701,6 +776,14 @@ class RaggedInferenceEngineV2:
                       hit_tokens=st.prefix_hit_tokens,
                       cow_copies=st.prefix_cow_copies)
             out["prefix_cache"] = pc
+        if self.kv_cache_dtype != "none":
+            from deepspeed_tpu.inference.common import kv_quant_block
+            from deepspeed_tpu.inference.paged import kv_dequant_path
+
+            out["kv_quant"] = kv_quant_block(
+                self.cache, self.kv_cache_dtype,
+                kv_dequant_path(int(getattr(self.cfg, "head_dim", 0))),
+                self.num_pages)
         out["requests"] = self.request_latency.summary()
         return out
 

@@ -6,6 +6,7 @@ from deepspeed_tpu.ops.fused_adam import (scale_by_fused_adam,
 from deepspeed_tpu.ops.quantization import (dequantize, dequantize_fp6,
                                             dequantize_fp8, quantize,
                                             quantize_fp6, quantize_fp8)
+from deepspeed_tpu.ops.ragged_paged_quant import ragged_paged_attention_quant
 from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
                                                 BSLongformerSparsityConfig,
                                                 DenseSparsityConfig,
@@ -16,7 +17,8 @@ from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
 __all__ = [
     "flash_attention", "evoformer_attention", "DS4Sci_EvoformerAttention", "scale_by_fused_adam", "scale_by_fused_lion",
     "quantize", "dequantize", "quantize_fp8", "dequantize_fp8",
-    "quantize_fp6", "dequantize_fp6", "block_sparse_attention",
+    "quantize_fp6", "dequantize_fp6", "ragged_paged_attention_quant",
+    "block_sparse_attention",
     "SparseSelfAttention", "FixedSparsityConfig", "BigBirdSparsityConfig",
     "BSLongformerSparsityConfig", "DenseSparsityConfig",
 ]

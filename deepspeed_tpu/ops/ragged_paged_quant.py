@@ -1,0 +1,192 @@
+"""Quantized-pages ragged paged attention (Pallas TPU kernel).
+
+The serving KV pool persists int8 / fp8-e4m3 pages with per-(row, head)
+fp32 scales (``inference/paged.py``).  The full-width Pallas kernel the
+paged path was written against (``jax.experimental.pallas.ops.tpu.
+ragged_paged_attention``) reads float pages, so a quantized pool used to
+be dequantized into a transient ``[P, page, 2*Hkv, D]`` float operand
+every attention call — the capacity win was real but the bandwidth win
+was negative.  This kernel removes that: it streams the 1-byte pages and
+their scale rows straight from the pool and dequantizes ONE page tile at
+a time in registers (VMEM), so HBM traffic per attended token is the
+quantized byte count, never the full-width pool.
+
+Layout contract (shared with :func:`~deepspeed_tpu.inference.paged.
+ref_paged_attention`): pages are ``[num_pages, page_size, 2*Hkv, D]``
+with K at even combined-head indices and V at odd; ``scales`` is the
+matching ``[num_pages, page_size, 2*Hkv]`` fp32 buffer; ``page_indices``
+pads unused entries with -1; ``kv_lens`` includes the current tick's
+tokens.
+
+Grid: ``(num_seq_slots, pages_per_seq)`` with the page dim minor, so the
+streaming-softmax accumulators (m, l, acc) carry across one sequence's
+pages in VMEM scratch.  The ragged metadata rides scalar prefetch
+(:class:`~jax.experimental.pallas.tpu.PrefetchScalarGridSpec`): page ids
+feed the page/scale BlockSpec index maps, so the DMA engine fetches only
+attended pages.  The output block is constant-indexed and revisited —
+each sequence's programs write only their own query rows at their last
+page step.
+
+Head dim must be 128 (the MXU lane width, same constraint as the
+full-width kernel).  ``interpret=True`` runs the identical kernel through
+the Pallas interpreter — that is how tier-1 covers this file on the CPU
+container (``tests/unit/inference/test_paged_quant.py`` parity suite).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# same mask value family as ops/flash_attention.py: large enough to
+# vanish under softmax, small enough that (mask - mask) stays exact 0
+_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+
+def _quant_kernel(kv_lens_ref, pi_ref, cu_ref, ns_ref,   # scalar prefetch
+                  q_ref, pages_ref, scales_ref, o_ref,
+                  acc_sc, m_sc, l_sc, *, page: int, groups: int,
+                  sliding_window: Optional[int]):
+    i = pl.program_id(0)                   # sequence slot
+    j = pl.program_id(1)                   # page ordinal within the slot
+    pp = pl.num_programs(1)
+
+    @pl.when(jnp.logical_and(i == 0, j == 0))
+    def _zero_out():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    @pl.when(j == 0)
+    def _reset_seq():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+        m_sc[...] = jnp.full_like(m_sc, _MASK_VALUE)
+        l_sc[...] = jnp.zeros_like(l_sc)
+
+    q0 = cu_ref[i]
+    q1 = cu_ref[i + 1]
+    kvl = kv_lens_ref[i]
+    live = jnp.logical_and(i < ns_ref[0], q1 > q0)
+    in_range = j * page < kvl              # page j holds attended rows
+
+    @pl.when(jnp.logical_and(live, in_range))
+    def _tile():
+        T, H, D = q_ref.shape
+        Hkv = pages_ref.shape[2] // 2
+        # dequantize THIS page tile only, in registers: 1-byte rows and
+        # one fp32 scale per (row, combined head)
+        tile = pages_ref[0].astype(jnp.float32)          # [page, 2Hkv, D]
+        kvf = tile * scales_ref[0][..., None]
+        kvf = kvf.reshape(page, Hkv, 2, D)
+        k = kvf[:, :, 0, :]                              # [page, Hkv, D]
+        v = kvf[:, :, 1, :]
+
+        qf = q_ref[...].astype(jnp.float32)              # pre-scaled
+        qg = qf.reshape(T, Hkv, groups, D)
+        att = jnp.einsum("thgd,phd->thgp", qg, k,
+                         preferred_element_type=jnp.float32)
+
+        t_idx = jax.lax.broadcasted_iota(jnp.int32, (T, page), 0)
+        kv_idx = jax.lax.broadcasted_iota(jnp.int32, (T, page), 1) + \
+            j * page
+        q_pos = kvl - (q1 - q0) + (t_idx - q0)           # abs position
+        mask = ((t_idx >= q0) & (t_idx < q1) &
+                (kv_idx <= q_pos) & (kv_idx < kvl))
+        if sliding_window is not None:
+            mask = mask & (kv_idx > q_pos - sliding_window)
+        att = jnp.where(mask[:, None, None, :], att, _MASK_VALUE)
+
+        att2 = att.reshape(T, Hkv * groups, page)        # [T, H, page]
+        curr_m = jnp.max(att2, axis=-1)                  # [T, H]
+        m_new = jnp.maximum(m_sc[...], curr_m)
+        alpha = jnp.exp(m_sc[...] - m_new)
+        p = jnp.exp(att2 - m_new[..., None])             # [T, H, page]
+        pv = jnp.einsum("thgp,phd->thgd",
+                        p.reshape(T, Hkv, groups, page), v,
+                        preferred_element_type=jnp.float32)
+        acc_sc[...] = (acc_sc[...] * alpha[..., None] +
+                       pv.reshape(T, Hkv * groups, D))
+        l_sc[...] = l_sc[...] * alpha + jnp.sum(p, axis=-1)
+        m_sc[...] = m_new
+
+    @pl.when(jnp.logical_and(live, j == pp - 1))
+    def _finalize():
+        T = q_ref.shape[0]
+        l = jnp.maximum(l_sc[...], 1e-30)
+        # rows that never matched a key keep 0 (the engine's padding
+        # rows and other sequences' rows are written by their own
+        # programs or stay at the j==0 zero fill)
+        valid = m_sc[...] > _MASK_VALUE * 0.5            # [T, H]
+        val = jnp.where(valid[..., None], acc_sc[...] / l[..., None], 0.0)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (T,), 0)
+        mine = jnp.logical_and(rows >= q0, rows < q1)    # [T]
+        o_ref[...] = jnp.where(mine[:, None, None],
+                               val.astype(o_ref.dtype), o_ref[...])
+
+
+def ragged_paged_attention_quant(
+        q: jax.Array, pages: jax.Array, scales: jax.Array,
+        kv_lens: jax.Array, page_indices: jax.Array,
+        cu_q_lens: jax.Array, num_seqs: jax.Array, *, sm_scale: float,
+        sliding_window: Optional[int] = None,
+        interpret: bool = False) -> jax.Array:
+    """Ragged paged attention over a QUANTIZED page pool.
+
+    q: ``[T, H, D]`` float; pages: ``[P, page, 2*Hkv, D]`` int8 or
+    fp8_e4m3; scales: ``[P, page, 2*Hkv]`` fp32; metadata as the
+    full-width kernel (``page_indices`` may pad with -1).  Returns
+    ``[T, H, D]`` in ``q.dtype``.  D must be 128 — the kernel contract
+    it shares with the full-width vLLM-TPU kernel; other head dims use
+    :func:`~deepspeed_tpu.inference.paged.ref_paged_attention_quant`.
+    """
+    T, H, D = q.shape
+    P, page, combined, _ = pages.shape
+    Hkv = combined // 2
+    S, pp = page_indices.shape
+    assert D == 128, (
+        f"ragged_paged_attention_quant requires head_dim 128, got {D} "
+        "(use ref_paged_attention_quant for other dims)")
+    assert H % Hkv == 0, (H, Hkv)
+    groups = H // Hkv
+    assert pages.dtype in (jnp.int8, jnp.float8_e4m3fn), pages.dtype
+
+    # fold sm_scale into q host-side (one mul per q element, exactly as
+    # ops/flash_attention.py) and pad q rows to the f32 sublane multiple
+    qf = q.astype(jnp.float32) * jnp.float32(sm_scale)
+    Tp = (T + 7) // 8 * 8
+    if Tp != T:
+        qf = jnp.pad(qf, ((0, Tp - T), (0, 0), (0, 0)))
+
+    # -1 page pads clamp to the trash page; their rows sit past kv_len
+    # and mask out in-kernel
+    safe_pi = jnp.maximum(page_indices, 0).astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(S, pp),
+        in_specs=[
+            pl.BlockSpec((Tp, H, D), lambda i, j, *refs: (0, 0, 0)),
+            pl.BlockSpec((1, page, combined, D),
+                         lambda i, j, kvl, pi, cu, ns: (pi[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, page, combined),
+                         lambda i, j, kvl, pi, cu, ns: (pi[i, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((Tp, H, D), lambda i, j, *refs: (0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Tp, H, D), jnp.float32),
+            pltpu.VMEM((Tp, H), jnp.float32),
+            pltpu.VMEM((Tp, H), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_quant_kernel, page=page, groups=groups,
+                          sliding_window=sliding_window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Tp, H, D), q.dtype),
+        interpret=interpret,
+    )(kv_lens.astype(jnp.int32), safe_pi, cu_q_lens.astype(jnp.int32),
+      num_seqs.astype(jnp.int32), qf, pages, scales)
+    return out[:T]

@@ -48,6 +48,12 @@ def main() -> None:
                    help="NVMe tier budget in KV pages (0 = host only)")
     p.add_argument("--kv-nvme-dir", default=None,
                    help="directory for NVMe tier page files")
+    p.add_argument("--kv-cache-dtype",
+                   choices=["none", "int8", "fp8"], default="none",
+                   help="store KV pages quantized (1 byte/elem + per-"
+                        "row fp32 scales): ~4x the resident sessions "
+                        "per HBM byte, attention reads the quantized "
+                        "pages directly (no full-pool dequant)")
     p.add_argument("--prefix-cache", action="store_true",
                    help="share identical token prefixes across "
                         "requests: matched KV pages attach read-only "
@@ -87,7 +93,8 @@ def main() -> None:
         pipeline=not args.no_pipeline,
         harvest_interval=args.harvest_interval,
         speculation={"mode": args.spec_mode, "k": args.spec_k},
-        kv_tiering=tiering, prefix_cache=args.prefix_cache, **spec_kw)
+        kv_cache_dtype=args.kv_cache_dtype, kv_tiering=tiering,
+        prefix_cache=args.prefix_cache, **spec_kw)
 
     # a burst of variable-length "requests"; with --prefix-cache they
     # share a common system prompt so later admissions hit the index
@@ -129,6 +136,13 @@ def main() -> None:
                        ("spills", "restores", "pages_spilled",
                         "pages_restored", "pages_verified", "demotions",
                         "nvme_spills", "prefetch_hits")))
+    kq = stages.get("kv_quant")
+    if kq:
+        print("kv quant: " +
+              " ".join(f"{k}={kq[k]}" for k in
+                       ("format", "dequant_path", "pool_bytes",
+                        "payload_bytes", "scale_bytes",
+                        "scale_rows_written")))
     pc = stages.get("prefix_cache")
     if pc:
         rl = engine.request_latency.summary()

@@ -27,6 +27,17 @@ bit-identical to the cache-off reference, must actually HIT the index
 ``audit_kv_sharing()`` (per-page refcount conservation over slots,
 index entries, and spill-holds) must hold after the drain.
 
+With ``--kv-quant`` it additionally gates the quantized paged-KV pool:
+the pool must really be quantized (1-byte payload pages plus fp32 scale
+rows — the gate is vacuous otherwise, enforced against a full-width
+control at <=0.5x the bytes), quantized greedy decode must be
+deterministic and bit-identical across tiering on/off (spilled pages
+carry the quantized payload, digest-verified), the refcount audit must
+hold after the drain, and a teacher-forced lockstep against the
+full-width pool must stay inside the measured quality envelope
+(per-tick greedy divergence and logit error — quantization is a
+bounded approximation, not a different model).
+
 With ``--trace`` it additionally gates the unified tracer: a serving
 run with ``DSTPU_TRACE``-style tracing enabled must export a
 schema-valid Chrome trace carrying both serving-stage spans and
@@ -37,6 +48,7 @@ tracer-off (min of 3 runs each) — tracing is observability, not a tax.
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py [--tokens 250]
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --kv-tiering
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --prefix-cache
+    JAX_PLATFORMS=cpu python scripts/serve_smoke.py --kv-quant
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --trace
 """
 import argparse
@@ -62,6 +74,11 @@ def main() -> int:
                    help="also gate the cross-request prefix cache "
                         "(shared-prompt parity vs cache-off, nonzero "
                         "hit rate, refcount-audit conservation)")
+    p.add_argument("--kv-quant", action="store_true",
+                   help="also gate the quantized paged-KV pool "
+                        "(1-byte pages + scales, deterministic, "
+                        "tiering parity over quantized bytes, "
+                        "teacher-forced quality envelope)")
     p.add_argument("--trace", action="store_true",
                    help="also gate the unified tracer (schema-valid "
                         "Chrome-trace export, request latency "
@@ -236,6 +253,162 @@ def main() -> int:
               f"prefill_computed={rl['prefill_computed_tokens']} "
               f"prefill_cached={rl['prefill_cached_tokens']}")
         p_eng.close()
+    if args.kv_quant:
+        import dataclasses
+
+        from deepspeed_tpu.inference.common import unroll_scan_params
+
+        kq_kw = dict(max_seqs=4, page_size=16, num_pages=9,
+                     prefill_chunk=16, decode_block_size=4)
+        kq_prompts = [rng.integers(1, 64, size=(n,), dtype=np.int32)
+                      for n in (12, 20, 9, 16)]
+
+        def kq_run(fmt, tiering=None):
+            eng = RaggedInferenceEngineV2(
+                LlamaForCausalLM(cfg), params=params, max_seq_len=128,
+                kv_cache_dtype=fmt, kv_tiering=tiering,
+                rng=jax.random.PRNGKey(args.seed), **kq_kw)
+            outs = eng.generate_all(list(kq_prompts), max_new_tokens=40)
+            return outs, eng
+
+        q_a, q_eng = kq_run("int8")
+        _, f_eng = kq_run("none")
+        leaves = jax.tree_util.tree_leaves(q_eng.cache)
+        payload = [lf for lf in leaves
+                   if np.dtype(lf.dtype).itemsize == 1]
+        scales = [lf for lf in leaves
+                  if np.dtype(lf.dtype).itemsize != 1]
+        if not payload or not scales:
+            print("FAIL [kv-quant]: pool is not quantized "
+                  f"({len(payload)} payload / {len(scales)} scale "
+                  "leaves) — the gate ran vacuously")
+            failures += 1
+        bytes_ratio = q_eng.cache_bytes() / max(f_eng.cache_bytes(), 1)
+        if not bytes_ratio <= 0.5:
+            print("FAIL [kv-quant]: quantized pool is "
+                  f"{bytes_ratio:.3f}x the full-width pool's bytes — "
+                  "expected <=0.5x at the same page count")
+            failures += 1
+        kq = q_eng.serving_stages().get("kv_quant") or {}
+        if kq.get("format") != "int8" or not kq.get(
+                "scale_rows_written", 0) > 0:
+            print(f"FAIL [kv-quant]: kv_quant stats block missing or "
+                  f"unwritten ({kq})")
+            failures += 1
+        q_b, _ = kq_run("int8")
+        det = sorted(q_a) == sorted(q_b) and all(
+            np.array_equal(q_a[u], q_b[u]) for u in q_a)
+        if not det:
+            print("FAIL [kv-quant]: quantized greedy decode is not "
+                  "deterministic across identical runs")
+            failures += 1
+        t_on, qt_eng = kq_run("int8", {"host_pages": 64})
+        st = qt_eng.tiering.stats()
+        tier_ok = sorted(t_on) == sorted(q_a) and all(
+            np.array_equal(t_on[u], q_a[u]) for u in q_a)
+        if not tier_ok:
+            print("FAIL [kv-quant]: tiering-on quantized output "
+                  "diverged — spill/restore must carry the quantized "
+                  "payload byte-identically")
+            failures += 1
+        if not st["spills"] > 0:
+            print("FAIL [kv-quant]: no spill traffic under the "
+                  f"quantized pool — the tier leg ran vacuously ({st})")
+            failures += 1
+        if st["pages_verified"] != st["pages_restored"]:
+            print("FAIL [kv-quant]: unverified quantized restore: "
+                  f"{st['pages_restored']} restored, "
+                  f"{st['pages_verified']} verified")
+            failures += 1
+        try:
+            qt_eng.audit_kv_sharing()
+        except AssertionError as e:
+            print(f"FAIL [kv-quant]: refcount audit failed: {e}")
+            failures += 1
+        qt_eng.close()
+
+        # teacher-forced lockstep vs the full-width pool: both pools
+        # replay the SAME token stream, so per-tick logit error and
+        # greedy divergence measure quantization alone (no trajectory
+        # compounding).  The envelope is generous against the measured
+        # smoke numbers (bench kv_quant: ~2.5% divergence, max err
+        # ~0.06 for int8) — this is a broken-kernel tripwire, not a
+        # quality benchmark.
+        lk_page, lk_len = 16, 64
+        pp_q = lk_len // lk_page
+
+        def lk_mk(fmt):
+            pcfg = dataclasses.replace(
+                cfg, decode=True, ragged_decode=False, paged_decode=True,
+                max_cache_len=lk_len, scan_layers=False,
+                kv_page_size=lk_page, kv_num_pages=pp_q + 1,
+                tensor_parallel=False, kv_cache_dtype=fmt)
+            pmodel = LlamaForCausalLM(pcfg)
+
+            @jax.jit
+            def tick(cache, tok, pos):
+                meta = {"kv_lens": (pos + 1)[None].astype(jnp.int32),
+                        "page_indices": jnp.arange(
+                            1, pp_q + 1, dtype=jnp.int32)[None],
+                        "cu_q_lens": jnp.asarray([0, 1], jnp.int32),
+                        "num_seqs": jnp.asarray([1], jnp.int32),
+                        "new_kv_dest": (lk_page + pos)[None].astype(
+                            jnp.int32)}
+                pp = params["params"] if "params" in params else params
+                if getattr(cfg, "scan_layers", False):
+                    pp = unroll_scan_params(pp)
+                out, mut = pmodel.apply(
+                    {"params": pp, "cache": cache}, tok[None, None],
+                    positions=pos[None, None], ragged_meta=meta,
+                    mutable=["cache"])
+                logits = out[0] if isinstance(out, tuple) else out
+                return logits[0, 0], mut["cache"]
+
+            meta0 = {"kv_lens": np.zeros((1,), np.int32),
+                     "page_indices": np.full((1, pp_q), -1, np.int32),
+                     "cu_q_lens": np.zeros((2,), np.int32),
+                     "num_seqs": np.zeros((1,), np.int32),
+                     "new_kv_dest": np.zeros((1,), np.int32)}
+            shapes = jax.eval_shape(lambda: pmodel.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32),
+                positions=jnp.zeros((1, 1), jnp.int32),
+                ragged_meta=meta0))
+            zero = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+            return tick, zero
+
+        f_tick, f_cache = lk_mk("none")
+        q_tick, q_cache = lk_mk("int8")
+        prompt = rng.integers(1, 64, size=(8,), dtype=np.int32)
+        max_err, diverged, compared, tok = 0.0, 0, 0, None
+        for pos in range(lk_len - 1):
+            t_in = (jnp.asarray(prompt[pos], jnp.int32)
+                    if pos < len(prompt) else tok)
+            p_in = jnp.asarray(pos, jnp.int32)
+            fl, f_cache = f_tick(f_cache, t_in, p_in)
+            ql, q_cache = q_tick(q_cache, t_in, p_in)
+            max_err = max(max_err, float(jnp.max(jnp.abs(fl - ql))))
+            if pos >= len(prompt) - 1:
+                compared += 1
+                diverged += int(int(jnp.argmax(fl)) !=
+                                int(jnp.argmax(ql)))
+                tok = jnp.argmax(fl).astype(jnp.int32)
+        div_rate = diverged / max(compared, 1)
+        if not np.isfinite(max_err) or max_err > 1.0:
+            print(f"FAIL [kv-quant]: lockstep logit error {max_err} "
+                  "out of envelope (<=1.0) — dequant path is broken, "
+                  "not merely approximate")
+            failures += 1
+        if div_rate > 0.25:
+            print(f"FAIL [kv-quant]: teacher-forced greedy divergence "
+                  f"{div_rate:.3f} over {compared} ticks exceeds the "
+                  "0.25 envelope")
+            failures += 1
+        print(f"[kv-quant] det={det} tier_ok={tier_ok} "
+              f"bytes_ratio={bytes_ratio:.3f} spills={st['spills']} "
+              f"verified={st['pages_verified']}/{st['pages_restored']} "
+              f"lockstep_max_err={max_err:.4f} "
+              f"divergence={div_rate:.3f}/{compared}t")
     if args.trace:
         import tempfile
         import time
@@ -304,6 +477,8 @@ def main() -> int:
            if args.kv_tiering else "") +
           (", prefix cache exact with nonzero hit rate and clean "
            "refcount audit" if args.prefix_cache else "") +
+          (", quantized pool deterministic, tier-exact, inside the "
+           "quality envelope" if args.kv_quant else "") +
           (", trace export valid within overhead budget"
            if args.trace else ""))
     return 0
