@@ -674,9 +674,36 @@ def bench_ragged(args) -> None:
     decode_block = 8
     import os
 
+    # fresh metrics registry so the request/stage histograms cover
+    # exactly the base run (the nearest-rank cross-check below compares
+    # against this engine's tracker, not a process-lifetime blur)
+    from deepspeed_tpu.telemetry.metrics import metrics as _registry
+    _registry.reset()
+    _registry.configure(enabled=True)
     gen_tokens, dispatches, wall, dev_s, base_eng = _ragged_run(
         model, {"params": params}, decode_block=decode_block, **run_kw)
     serving_stages = base_eng.serving_stages()
+    # histogram-derived latency percentiles (linear interpolation inside
+    # the crossing exponential bucket) next to the tracker's exact
+    # nearest-rank values; `agrees` flags the one-bucket-width contract
+    # serve_smoke --metrics hard-gates
+    hist_latency = {}
+    for _mname in ("ttft_ms", "tpot_ms", "queue_wait_ms"):
+        _fam = _registry.get(f"dstpu_request_{_mname}")
+        if _fam is None:
+            continue
+        _child = _fam.labels()
+        _entry = {"count": _child.merged()[2]}
+        for _q in (50, 99):
+            _hq = _child.quantile(_q)
+            _nr = serving_stages["requests"].get(f"{_mname}_p{_q}")
+            _entry[f"p{_q}"] = round(_hq, 3) if _hq is not None else None
+            if _hq is not None and _nr is not None:
+                _tol = max(_child.bucket_width_at(_nr),
+                           _child.bucket_width_at(_hq)) + 1e-9
+                _entry[f"p{_q}_agrees_nearest_rank"] = bool(
+                    abs(_hq - _nr) <= _tol)
+        hist_latency[_mname] = _entry
     n_chips = len(jax.devices())
     best_s = dev_s if dev_s else wall
     detail = {"requests": int(n_req), "max_seqs": max_seqs,
@@ -726,6 +753,7 @@ def bench_ragged(args) -> None:
     # lives in scripts/serve_smoke.py --trace (min-of-3); the bench row
     # records the single-run delta alongside it.
     detail["request_latency"] = dict(serving_stages["requests"])
+    detail["request_latency"]["histogram"] = hist_latency
     from deepspeed_tpu import telemetry
     # back-to-back off/on pairs (the base run above warms process-wide
     # caches the later runs inherit — comparing against it would
@@ -913,6 +941,7 @@ def bench_ragged(args) -> None:
         "off_control": {"wall_tokens_per_sec": round(base_wall_tps, 1),
                         "tokens_per_dispatch": round(
                             gen_tokens / max(dispatches, 1), 1)}}
+    from deepspeed_tpu.telemetry import profiler as _prof
     for sname, skw in spec_runs.items():
         st_, sd_, swall, sdev, seng = _ragged_run(
             model, {"params": params}, decode_block=decode_block,
@@ -922,12 +951,31 @@ def bench_ragged(args) -> None:
         if brk:
             brk["tokens_per_target_pass"] = round(
                 1.0 + brk["mean_accepted_len"], 3)
+        # host-vs-device attribution (PR 6's recorded blind spot): the
+        # jit closures are named, so the XPlane trace _ragged_run just
+        # wrote splits device seconds per program — where does the
+        # draft/verify tick actually spend its accelerator time?
+        progs = _prof.device_seconds_by_program(
+            "/tmp/dstpu_bench_ragged_trace")
+        split = {k: (round(v, 4) if isinstance(v, float) else v)
+                 for k, v in _prof.split_host_device(
+                     swall, sdev if sdev else None).items()}
+        split.update({
+            "draft_device_s": round(_prof.device_seconds_matching(
+                progs, "draft"), 4),
+            "verify_device_s": round(_prof.device_seconds_matching(
+                progs, "spec_verify"), 4),
+            "decode_device_s": round(_prof.device_seconds_matching(
+                progs, "ragged_decode_block"), 4),
+            "prefill_device_s": round(_prof.device_seconds_matching(
+                progs, "ragged_fused_step"), 4)})
         detail["speculation"][sname] = {
             "wall_tokens_per_sec": round(st_ / swall, 1),
             "tokens_per_sec": round(st_ / (sdev if sdev else swall), 1),
             "speedup_vs_off_wall": round((st_ / swall) /
                                          max(base_wall_tps, 1e-9), 3),
             "dispatches": sd_,
+            "host_device_split": split,
             "breakdown": brk}
 
     # decode-block sweep: on-device sampling makes larger K nearly free

@@ -81,7 +81,13 @@ class CollectiveWatchdog:
                 "mid-collective, or the transport wedged); "
                 "resilience.comm.collective_timeout_s bounds this wait")
             from deepspeed_tpu.telemetry import flight
+            from deepspeed_tpu.telemetry.metrics import metrics as _metrics
 
+            if _metrics.enabled:
+                _metrics.counter(
+                    "dstpu_watchdog_timeouts_total",
+                    "Collective watchdog deadline fires",
+                    labels=("what",)).labels(what=what).inc()
             flight.dump_on_fault("collective_timeout", err,
                                  extra={"what": what,
                                         "deadline_s": self.deadline_s})

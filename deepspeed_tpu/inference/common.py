@@ -9,6 +9,7 @@ import jax
 import numpy as np
 
 from deepspeed_tpu.telemetry import trace
+from deepspeed_tpu.telemetry.metrics import metrics as _metrics
 
 
 class HostStageStats:
@@ -56,6 +57,8 @@ class HostStageStats:
               "verify", "spill", "restore", "prefix")
 
     def __init__(self):
+        self._hists: Dict[str, Any] = {}
+        self._hist_fam = None
         self.reset()
 
     def reset(self) -> None:
@@ -85,6 +88,22 @@ class HostStageStats:
             self.seconds[name] += dt
             if trace.enabled:
                 trace.add_complete(name, t0, dt, cat="serving")
+            if _metrics.enabled:
+                self._stage_hist(name).observe(dt)
+
+    def _stage_hist(self, name: str):
+        """Cached registry child for this stage (lookup once, then a
+        plain attribute read per bracket)."""
+        h = self._hists.get(name)
+        if h is None or self._hist_fam is not _metrics.get(
+                "dstpu_serving_stage_seconds"):
+            self._hist_fam = _metrics.histogram(
+                "dstpu_serving_stage_seconds",
+                "Serving host-path stage bracket durations (s)",
+                labels=("stage",))
+            h = self._hist_fam.labels(stage=name)
+            self._hists[name] = h
+        return h
 
     def serving_stages(self) -> Dict[str, Any]:
         d = max(self.dispatches, 1)

@@ -9,7 +9,7 @@ so there is nothing to inject.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Union
+from typing import Any, Dict, List, Optional, Union
 
 from pydantic import Field, model_validator
 
@@ -184,6 +184,14 @@ class InferenceV2Config(ConfigModel):
     kv_tiering: KVTieringConfig = Field(default_factory=KVTieringConfig)
     prefix_cache: PrefixCacheConfig = Field(
         default_factory=PrefixCacheConfig)
+    # SLO objectives ("ttft_ms_p99 <= 150"-style strings) fed at reap
+    # time; serving_stages()["slo"] reports the rolling budget burn.
+    # Empty = no objectives.
+    slo: List[str] = Field(default_factory=list)
+    # Tail-based trace sampling 1-in-N (0 = off unless the env var
+    # DSTPU_TRACE_SAMPLE arms it); breaching/erroring requests always
+    # promote when sampling is armed.
+    trace_sample: int = 0
 
     @model_validator(mode="after")
     def _positive(self):
@@ -195,6 +203,11 @@ class InferenceV2Config(ConfigModel):
             raise ValueError(
                 "kv_cache_dtype must be none|int8|fp8|fp8_e4m3, got "
                 f"{self.kv_cache_dtype!r}")
+        if self.trace_sample < 0:
+            raise ValueError("trace_sample must be >= 0")
+        from deepspeed_tpu.telemetry.slo import parse_objective
+        for spec in self.slo:
+            parse_objective(spec)      # raises ValueError on a bad spec
         return self
 
 

@@ -533,6 +533,16 @@ class TieredKVStore:
         out["resident_spilled_sessions"] = len(self._entries)
         out["host_pages_used"] = self._host_used
         out["nvme_pages_used"] = self._nvme_used
+        from deepspeed_tpu.telemetry.metrics import metrics as _metrics
+        _metrics.sync_counters(
+            "dstpu_kv_tiering_", self.counters,
+            help="Tiered paged-KV store counters (cumulative)")
+        if _metrics.enabled:
+            g = _metrics.gauge("dstpu_kv_tiering_pages_used",
+                               "Spilled pages resident per tier",
+                               labels=("tier",))
+            g.labels(tier="host").set(self._host_used)
+            g.labels(tier="nvme").set(self._nvme_used)
         return out
 
     def close(self) -> None:

@@ -233,6 +233,8 @@ class RaggedInferenceEngineV2:
                  draft_kv_cache_dtype: Optional[str] = None,
                  kv_tiering: Any = None,
                  prefix_cache: Any = None,
+                 slo: Any = None,
+                 trace_sample: Optional[int] = None,
                  config: Any = None):
         """``kv_cache_dtype``: ``None`` (config subtree
         ``v2.kv_cache_dtype`` decides; "none" by default) | "none" |
@@ -293,7 +295,18 @@ class RaggedInferenceEngineV2:
         copy-on-writes.  Greedy outputs are bit-identical to
         cache-off, and seeded sampling too, because sampling keys are
         position-keyed (:func:`~deepspeed_tpu.inference.sampling.position_keys`)
-        rather than drawn from a dispatch-ordered stream."""
+        rather than drawn from a dispatch-ordered stream.
+        ``slo``: ``None`` (config subtree ``v2.slo`` decides; off by
+        default), a list of objective strings like
+        ``"ttft_ms_p99 <= 150"``, or a prebuilt
+        :class:`~deepspeed_tpu.telemetry.slo.SLOSet` — every reaped
+        request feeds its summary record; ``serving_stages()["slo"]``
+        carries the rolling error-budget burn per objective.
+        ``trace_sample``: tail-based trace sampling N (kwarg > config
+        ``v2.trace_sample`` > env ``DSTPU_TRACE_SAMPLE``).  When the
+        tracer's sampling mode is armed, a reaped request's spans are
+        promoted to the retained ring only on SLO breach, error, or a
+        deterministic 1-in-N draw."""
         mcfg = getattr(model, "config", None)
         assert dataclasses.is_dataclass(mcfg) and hasattr(mcfg, "decode"), \
             "ragged engine needs a model-zoo module with a decode config"
@@ -338,6 +351,9 @@ class RaggedInferenceEngineV2:
             kv_cache_dtype = (v2cfg.kv_cache_dtype
                               if kv_cache_dtype is None
                               else kv_cache_dtype)
+            slo = (v2cfg.slo if slo is None else slo)
+            trace_sample = (v2cfg.trace_sample if trace_sample is None
+                            else trace_sample)
         kv_cache_dtype = ("none" if kv_cache_dtype is None
                           else str(kv_cache_dtype))
         assert kv_cache_dtype in ("none", "int8", "fp8", "fp8_e4m3"), (
@@ -394,6 +410,28 @@ class RaggedInferenceEngineV2:
         # per-request lifecycle latency (TTFT/TPOT/queue-wait/spill-
         # stall percentiles) — always on; independent of the tracer
         self.request_latency = RequestLatencyTracker()
+
+        # -- SLO objectives + tail-based trace sampling --
+        # All evaluation happens at reap time on the host — the traced
+        # dispatch path never sees the registry or the sampler, so the
+        # zero-new-compilations guarantee is structural, not incidental.
+        from deepspeed_tpu.telemetry.slo import SLOSet, TailSampler
+
+        if slo is None or slo is False or (isinstance(slo, (list, tuple))
+                                           and not slo):
+            self.slo = None
+        elif isinstance(slo, SLOSet):
+            self.slo = slo
+        else:
+            self.slo = SLOSet(list(slo))
+        n = (int(trace_sample) if trace_sample is not None
+             else trace.sample_n)
+        self._tail_sampler = (TailSampler(n=n)
+                              if (trace.sampling or n > 0) else None)
+        if n > 0 and not trace.sampling:
+            # an explicit engine/config N arms the tracer's sampling
+            # mode the same way DSTPU_TRACE_SAMPLE does
+            trace.configure(enabled=True, sampling=True, sample_n=n)
         # device-resident decode-loop state while the pipeline runs
         # ahead of the host (None <=> host state is authoritative)
         self._dev: Optional[Dict[str, Any]] = None
@@ -785,6 +823,15 @@ class RaggedInferenceEngineV2:
                 kv_dequant_path(int(getattr(self.cfg, "head_dim", 0))),
                 self.num_pages)
         out["requests"] = self.request_latency.summary()
+        if self.slo is not None:
+            out["slo"] = self.slo.flat_summary()
+        from deepspeed_tpu.telemetry.metrics import metrics as _metrics
+        if _metrics.enabled:
+            # flat registry view (histogram p50/p99 + counters) — one
+            # scalar level, the MonitorMaster flattening contract
+            out["metrics"] = _metrics.scalar_summary()
+            if self._tail_sampler is not None:
+                out["trace_sampling"] = dict(self._tail_sampler.counters())
         return out
 
     def close(self) -> None:
@@ -891,6 +938,9 @@ class RaggedInferenceEngineV2:
             sel = jnp.take(logits, sample_rows, axis=0)     # [max_seqs, V]
             return sel, vars_["cache"]
 
+        # distinguishable XLA program name ("jit_ragged_fused_step") so
+        # the profiler bridge can attribute device time per program
+        run.__name__ = run.__qualname__ = "ragged_fused_step"
         self._step_fn = jax.jit(run, donate_argnums=(1,))
         return self._step_fn
 
@@ -973,6 +1023,7 @@ class RaggedInferenceEngineV2:
             # it device-resident across dispatches (no re-upload)
             return cache, last_tok, pos, active, remaining, toks, mask
 
+        run.__name__ = run.__qualname__ = "ragged_decode_block"
         fn = jax.jit(run, donate_argnums=(1,))
         self._decode_block_cache[sampled] = fn
         return fn
@@ -1074,6 +1125,7 @@ class RaggedInferenceEngineV2:
                 positions=positions, mutable=["cache"], ragged_meta=meta)
             return vars_["cache"]
 
+        run.__name__ = run.__qualname__ = "draft_prefill"
         self._draft_prefill = jax.jit(run, donate_argnums=(1,))
         return self._draft_prefill
 
@@ -1319,6 +1371,7 @@ class RaggedInferenceEngineV2:
             return (cache, dcache, hist, last_tok, pos, active,
                     remaining, toks, mask, prop, accd)
 
+        run.__name__ = run.__qualname__ = "spec_verify_block"
         fn = jax.jit(run, donate_argnums=(2, 3, 4))
         self._spec_block_cache[sampled] = fn
         return fn
@@ -2132,6 +2185,7 @@ class RaggedInferenceEngineV2:
             self.waiting.appendleft(req)   # front: it already waited
             self.request_latency.on_restore_stall(
                 req.uid, time.perf_counter() - t_restore0)
+            self.request_latency.on_error(req.uid)
             if trace.enabled:
                 trace.event("request_restore_failed", cat="request",
                             uid=req.uid, page=int(e.page))
@@ -2460,10 +2514,23 @@ class RaggedInferenceEngineV2:
                 self.allocator.free(i)
                 self.page_table[i, :] = -1
                 self._draft_len[i] = 0
-                self.request_latency.on_finish(r.uid)
+                rec = self.request_latency.on_finish(r.uid)
                 if trace.enabled:
                     trace.event("request_reap", cat="request", uid=r.uid,
                                 tokens=len(r.generated))
+                if rec is not None:
+                    breaches = (self.slo.record_request(rec)
+                                if self.slo is not None else [])
+                    if (trace.sampling and trace.enabled
+                            and self._tail_sampler is not None):
+                        keep, why = self._tail_sampler.should_promote(
+                            breached=bool(breaches),
+                            errored=rec["errors"] > 0)
+                        if keep:
+                            if breaches:
+                                why = f"{why}:{','.join(breaches)}"
+                            trace.promote(r.uid, rec["submit_t"],
+                                          rec["finish_t"], reason=why)
 
     # -- introspection ----------------------------------------------------
 

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import csv
 import os
-from typing import List, Tuple
+from typing import Any, List, Tuple
 
 from deepspeed_tpu.utils.logging import logger
 
@@ -225,6 +225,29 @@ class MonitorMaster(Monitor):
                            if isinstance(v, (int, float))]
             elif isinstance(value, (int, float)):
                 events.append((f"Serving/{name}", float(value), step))
+        self.write_events(events)
+
+    def write_metrics(self, registry: Any = None, step: int = 0) -> None:
+        """Surface the telemetry :class:`MetricsRegistry` as
+        ``Metrics/*`` series: counters and gauges by value, histograms
+        as ``_count``/``_sum``/``_p50``/``_p99`` scalars (the registry's
+        ``scalar_summary()`` view).  ``registry`` defaults to the
+        process singleton; a dict is accepted for pre-flattened views.
+        The SLO burn per objective rides along when an ``SLOSet`` is
+        attached to the registry — ``Metrics/slo/<objective>_burn_rate``
+        crossing 1.0 is the page-the-operator signal."""
+        if registry is None:
+            from deepspeed_tpu.telemetry.metrics import metrics as registry
+        summary = (dict(registry) if isinstance(registry, dict)
+                   else registry.scalar_summary())
+        events = [(f"Metrics/{name}", float(value), step)
+                  for name, value in sorted(summary.items())
+                  if isinstance(value, (int, float))]
+        slo = getattr(registry, "slo", None)
+        if slo is not None:
+            events += [(f"Metrics/slo/{k}", float(v), step)
+                       for k, v in sorted(slo.flat_summary().items())
+                       if isinstance(v, (int, float))]
         self.write_events(events)
 
     def write_comm_health(self, straggler_report: dict, step: int) -> None:

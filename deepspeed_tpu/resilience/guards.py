@@ -41,6 +41,12 @@ class SkippedStepGuard:
         self.consecutive = 0
 
     def update(self, overflowed: bool, step: int) -> None:
+        if overflowed:
+            from deepspeed_tpu.telemetry.metrics import metrics as _metrics
+            if _metrics.enabled:
+                _metrics.counter(
+                    "dstpu_skipped_steps_total",
+                    "Optimizer steps skipped on gradient overflow").inc()
         if not overflowed:
             if self.consecutive:
                 logger.info(f"step {step}: finite gradients after "
@@ -56,7 +62,12 @@ class SkippedStepGuard:
                 "resume from the last verified checkpoint "
                 "(resilience.max_consecutive_skips bounds this abort).")
             from deepspeed_tpu.telemetry import flight
+            from deepspeed_tpu.telemetry.metrics import metrics as _metrics
 
+            if _metrics.enabled:
+                _metrics.counter(
+                    "dstpu_gradient_anomalies_total",
+                    "Aborts on consecutive non-finite gradients").inc()
             flight.dump_on_fault("gradient_anomaly", err,
                                  extra={"step": int(step),
                                         "consecutive": self.consecutive})

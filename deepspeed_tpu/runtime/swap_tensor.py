@@ -72,6 +72,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.resilience.guards import SwapCorruptionError
+from deepspeed_tpu.telemetry.metrics import metrics as _registry_metrics
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -1503,6 +1504,9 @@ class NvmeOptimizerSwapper:
             "pipelined": pipelined,
             "sdc": dict(self.sdc_counters),   # cumulative
         }
+        _registry_metrics.sync_counters(
+            "dstpu_sdc_", self.sdc_counters,
+            help="Swap-path SDC defense counters (cumulative)")
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(params), new_leaves)
 
@@ -1627,6 +1631,9 @@ class NvmeOptimizerSwapper:
             if wall > 0 else 0.0,
             "sdc": dict(self.sdc_counters),   # cumulative
         }
+        _registry_metrics.sync_counters(
+            "dstpu_sdc_", self.sdc_counters,
+            help="Swap-path SDC defense counters (cumulative)")
         return jax.tree_util.tree_unflatten(
             jax.tree_util.tree_structure(params), new_leaves)
 

@@ -35,6 +35,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from deepspeed_tpu.telemetry.tracer import trace
+from deepspeed_tpu.telemetry.metrics import metrics as _metrics
 
 __all__ = ["dump_on_fault", "flight_dir", "last_dump_path",
            "read_flight_record"]
@@ -91,6 +92,14 @@ def dump_on_fault(reason: str, exc: Optional[BaseException] = None,
         }
         if extra:
             header["extra"] = extra
+        try:
+            # cumulative counters + SLO state ride along with the span
+            # ring, so a postmortem has the "how long has this been
+            # going on" axis, not just the last few seconds
+            if _metrics.enabled:
+                header["metrics"] = _metrics.export_json()
+        except Exception:
+            pass                # metrics must never break a fault dump
         with open(path, "w", encoding="utf-8") as f:
             f.write(json.dumps(header) + "\n")
             for ev in events:
@@ -130,4 +139,12 @@ def read_flight_record(path: str) -> Tuple[Dict[str, Any],
         raise ValueError(
             f"{path}: event count mismatch (header={header.get('events')} "
             f"end={tail.get('events')} actual={len(events)})")
+    snap = header.get("metrics")
+    if snap is not None:
+        from deepspeed_tpu.telemetry.metrics import validate_metrics_doc
+        problems = validate_metrics_doc(snap)
+        if problems:
+            raise ValueError(
+                f"{path}: bad embedded metrics snapshot: "
+                + "; ".join(problems[:5]))
     return header, events

@@ -1,0 +1,102 @@
+"""jax.profiler bridge: per-program device time from XPlane traces.
+
+PR 6 recorded the blind spot verbatim in the bench row — "draft_ms/
+verify_ms [host brackets — device split needs the profiler]".  The host
+brackets around a pipelined dispatch measure handoff, not execution, so
+the speculation economics (is verify device time the cost, or host
+scheduling?) were unanswerable.  This module closes it: after a run
+profiled with ``jax.profiler.start_trace(dir)``, it reads the newest
+``*.xplane.pb`` and aggregates device-plane event durations *per jitted
+program name* (``jit_<fn.__name__>``) — the engine names its jitted
+closures distinguishably (``ragged_decode_block``, ``spec_verify_block``,
+``draft_prefill``, ...) exactly so this attribution works.
+
+Graceful everywhere: on CPU-only smoke runs there are no device planes
+and :func:`device_seconds_by_program` returns ``{}``; callers render
+``source: None`` instead of fake numbers.  Multi-chip hosts average
+over planes (same convention as bench's aggregate device-seconds
+helper) so one logical dispatch isn't counted once per chip.
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Optional
+
+__all__ = ["device_seconds_by_program", "split_host_device"]
+
+
+def device_seconds_by_program(trace_dir: str, prefix: str = "jit_",
+                              ) -> Dict[str, float]:
+    """``{program_name: device_seconds}`` from the newest xplane under
+    ``trace_dir``.  Prefers TPU planes; falls back to GPU planes, then
+    to any plane carrying ``prefix`` events.  ``{}`` when no profile or
+    no device events exist (never raises)."""
+    try:
+        from jax.profiler import ProfileData
+    except Exception:
+        return {}
+    try:
+        paths = sorted(glob.glob(os.path.join(trace_dir, "**",
+                                              "*.xplane.pb"),
+                                 recursive=True))
+        if not paths:
+            return {}
+        pdata = ProfileData.from_file(paths[-1])
+        planes = list(pdata.planes)
+    except Exception:
+        return {}
+
+    def _collect(selector) -> Dict[str, float]:
+        per_prog: Dict[str, float] = {}
+        n_planes = 0
+        for plane in planes:
+            if not selector(plane.name):
+                continue
+            plane_progs: Dict[str, float] = {}
+            try:
+                for line in plane.lines:
+                    for ev in line.events:
+                        if ev.name.startswith(prefix):
+                            plane_progs[ev.name] = (
+                                plane_progs.get(ev.name, 0.0)
+                                + ev.duration_ns / 1e9)
+            except Exception:
+                continue
+            if plane_progs:
+                n_planes += 1
+                for k, v in plane_progs.items():
+                    per_prog[k] = per_prog.get(k, 0.0) + v
+        if n_planes > 1:              # average over chips, like bench
+            per_prog = {k: v / n_planes for k, v in per_prog.items()}
+        return per_prog
+
+    for sel in (lambda n: "TPU" in n,
+                lambda n: "GPU" in n or "gpu" in n,
+                lambda n: True):
+        out = _collect(sel)
+        if out:
+            return out
+    return {}
+
+
+def device_seconds_matching(progs: Dict[str, float], substr: str) -> float:
+    """Sum device seconds over programs whose name contains ``substr``
+    (XLA may suffix recompiled programs, so exact match is too brittle)."""
+    return sum(v for k, v in progs.items() if substr in k)
+
+
+__all__.append("device_seconds_matching")
+
+
+def split_host_device(host_s: float, device_s: Optional[float]
+                      ) -> Dict[str, Optional[float]]:
+    """Render a host-bracketed interval against its attributed device
+    time.  ``host_other_s`` is the bracket residual (scheduling, Python,
+    transfer setup); negative residuals clamp to 0 — under the pipelined
+    dispatch the host bracket releases before the device finishes, so
+    device > bracket is expected, not an error."""
+    if device_s is None:
+        return {"host_s": host_s, "device_s": None, "host_other_s": None}
+    return {"host_s": host_s, "device_s": device_s,
+            "host_other_s": max(0.0, host_s - device_s)}

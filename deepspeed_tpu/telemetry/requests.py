@@ -35,7 +35,20 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from deepspeed_tpu.telemetry import metrics as _metrics_mod
+
 __all__ = ["RequestLatencyTracker", "percentile"]
+
+# Request-latency histograms (ms buckets).  Families are registered
+# lazily on first observation so an import alone never mutates the
+# registry; children are cached per tracker.
+_HIST_SPECS = {
+    "ttft_ms": "Time to first harvested token (ms)",
+    "tpot_ms": "Per-token decode latency after the first token (ms)",
+    "queue_wait_ms": "Submit to first admission (ms)",
+    "spill_stall_ms": "Restore-bracket stall attributed to the request (ms)",
+    "prefill_ms": "Admission to prefill-complete (ms)",
+}
 
 
 def percentile(values: List[float], q: float) -> Optional[float]:
@@ -50,11 +63,13 @@ def percentile(values: List[float], q: float) -> Optional[float]:
 
 
 class _Rec:
-    __slots__ = ("submit_t", "admit_t", "first_token_t", "last_token_t",
-                 "tokens", "spill_stall_s", "spills", "finish_t",
-                 "prefill_end_t", "prefill_computed", "prefill_cached")
+    __slots__ = ("uid", "submit_t", "admit_t", "first_token_t",
+                 "last_token_t", "tokens", "spill_stall_s", "spills",
+                 "finish_t", "prefill_end_t", "prefill_computed",
+                 "prefill_cached", "errors")
 
-    def __init__(self, submit_t: float):
+    def __init__(self, uid: Any, submit_t: float):
+        self.uid = uid
         self.submit_t = submit_t
         self.admit_t: Optional[float] = None
         self.first_token_t: Optional[float] = None
@@ -66,6 +81,7 @@ class _Rec:
         self.prefill_end_t: Optional[float] = None
         self.prefill_computed = 0
         self.prefill_cached = 0
+        self.errors = 0
 
 
 class RequestLatencyTracker:
@@ -74,17 +90,35 @@ class RequestLatencyTracker:
     PCTS = (50, 90, 99)
 
     def __init__(self, clock: Callable[[], float] = time.perf_counter,
-                 max_completed: int = 4096):
+                 max_completed: int = 4096,
+                 registry: Any = "auto"):
         self.clock = clock
         self._live: Dict[Any, _Rec] = {}
         self._done: deque = deque(maxlen=max_completed)
         self.submitted = 0
         self.finished = 0
+        # "auto": the process registry singleton (respects its enabled
+        # flag); None/False: no metrics feed; else an injected registry.
+        self._registry = registry
+        self._hists: Dict[str, Any] = {}
+
+    def _observe(self, name: str, value_ms: float) -> None:
+        reg = self._registry
+        if reg == "auto":
+            reg = _metrics_mod.metrics
+        if not reg or not reg.enabled:
+            return
+        h = self._hists.get(name)
+        if h is None or h is not reg.get(f"dstpu_request_{name}"):
+            h = reg.histogram(f"dstpu_request_{name}", _HIST_SPECS[name],
+                              buckets=_metrics_mod.MS_BUCKETS)
+            self._hists[name] = h
+        h.observe(value_ms)
 
     # -- lifecycle hooks (called by the engine) --------------------------
 
     def on_submit(self, uid: Any) -> None:
-        self._live[uid] = _Rec(self.clock())
+        self._live[uid] = _Rec(uid, self.clock())
         self.submitted += 1
 
     def on_admit(self, uid: Any) -> None:
@@ -131,15 +165,62 @@ class RequestLatencyTracker:
         if r is not None:
             r.spill_stall_s += float(seconds)
 
-    def on_finish(self, uid: Any) -> None:
+    def on_error(self, uid: Any) -> None:
+        """A recoverable per-request fault (e.g. KV restore failure
+        forcing re-prefill) — feeds the tail sampler's error arm."""
+        r = self._live.get(uid)
+        if r is not None:
+            r.errors += 1
+
+    def on_finish(self, uid: Any) -> Optional[Dict[str, Any]]:
+        """Completes ``uid`` and returns its summary record (the SLO /
+        tail-sampling input) — None if the uid was never submitted."""
         r = self._live.pop(uid, None)
         if r is None:
-            return
+            return None
         r.finish_t = self.clock()
         self._done.append(r)
         self.finished += 1
+        rec = self._rec_summary(r)
+        for name in ("ttft_ms", "tpot_ms", "queue_wait_ms",
+                     "spill_stall_ms", "prefill_ms"):
+            v = rec.get(name)
+            if v is not None:
+                self._observe(name, v)
+        return rec
 
     # -- derived metrics -------------------------------------------------
+
+    @staticmethod
+    def _rec_summary(r: _Rec) -> Dict[str, Any]:
+        """Per-request scalars; fields absent from the lifecycle stay
+        None (``spill_stall_ms`` only exists for requests that actually
+        spilled, matching the ``summary()`` series filters)."""
+        ttft = ((r.first_token_t - r.submit_t) * 1e3
+                if r.first_token_t is not None else None)
+        tpot = ((r.last_token_t - r.first_token_t) * 1e3 / (r.tokens - 1)
+                if r.tokens >= 2 and r.first_token_t is not None else None)
+        return {
+            "uid": r.uid,
+            "submit_t": r.submit_t,
+            "finish_t": r.finish_t,
+            "ttft_ms": ttft,
+            "tpot_ms": tpot,
+            "queue_wait_ms": ((r.admit_t - r.submit_t) * 1e3
+                              if r.admit_t is not None else None),
+            "spill_stall_ms": (r.spill_stall_s * 1e3 if r.spills > 0
+                               else None),
+            "prefill_ms": ((r.prefill_end_t - r.admit_t) * 1e3
+                           if r.prefill_end_t is not None
+                           and r.admit_t is not None else None),
+            "tokens": r.tokens,
+            "spills": r.spills,
+            "errors": r.errors,
+        }
+
+    def completed(self) -> List[Dict[str, Any]]:
+        """Summary records for the retained completed-request window."""
+        return [self._rec_summary(r) for r in self._done]
 
     def summary(self) -> Dict[str, Any]:
         """Flat percentile summary over completed requests (ms)."""

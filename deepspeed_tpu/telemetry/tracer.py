@@ -119,11 +119,23 @@ class Tracer:
     def __init__(self, enabled: bool = False,
                  buffer_size: int = DEFAULT_BUFFER,
                  clock: Callable[[], float] = time.perf_counter,
-                 annotate: bool = False):
+                 annotate: bool = False,
+                 sampling: bool = False,
+                 sample_n: int = 0,
+                 retained_size: int = 4 * DEFAULT_BUFFER):
         self.enabled = bool(enabled)
         self.buffer_size = int(buffer_size)
         self.clock = clock
         self.annotate = bool(annotate)
+        # Tail sampling: record always-on into the per-thread staging
+        # rings, but treat them as scratch — only spans *promoted* (the
+        # request breached an SLO, errored, or fell in the 1-in-N
+        # sample) survive into the bounded retained ring that export()
+        # writes.  ``sample_n`` is the engine-consumed default N.
+        self.sampling = bool(sampling)
+        self.sample_n = int(sample_n)
+        self.retained_size = int(retained_size)
+        self._retained: deque = deque(maxlen=self.retained_size)
         self._epoch = clock()
         self._lock = threading.Lock()
         self._rings: Dict[int, deque] = {}
@@ -136,7 +148,10 @@ class Tracer:
     def configure(self, enabled: Optional[bool] = None,
                   buffer_size: Optional[int] = None,
                   clock: Optional[Callable[[], float]] = None,
-                  annotate: Optional[bool] = None) -> "Tracer":
+                  annotate: Optional[bool] = None,
+                  sampling: Optional[bool] = None,
+                  sample_n: Optional[int] = None,
+                  retained_size: Optional[int] = None) -> "Tracer":
         """Mutate in place (never replace — importers hold references)."""
         with self._lock:
             if clock is not None:
@@ -149,6 +164,15 @@ class Tracer:
                 self._local = threading.local()
             if annotate is not None:
                 self.annotate = bool(annotate)
+            if sampling is not None:
+                self.sampling = bool(sampling)
+            if sample_n is not None:
+                self.sample_n = int(sample_n)
+            if retained_size is not None \
+                    and int(retained_size) != self.retained_size:
+                self.retained_size = int(retained_size)
+                self._retained = deque(self._retained,
+                                       maxlen=self.retained_size)
             if enabled is not None:
                 self.enabled = bool(enabled)
         return self
@@ -222,18 +246,71 @@ class Tracer:
         out.sort(key=lambda e: e.get("ts", 0.0))
         return out
 
+    # -- tail sampling ---------------------------------------------------
+
+    def promote(self, uid: Any, t0: float, t1: float, reason: str = "",
+                slack_s: float = 0.05) -> int:
+        """Copy one request's timeline from the staging rings into the
+        retained ring (tail-based sampling: called at reap time when the
+        request breached an SLO, errored, or won the 1-in-N draw).
+
+        ``t0``/``t1`` are raw clock seconds (the tracker's ``submit_t``
+        / ``finish_t`` — same ``perf_counter`` clock as the tracer);
+        ``slack_s`` widens the window so the reap event recorded just
+        after ``on_finish`` still lands.  Selection keeps every span
+        overlapping the window EXCEPT request-lifecycle events that
+        belong to *other* uids — so a promoted slow request carries the
+        shared serving spans (prefill chunks, decode blocks it rode in)
+        but not its neighbours' lifecycles, and un-promoted fast
+        requests leave no lifecycle marks in the retained ring.
+        Returns the number of events promoted."""
+        t0_us = self._us(t0) - slack_s * 1e6
+        t1_us = self._us(t1) + slack_s * 1e6
+        kept: List[Dict[str, Any]] = []
+        for ev in self.snapshot():
+            ts = ev.get("ts", 0.0)
+            end = ts + ev.get("dur", 0.0)
+            if end < t0_us or ts > t1_us:
+                continue
+            if ev.get("cat") == "request":
+                args = ev.get("args") or {}
+                if args.get("uid") != uid and \
+                        uid not in (args.get("uids") or ()):
+                    continue
+            kept.append(ev)
+        marker = {"ph": "i", "name": "promoted", "cat": "sampling",
+                  "s": "t", "ts": self._us(self.clock()),
+                  "tid": threading.get_ident(),
+                  "args": {"uid": uid, "reason": reason,
+                           "events": len(kept)}}
+        with self._lock:
+            self._retained.extend(kept)
+            self._retained.append(marker)
+        return len(kept)
+
+    def retained_snapshot(self) -> List[Dict[str, Any]]:
+        """Promoted events (ts-sorted) — what export() writes when
+        sampling is armed."""
+        with self._lock:
+            out = list(self._retained)
+        out.sort(key=lambda e: e.get("ts", 0.0))
+        return out
+
     def clear(self) -> None:
         with self._lock:
             self._rings.clear()
             self._thread_names.clear()
             self._local = threading.local()
+            self._retained.clear()
 
     def export(self, path: str) -> str:
         """Write Chrome trace-event JSON (object form) to ``path``.
 
         Opens in https://ui.perfetto.dev / ``chrome://tracing``.  Adds
         process/thread-name metadata events so timeline rows are
-        labelled."""
+        labelled.  With tail sampling armed, only the *promoted*
+        timeline (the retained ring) is written — the staging rings are
+        scratch."""
         pid = os.getpid()
         events: List[Dict[str, Any]] = [{
             "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
@@ -244,7 +321,8 @@ class Tracer:
         for tid, tname in sorted(names.items()):
             events.append({"ph": "M", "name": "thread_name", "pid": pid,
                            "tid": tid, "ts": 0, "args": {"name": tname}})
-        for ev in self.snapshot():
+        body = self.retained_snapshot() if self.sampling else self.snapshot()
+        for ev in body:
             ev = dict(ev)
             ev["pid"] = pid
             events.append(ev)
@@ -265,10 +343,26 @@ def _env_truthy(name: str) -> bool:
                                                         "on")
 
 
+def _env_sample_n() -> Optional[int]:
+    """``DSTPU_TRACE_SAMPLE=N`` arms tail sampling with a 1-in-N random
+    arm (N=0: promote only on SLO breach / error).  Unset: disarmed."""
+    raw = os.environ.get("DSTPU_TRACE_SAMPLE", "").strip()
+    if not raw:
+        return None
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return None
+
+
+_SAMPLE_N = _env_sample_n()
+
 trace = Tracer(
-    enabled=_env_truthy("DSTPU_TRACE"),
+    enabled=_env_truthy("DSTPU_TRACE") or _SAMPLE_N is not None,
     buffer_size=int(os.environ.get("DSTPU_TRACE_BUFFER", DEFAULT_BUFFER)),
     annotate=_env_truthy("DSTPU_TRACE_ANNOTATE"),
+    sampling=_SAMPLE_N is not None,
+    sample_n=_SAMPLE_N or 0,
 )
 
 

@@ -46,6 +46,7 @@ from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from deepspeed_tpu.telemetry import trace
+from deepspeed_tpu.telemetry.metrics import metrics as _metrics
 
 __all__ = ["BoundedAsyncStage", "HostBufferPool", "StageTimers"]
 
@@ -64,6 +65,20 @@ class StageTimers:
         self.seconds: Dict[str, float] = {}
         self.counters: Dict[str, int] = {}
         self.cat = cat
+        self._hists: Dict[str, Any] = {}
+        self._hist_fam = None
+
+    def _hist(self, name: str):
+        h = self._hists.get(name)
+        if h is None or self._hist_fam is not _metrics.get(
+                "dstpu_stage_seconds"):
+            self._hist_fam = _metrics.histogram(
+                "dstpu_stage_seconds",
+                "Async-pipeline stage bracket durations (s)",
+                labels=("cat", "stage"))
+            h = self._hist_fam.labels(cat=self.cat, stage=name)
+            self._hists[name] = h
+        return h
 
     @contextmanager
     def stage(self, name: str):
@@ -75,6 +90,8 @@ class StageTimers:
             self.seconds[name] = self.seconds.get(name, 0.0) + dt
             if trace.enabled:
                 trace.add_complete(name, t0, dt, cat=self.cat)
+            if _metrics.enabled:
+                self._hist(name).observe(dt)
 
     def add(self, name: str, seconds: float) -> None:
         self.seconds[name] = self.seconds.get(name, 0.0) + seconds
@@ -82,6 +99,8 @@ class StageTimers:
             # externally bracketed: anchor at now-dt (approximate start)
             trace.add_complete(name, time.perf_counter() - seconds,
                                seconds, cat=self.cat)
+        if _metrics.enabled:
+            self._hist(name).observe(seconds)
 
     def count(self, name: str, n: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + n

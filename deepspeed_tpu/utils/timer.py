@@ -14,6 +14,7 @@ import time
 from typing import Dict, List, Optional
 
 from deepspeed_tpu.telemetry import trace
+from deepspeed_tpu.telemetry.metrics import metrics as _metrics
 from deepspeed_tpu.utils.logging import logger
 
 FORWARD_MICRO_TIMER = "fwd_microstep"
@@ -44,6 +45,18 @@ class Timer:
         self._elapsed = 0.0
         self._record_count = 0
         self.last_interval = 0.0
+        self._hist = None
+        self._hist_fam = None
+
+    def _observe(self, seconds: float) -> None:
+        if self._hist is None or self._hist_fam is not _metrics.get(
+                "dstpu_engine_seconds"):
+            self._hist_fam = _metrics.histogram(
+                "dstpu_engine_seconds",
+                "Engine wall-clock timer intervals (s)",
+                labels=("timer",))
+            self._hist = self._hist_fam.labels(timer=self.name)
+        self._hist.observe(seconds)
 
     def start(self) -> None:
         assert not self.started, f"timer {self.name} already started"
@@ -64,6 +77,8 @@ class Timer:
         if trace.enabled:
             trace.add_complete(self.name, self._start_time,
                                self.last_interval, cat="engine")
+        if _metrics.enabled:
+            self._observe(self.last_interval)
 
     def discard(self) -> None:
         """Abandon an in-flight interval without recording it (and without
@@ -82,6 +97,8 @@ class Timer:
         if trace.enabled:
             trace.add_complete(self.name, time.perf_counter() - seconds,
                                seconds, cat="engine")
+        if _metrics.enabled:
+            self._observe(seconds)
 
     def reset(self) -> None:
         self.started = False

@@ -45,11 +45,22 @@ request lifecycle events, the engine must surface non-None TTFT/TPOT
 percentiles, and the tracer-on wall clock must stay within 5% of
 tracer-off (min of 3 runs each) — tracing is observability, not a tax.
 
+With ``--metrics`` it additionally gates the metrics/SLO layer: the
+registry's Prometheus exposition must parse line-for-line (labels,
+cumulative bucket monotonicity, ``+Inf`` bucket == ``_count``), the
+histogram-derived TTFT/TPOT p50/p99 must agree with the tracker's
+nearest-rank percentiles within one bucket width, tail-based trace
+sampling must retain a structurally slow request (3 requests over 2
+seats — the queued one's TTFT breaches a calibrated SLO) while
+dropping the fast ones, and metrics+sampling-on wall must stay within
+5% of all-off (min of 3 runs each).
+
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py [--tokens 250]
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --kv-tiering
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --prefix-cache
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --kv-quant
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --trace
+    JAX_PLATFORMS=cpu python scripts/serve_smoke.py --metrics
 """
 import argparse
 import os
@@ -83,6 +94,11 @@ def main() -> int:
                    help="also gate the unified tracer (schema-valid "
                         "Chrome-trace export, request latency "
                         "percentiles, <=5%% tracer-on wall overhead)")
+    p.add_argument("--metrics", action="store_true",
+                   help="also gate the metrics/SLO layer (exposition "
+                        "parses, histogram vs nearest-rank percentile "
+                        "agreement, tail sampling keeps the slow "
+                        "request, <=5%% metrics-on wall overhead)")
     args = p.parse_args()
 
     import jax
@@ -468,6 +484,190 @@ def main() -> int:
         print(f"[trace] events={len(events)} overhead="
               f"{overhead * 100:+.1f}% ttft_p50={req.get('ttft_ms_p50')}ms "
               f"tpot_p50={req.get('tpot_ms_p50')}ms exported={trace_path}")
+    if args.metrics:
+        import re
+        import time
+
+        from deepspeed_tpu import telemetry
+        from deepspeed_tpu.telemetry import metrics as metrics_mod
+
+        reg = metrics_mod.metrics
+        # 3 requests over 2 seats: the queued request's TTFT includes a
+        # full generation of queue wait — structurally slow, no sleeps
+        m_prompts = [rng.integers(1, 64, size=(n,), dtype=np.int32)
+                     for n in (9, 14, 11)]
+
+        def m_run(**kw):
+            eng = RaggedInferenceEngineV2(
+                LlamaForCausalLM(cfg), params=params, max_seqs=2,
+                max_seq_len=max_len, prefill_chunk=16,
+                decode_block_size=8, speculation="off",
+                rng=jax.random.PRNGKey(args.seed), **kw)
+            outs = eng.generate_all(list(m_prompts), max_new_tokens=60)
+            return outs, eng
+
+        # ---- exposition + percentile agreement (fresh registry) -----
+        reg.reset()
+        reg.configure(enabled=True)
+        _, m_eng = m_run()
+        text = reg.export_text()
+        line_re = re.compile(
+            r'^([a-zA-Z_:][a-zA-Z0-9_:]*)'
+            r'(\{(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*\})?'
+            r' (\+Inf|-?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?))$')
+        lab_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+        series = {}
+        bad_lines = 0
+        for ln in text.splitlines():
+            if not ln or ln.startswith("#"):
+                continue
+            m = line_re.match(ln)
+            if m is None:
+                bad_lines += 1
+                if bad_lines <= 3:
+                    print("FAIL [metrics]: unparseable exposition "
+                          f"line: {ln!r}")
+                continue
+            raw = m.group(3)
+            series[(m.group(1), m.group(2) or "")] = (
+                float("inf") if raw == "+Inf" else float(raw))
+        if bad_lines:
+            failures += 1
+        bucket_runs = {}                # (family, labels-minus-le) -> rows
+        count_vals = {}
+        for (name, labstr), val in series.items():   # dict = file order
+            labs = dict(lab_re.findall(labstr))
+            if name.endswith("_bucket") and "le" in labs:
+                le_raw = labs.pop("le")
+                le = (float("inf") if le_raw == "+Inf" else float(le_raw))
+                key = (name[:-len("_bucket")], tuple(sorted(labs.items())))
+                bucket_runs.setdefault(key, []).append((le, val))
+            elif name.endswith("_count"):
+                count_vals[(name[:-len("_count")],
+                            tuple(sorted(labs.items())))] = val
+        if not bucket_runs or ("dstpu_request_ttft_ms", ()) not in \
+                bucket_runs:
+            print("FAIL [metrics]: no request histograms in the "
+                  "exposition — the gate ran vacuously "
+                  f"({sorted(k[0] for k in bucket_runs)})")
+            failures += 1
+        for key, rows in sorted(bucket_runs.items()):
+            les = [le for le, _v in rows]
+            cums = [v for _le, v in rows]
+            if les != sorted(les) or les[-1] != float("inf"):
+                print(f"FAIL [metrics]: {key[0]}{dict(key[1])} bucket "
+                      f"les not ascending-to-+Inf: {les}")
+                failures += 1
+                continue
+            if any(cums[i] > cums[i + 1] for i in range(len(cums) - 1)):
+                print(f"FAIL [metrics]: {key[0]}{dict(key[1])} bucket "
+                      f"series not cumulative: {cums}")
+                failures += 1
+            if count_vals.get(key) != cums[-1]:
+                print(f"FAIL [metrics]: {key[0]}{dict(key[1])} +Inf "
+                      f"bucket {cums[-1]} != _count "
+                      f"{count_vals.get(key)}")
+                failures += 1
+        probs = metrics_mod.validate_metrics_doc(reg.export_json())
+        if probs:
+            for msg in probs[:5]:
+                print(f"FAIL [metrics]: export_json invalid: {msg}")
+            failures += 1
+        rl = m_eng.request_latency.summary()
+        for mname in ("ttft_ms", "tpot_ms"):
+            fam = reg.get(f"dstpu_request_{mname}")
+            child = fam.labels() if fam is not None else None
+            for q in (50, 99):
+                hq = child.quantile(q) if child is not None else None
+                nr = rl.get(f"{mname}_p{q}")
+                if hq is None or nr is None:
+                    print(f"FAIL [metrics]: {mname} p{q} missing "
+                          f"(histogram={hq} nearest-rank={nr})")
+                    failures += 1
+                    continue
+                tol = max(child.bucket_width_at(nr),
+                          child.bucket_width_at(hq)) + 1e-9
+                if abs(hq - nr) > tol:
+                    print(f"FAIL [metrics]: {mname} p{q} histogram "
+                          f"{hq:.3f} vs nearest-rank {nr:.3f} differ "
+                          f"by more than one bucket width ({tol:.3f})")
+                    failures += 1
+
+        # ---- tail sampling: calibrated SLO keeps slow, drops fast ----
+        comp = m_eng.request_latency.completed()
+        ttfts = sorted((c["ttft_ms"], c["uid"]) for c in comp
+                       if c["ttft_ms"] is not None)
+        slow_ttft, slow_uid = ttfts[-1]
+        fast_max = ttfts[-2][0]
+        fast_uids = {uid for _t, uid in ttfts[:-1]}
+        if not (len(ttfts) == 3 and slow_ttft > 2 * fast_max):
+            print("FAIL [metrics]: queued request is not structurally "
+                  f"slow (ttfts={ttfts}) — the sampling leg would run "
+                  "vacuously")
+            failures += 1
+        thr = (fast_max * slow_ttft) ** 0.5     # geometric midpoint
+        telemetry.trace.clear()
+        telemetry.trace.configure(enabled=True, sampling=True,
+                                  sample_n=0)
+        _, s_eng = m_run(slo=[f"ttft_ms_p99 <= {thr:.6f}"],
+                         trace_sample=0)
+        st = s_eng.serving_stages()
+        ts = st.get("trace_sampling") or {}
+        slo_flat = st.get("slo") or {}
+        retained = telemetry.trace.retained_snapshot()
+        telemetry.trace.configure(enabled=False, sampling=False,
+                                  sample_n=0)
+        telemetry.trace.clear()
+        kept_uids = {ev["args"]["uid"] for ev in retained
+                     if ev.get("cat") == "request"
+                     and isinstance(ev.get("args"), dict)
+                     and "uid" in ev["args"]}
+        if slow_uid not in kept_uids:
+            print("FAIL [metrics]: breaching slow request "
+                  f"uid={slow_uid} not retained (kept={kept_uids}, "
+                  f"sampler={ts})")
+            failures += 1
+        leaked = kept_uids & fast_uids
+        if leaked:
+            print("FAIL [metrics]: fast requests leaked into the "
+                  f"retained ring: {leaked} (sampler={ts})")
+            failures += 1
+        if not ts.get("promoted_breach", 0) >= 1 or \
+                not ts.get("dropped", 0) >= 1:
+            print(f"FAIL [metrics]: sampler counters off ({ts}) — "
+                  "want >=1 breach promotion and >=1 drop")
+            failures += 1
+        if not slo_flat.get("ttft_ms_p99_breaches", 0) >= 1:
+            print(f"FAIL [metrics]: SLO window saw no breach "
+                  f"({slo_flat})")
+            failures += 1
+
+        # ---- overhead: metrics + sampling on vs all off --------------
+        def m_timed(on):
+            reg.configure(enabled=on)
+            telemetry.trace.configure(enabled=on, sampling=on,
+                                      sample_n=1 if on else 0)
+            telemetry.trace.clear()
+            t0 = time.perf_counter()
+            m_run()
+            return time.perf_counter() - t0
+
+        m_off = min(m_timed(False) for _ in range(3))
+        m_on = min(m_timed(True) for _ in range(3))
+        reg.configure(enabled=True)
+        telemetry.trace.configure(enabled=False, sampling=False,
+                                  sample_n=0)
+        telemetry.trace.clear()
+        m_ovh = (m_on - m_off) / m_off
+        if m_ovh > 0.05:
+            print(f"FAIL [metrics]: metrics+sampling-on wall regressed "
+                  f"{m_ovh * 100:.1f}% (off={m_off:.3f}s "
+                  f"on={m_on:.3f}s)")
+            failures += 1
+        print(f"[metrics] series={len(series)} "
+              f"histograms={len(bucket_runs)} "
+              f"slow_uid={slow_uid} kept={sorted(kept_uids)} "
+              f"thr={thr:.1f}ms overhead={m_ovh * 100:+.1f}%")
     if failures:
         print(f"serve_smoke: {failures} failure(s)")
         return 1
@@ -480,7 +680,10 @@ def main() -> int:
           (", quantized pool deterministic, tier-exact, inside the "
            "quality envelope" if args.kv_quant else "") +
           (", trace export valid within overhead budget"
-           if args.trace else ""))
+           if args.trace else "") +
+          (", metrics exposition valid, percentiles agree, tail "
+           "sampling selective within overhead budget"
+           if args.metrics else ""))
     return 0
 
 

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Summarize a Chrome-trace export or a flight-recorder dump.
+"""Summarize a Chrome-trace export, flight dump, or metrics export.
 
 Turns the unified tracer's output (``trace.export(path)`` Chrome-trace
 JSON, loadable in ui.perfetto.dev, or a ``flight_*.jsonl`` postmortem
@@ -12,24 +12,33 @@ dump) into a terminal report:
 - per-request lifecycle: for every ``cat="request"`` uid, the
   submit → admit → prefill → decode → spill/restore → reap event
   sequence with derived queue-wait and first-token timings;
-- ``--validate``: schema gate (used by ``serve_smoke.py --trace``) —
-  exits nonzero on a malformed trace instead of printing a report.
+- ``--metrics``: render a ``MetricsRegistry.export_json()`` document
+  (also autodetected) as per-metric tables — counters/gauges by value,
+  histograms with count/sum/p50/p90/p99;
+- ``--slo``: render only the SLO objective table (window samples,
+  breaches, error rate, budget burn) from a metrics export;
+- ``--validate``: schema gate (used by ``serve_smoke.py --trace`` /
+  ``--metrics``) — exits nonzero on a malformed file instead of
+  printing a report; covers all three formats.
 
 Usage::
 
     python scripts/trace_summarize.py /tmp/serve_trace.json
     python scripts/trace_summarize.py /tmp/dstpu_flight/flight_*.jsonl
-    python scripts/trace_summarize.py --validate trace.json
+    python scripts/trace_summarize.py --metrics /tmp/metrics.json
+    python scripts/trace_summarize.py --slo /tmp/metrics.json
+    python scripts/trace_summarize.py --validate trace.json metrics.json
 """
 import argparse
 import json
 import os
 import sys
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-from deepspeed_tpu.telemetry import percentile, read_flight_record  # noqa: E402
+from deepspeed_tpu.telemetry import (percentile, read_flight_record,  # noqa: E402
+                                     validate_metrics_doc)
 
 # the ph values the tracer emits: complete spans, instants, metadata
 _KNOWN_PH = {"X", "i", "M"}
@@ -54,6 +63,97 @@ def load_events(path: str) -> Tuple[List[Dict[str, Any]], str]:
         raise ValueError(f"{path}: not a Chrome-trace object "
                          "(missing traceEvents)")
     return doc["traceEvents"], "chrome"
+
+
+def _is_trace_file(path: str) -> bool:
+    """Flight dumps and Chrome traces render as traces by default even
+    when they carry an embedded metrics snapshot; bare metrics exports
+    do not."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            first = f.readline()
+        head = json.loads(first)
+    except (OSError, json.JSONDecodeError):
+        return True        # let load_events produce the real error
+    if isinstance(head, dict) and head.get("record") == "metrics":
+        return False
+    return True
+
+
+def load_metrics_doc(path: str) -> Optional[Dict[str, Any]]:
+    """A ``MetricsRegistry.export_json()`` document (or a flight dump's
+    embedded one via ``header["metrics"]``), else None when the file is
+    some other format."""
+    with open(path, "r", encoding="utf-8") as f:
+        first = f.readline()
+    try:
+        head = json.loads(first)
+    except json.JSONDecodeError:
+        head = None
+    if isinstance(head, dict) and head.get("record") == "flight":
+        header, _events = read_flight_record(path)
+        return header.get("metrics")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return None
+    if isinstance(doc, dict) and doc.get("record") == "metrics":
+        return doc
+    return None
+
+
+def print_metrics_report(path: str, doc: Dict[str, Any]) -> None:
+    n = (len(doc.get("counters", [])) + len(doc.get("gauges", []))
+         + len(doc.get("histograms", [])))
+    print(f"{path}: metrics export, {n} series")
+    for kind in ("counters", "gauges"):
+        rows = doc.get(kind, [])
+        if not rows:
+            continue
+        print(f"\n{kind}:")
+        for m in sorted(rows, key=lambda m: (m["name"],
+                                             sorted(m["labels"].items()))):
+            lbl = ",".join(f"{k}={v}" for k, v in
+                           sorted(m["labels"].items()))
+            tag = f"{m['name']}{{{lbl}}}" if lbl else m["name"]
+            print(f"  {tag:<64} {m['value']:>14g}")
+    hists = doc.get("histograms", [])
+    if hists:
+        print(f"\n{'histogram':<56} {'count':>8} {'sum':>12} "
+              f"{'p50':>10} {'p90':>10} {'p99':>10}")
+        for m in sorted(hists, key=lambda m: (m["name"],
+                                              sorted(m["labels"].items()))):
+            lbl = ",".join(f"{k}={v}" for k, v in
+                           sorted(m["labels"].items()))
+            tag = f"{m['name']}{{{lbl}}}" if lbl else m["name"]
+            ps = [("-" if m.get(f"p{q}") is None else
+                   f"{m[f'p{q}']:.4g}") for q in (50, 90, 99)]
+            print(f"  {tag:<54} {m['count']:>8} {m['sum']:>12.4g} "
+                  f"{ps[0]:>10} {ps[1]:>10} {ps[2]:>10}")
+    if doc.get("slo"):
+        print_slo_report(path, doc, header=False)
+
+
+def print_slo_report(path: str, doc: Dict[str, Any],
+                     header: bool = True) -> None:
+    slo = doc.get("slo") or {}
+    if header:
+        print(f"{path}: metrics export, {len(slo)} SLO objective(s)")
+    if not slo:
+        print("\n(no SLO state attached — run the engine with "
+              "slo=[...] objectives)")
+        return
+    print(f"\n{'objective':<26} {'threshold':>10} {'window_s':>9} "
+          f"{'samples':>8} {'breaches':>9} {'err_rate':>9} "
+          f"{'burn':>8}  state")
+    for name in sorted(slo):
+        st = slo[name]
+        state = "ok" if st.get("ok") else "BURNING"
+        print(f"  {name:<24} {st['threshold']:>10g} "
+              f"{st['window_s']:>9g} {st['samples']:>8} "
+              f"{st['breaches']:>9} {st['error_rate']:>9.4f} "
+              f"{st['burn_rate']:>8.3f}  {state}")
 
 
 def validate_events(events: List[Dict[str, Any]]) -> List[str]:
@@ -179,13 +279,53 @@ def print_report(path: str, events: List[Dict[str, Any]],
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("paths", nargs="+",
-                    help="Chrome-trace JSON or flight_*.jsonl dump(s)")
+                    help="Chrome-trace JSON, flight_*.jsonl dump(s), or "
+                         "MetricsRegistry.export_json() file(s)")
     ap.add_argument("--validate", action="store_true",
                     help="schema-check only; exit nonzero on a "
                          "malformed file")
+    ap.add_argument("--metrics", action="store_true",
+                    help="treat paths as metrics exports; render the "
+                         "per-metric tables")
+    ap.add_argument("--slo", action="store_true",
+                    help="treat paths as metrics exports; render only "
+                         "the SLO objective/budget-burn table")
     args = ap.parse_args(argv)
     failures = 0
     for path in args.paths:
+        # metrics exports (and flight dumps under --metrics/--slo, via
+        # their embedded snapshot) route to the metrics renderer
+        doc = None
+        try:
+            doc = load_metrics_doc(path)
+        except (ValueError, OSError):
+            doc = None
+        if args.metrics or args.slo:
+            if doc is None:
+                print(f"FAIL {path}: not a metrics export "
+                      "(want MetricsRegistry.export_json() or a flight "
+                      "dump with an embedded snapshot)")
+                failures += 1
+                continue
+        if doc is not None and (args.metrics or args.slo
+                                or not _is_trace_file(path)):
+            problems = validate_metrics_doc(doc)
+            if problems:
+                for p in problems:
+                    print(f"FAIL {path}: {p}")
+                failures += 1
+                continue
+            if args.validate:
+                nseries = (len(doc.get("counters", []))
+                           + len(doc.get("gauges", []))
+                           + len(doc.get("histograms", [])))
+                print(f"OK {path}: metrics, {nseries} series, "
+                      "schema valid")
+            elif args.slo:
+                print_slo_report(path, doc)
+            else:
+                print_metrics_report(path, doc)
+            continue
         try:
             events, kind = load_events(path)
         except (ValueError, OSError, json.JSONDecodeError) as e:
