@@ -56,10 +56,23 @@ class HostStageStats:
     STAGES = ("plan", "upload", "dispatch", "device", "harvest", "draft",
               "verify", "spill", "restore", "prefix")
 
-    def __init__(self):
+    def __init__(self, replica: str = ""):
+        # scale-out serving runs several engines in one process; the
+        # ``replica`` label keeps their registry children apart (the
+        # solo-engine default is the empty label value, so a process
+        # with one engine exports the same series it always did)
+        self.replica = str(replica)
         self._hists: Dict[str, Any] = {}
         self._hist_fam = None
         self.reset()
+
+    def set_replica(self, replica: str) -> None:
+        """Re-label after construction (ReplicaSet assigns indices to
+        engines built without one); drops cached children so the next
+        bracket lands under the new label."""
+        self.replica = str(replica)
+        self._hists.clear()
+        self._hist_fam = None
 
     def reset(self) -> None:
         self.seconds: Dict[str, float] = {s: 0.0 for s in self.STAGES}
@@ -100,8 +113,8 @@ class HostStageStats:
             self._hist_fam = _metrics.histogram(
                 "dstpu_serving_stage_seconds",
                 "Serving host-path stage bracket durations (s)",
-                labels=("stage",))
-            h = self._hist_fam.labels(stage=name)
+                labels=("stage", "replica"))
+            h = self._hist_fam.labels(stage=name, replica=self.replica)
             self._hists[name] = h
         return h
 

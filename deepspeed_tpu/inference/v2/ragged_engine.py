@@ -120,6 +120,7 @@ import numpy as np
 
 from deepspeed_tpu.inference.common import HostStageStats
 from deepspeed_tpu.telemetry import RequestLatencyTracker, trace
+from deepspeed_tpu.utils.async_stage import BoundedAsyncStage, StageTimers
 from deepspeed_tpu.inference.paged import (PageAllocator,
                                            pages_for)
 from deepspeed_tpu.inference.prefix_cache import (ROOT_HASH,
@@ -235,6 +236,7 @@ class RaggedInferenceEngineV2:
                  prefix_cache: Any = None,
                  slo: Any = None,
                  trace_sample: Optional[int] = None,
+                 replica: Optional[str] = None,
                  config: Any = None):
         """``kv_cache_dtype``: ``None`` (config subtree
         ``v2.kv_cache_dtype`` decides; "none" by default) | "none" |
@@ -306,7 +308,12 @@ class RaggedInferenceEngineV2:
         ``v2.trace_sample`` > env ``DSTPU_TRACE_SAMPLE``).  When the
         tracer's sampling mode is armed, a reaped request's spans are
         promoted to the retained ring only on SLO breach, error, or a
-        deterministic 1-in-N draw."""
+        deterministic 1-in-N draw.
+        ``replica``: metric-label identity for scale-out serving — each
+        replica engine's registry children (``dstpu_request_*``,
+        ``dstpu_serving_stage_seconds``) carry ``replica="<value>"`` so
+        ``export_text()`` distinguishes replicas; solo engines keep the
+        empty label value."""
         mcfg = getattr(model, "config", None)
         assert dataclasses.is_dataclass(mcfg) and hasattr(mcfg, "decode"), \
             "ragged engine needs a model-zoo module with a decode config"
@@ -406,10 +413,15 @@ class RaggedInferenceEngineV2:
         self.harvest_interval = max(
             int(harvest_interval) if harvest_interval is not None else 4,
             1)
-        self.host_stats = HostStageStats()
+        self.replica = "" if replica is None else str(replica)
+        self.host_stats = HostStageStats(replica=self.replica)
+        # substrate timers for the pipelined decode window (submitted/
+        # completed counters + submit_wait brackets; serving_stages()
+        # exposes the snapshot as ``pipeline_window``)
+        self._pipe_timers = StageTimers(cat="serving")
         # per-request lifecycle latency (TTFT/TPOT/queue-wait/spill-
         # stall percentiles) — always on; independent of the tracer
-        self.request_latency = RequestLatencyTracker()
+        self.request_latency = RequestLatencyTracker(replica=self.replica)
 
         # -- SLO objectives + tail-based trace sampling --
         # All evaluation happens at reap time on the host — the traced
@@ -720,18 +732,25 @@ class RaggedInferenceEngineV2:
 
     # -- request API ----------------------------------------------------
 
-    def put_request(self, prompt, **kw) -> int:
-        """Queue a request; raises ``ValueError`` AT SUBMIT TIME for a
-        request that could never be scheduled (a prompt + budget beyond
-        ``max_seq_len``, or needing more KV pages than the whole pool
-        holds even after evicting every other sequence) — admitting one
-        would deadlock the FIFO queue behind an unschedulable head.
-        (``ValueError``, not ``assert``: these guard USER input and must
-        stay loud under ``python -O``.)"""
+    def set_replica(self, replica: str) -> None:
+        """Assign the scale-out metric-label identity after
+        construction (``ReplicaSet`` labels engines built without
+        one); re-labels the stage/latency emitters in place."""
+        self.replica = str(replica)
+        self.host_stats.set_replica(self.replica)
+        self.request_latency.set_replica(self.replica)
+
+    def validate_request(self, prompt, max_new_tokens: int = 64) -> None:
+        """The submit-time schedulability checks, without enqueuing —
+        raises ``ValueError`` for a request that could never run on
+        THIS engine.  The scale-out router calls this before accepting
+        a request (its typed rejection wraps the message), so loud
+        rejection happens at the front door rather than deep inside a
+        replica's feed queue."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
-        max_new = int(kw.get("max_new_tokens", 64))
+        max_new = int(max_new_tokens)
         if max_new < 1:
             raise ValueError(
                 "max_new_tokens must be >= 1 (prefill seeds the first "
@@ -766,6 +785,18 @@ class RaggedInferenceEngineV2:
                     f"({self.tiering.nvme_budget}) tiers hold only {cap} "
                     "— it could never be scheduled; raise num_pages or "
                     "the kv_tiering host_pages/nvme_pages budgets")
+
+    def put_request(self, prompt, **kw) -> int:
+        """Queue a request; raises ``ValueError`` AT SUBMIT TIME for a
+        request that could never be scheduled (a prompt + budget beyond
+        ``max_seq_len``, or needing more KV pages than the whole pool
+        holds even after evicting every other sequence) — admitting one
+        would deadlock the FIFO queue behind an unschedulable head.
+        (``ValueError``, not ``assert``: these guard USER input and must
+        stay loud under ``python -O``.)"""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        max_new = int(kw.get("max_new_tokens", 64))
+        self.validate_request(prompt, max_new)
         req = Request(uid=next(self._uid), prompt=prompt, **kw)
         self.waiting.append(req)
         self.request_latency.on_submit(req.uid)
@@ -797,6 +828,19 @@ class RaggedInferenceEngineV2:
             return 0
         return self._pipeline_harvest()
 
+    def drain(self) -> Dict[int, np.ndarray]:
+        """Run until every queued/resident request finishes; returns
+        ``{uid: tokens}`` for everything reaped along the way (the
+        replica-shutdown half of the engine handle — ``close()``
+        releases resources after)."""
+        outs: Dict[int, np.ndarray] = {}
+        while self.has_work():
+            self.step()
+            outs.update(self.get_outputs())
+        self.sync()
+        outs.update(self.get_outputs())
+        return outs
+
     def serving_stages(self) -> Dict[str, Any]:
         """Per-dispatch host-path breakdown + ``host_bound_fraction``
         (see :class:`~deepspeed_tpu.inference.common.HostStageStats`);
@@ -822,6 +866,21 @@ class RaggedInferenceEngineV2:
                 self.cache, self.kv_cache_dtype,
                 kv_dequant_path(int(getattr(self.cfg, "head_dim", 0))),
                 self.num_pages)
+        # pool pressure: the scale-out router's least-pressure policy
+        # reads this (waiting queue + page occupancy, both plain host
+        # ints — no device sync)
+        usable = max(self.num_pages - 1, 1)
+        in_use = usable - self.allocator.free_pages
+        out["pool"] = {
+            "num_pages": self.num_pages,
+            "pages_in_use": int(in_use),
+            "waiting_requests": len(self.waiting),
+            "pressure": round(in_use / usable
+                              + len(self.waiting), 4)}
+        if self._pipe_timers.seconds or self._pipe_timers.counters:
+            # the pipelined decode window's substrate counters
+            # (submitted/completed blocks, submit_wait back-pressure)
+            out["pipeline_window"] = self._pipe_timers.snapshot()
         out["requests"] = self.request_latency.summary()
         if self.slo is not None:
             out["slo"] = self.slo.flat_summary()
@@ -866,6 +925,14 @@ class RaggedInferenceEngineV2:
         with self.host_stats.stage("device"):
             self.host_stats.blocking_gets += 1
             return jax.device_get(tree)
+
+    def _block_ready(self, block):
+        """The pipeline window's waiter: joining a decode block means
+        waiting for its device tokens (run-ahead bound, NOT a fetch —
+        harvest later folds all ready blocks in one blocking get)."""
+        with self.host_stats.stage("device"):
+            jax.block_until_ready(block[0])
+        return block
 
     # -- compiled fused step ---------------------------------------------
 
@@ -1561,7 +1628,18 @@ class RaggedInferenceEngineV2:
             "top_p": self._upload(top_p),
             "plen": plen, "rem": rem, "has_eos": has_eos,
             "spec": spec,
-            "pending": [],                # un-harvested (toks, mask)
+            # un-harvested decode blocks ride the shared bounded-window
+            # substrate: the window bounds device run-ahead at
+            # async_depth (joining = block_until_ready on the block's
+            # tokens, bracketed as device wait), joined blocks park in
+            # "ready" until the next harvest folds them.  Same substrate
+            # instance shape as the NVMe moment stream and the router's
+            # per-replica feed loop.
+            "window": BoundedAsyncStage(
+                waiter=self._block_ready, depth=self.async_depth,
+                timers=self._pipe_timers, name="serving_pipeline"),
+            "ready": [],                  # joined, un-harvested blocks
+            "block_seq": 0,
         }
         if spec:
             self._dev["hist"] = self._upload(self._hist_array(reqs))
@@ -1632,7 +1710,7 @@ class RaggedInferenceEngineV2:
                     dv["pos"], dv["active"], dv["remaining"],
                     dv["page_table"], dv["eos_ids"], dv["do_sample"],
                     dv["temperature"], dv["top_k"], dv["top_p"], sub)
-            dv["pending"].append((toks, mask, prop, accd))
+            block = (toks, mask, prop, accd)
         else:
             with st.stage("dispatch"):
                 st.dispatches += 1
@@ -1643,7 +1721,14 @@ class RaggedInferenceEngineV2:
                     dv["active"], dv["remaining"], dv["page_table"],
                     dv["eos_ids"], dv["do_sample"], dv["temperature"],
                     dv["top_k"], dv["top_p"], dv["seeds"], sub)
-            dv["pending"].append((toks, mask))
+            block = (toks, mask)
+        # track the block in the bounded window: past async_depth the
+        # submit first joins the oldest un-joined block (waiting for
+        # its tokens under the "device" bracket), bounding device
+        # run-ahead exactly as the hand-rolled carry did
+        dv["window"].submit(dv["block_seq"], block,
+                            on_done=dv["ready"].append)
+        dv["block_seq"] += 1
         st.ticks += K
         with st.stage("plan"):
             # advance the projection past this block and decide whether
@@ -1679,14 +1764,8 @@ class RaggedInferenceEngineV2:
                 self.tiering.prefetch(
                     [q.uid for q in itertools.islice(self.waiting, 8)
                      if q.spilled is not None])
-        if len(dv["pending"]) > self.async_depth:
-            # bound device run-ahead without harvesting: wait for the
-            # (now - depth)-th block; in-order execution keeps at most
-            # async_depth programs queued behind it
-            with st.stage("device"):
-                jax.block_until_ready(
-                    dv["pending"][-self.async_depth - 1][0])
-        if finish_possible or len(dv["pending"]) >= self.harvest_interval:
+        pending = dv["window"].in_flight + len(dv["ready"])
+        if finish_possible or pending >= self.harvest_interval:
             return self._pipeline_harvest()
         return 0
 
@@ -1699,10 +1778,15 @@ class RaggedInferenceEngineV2:
         st = self.host_stats
         st.harvests += 1
         spec = dv.get("spec", False)
+        # join every block still tracked by the bounded window (on_done
+        # appends them to dv["ready"] in submit order), then fold the
+        # whole run with ONE blocking fetch
+        dv["window"].drain()
+        blocks = dv["ready"]
         toks_l, mask_l, last_tok, extra = self._fetch((
-            [p[0] for p in dv["pending"]],
-            [p[1] for p in dv["pending"]], dv["last_tok"],
-            [p[2:] for p in dv["pending"]] if spec else []))
+            [p[0] for p in blocks],
+            [p[1] for p in blocks], dv["last_tok"],
+            [p[2:] for p in blocks] if spec else []))
         with st.stage("harvest"):
             # np.array: device_get returns READ-ONLY views
             self._last_tokens = np.array(last_tok)
@@ -1720,7 +1804,7 @@ class RaggedInferenceEngineV2:
                     self._draft_len[r.slot] = max(r.length - 1, 0)
             changed = any(r.done for r in dv["reqs"])
             self._reap()
-            dv["pending"] = []
+            dv["ready"] = []
             if teardown or changed:
                 self._dev = None
             else:

@@ -1,0 +1,33 @@
+"""Scale-out serving: replicated ragged engines behind an SLO-aware
+router with continuous admission control.
+
+Data parallelism across engine REPLICAS composes with the tensor
+parallelism each engine already runs inside (GSPMD annotations over a
+mesh slice): on TPU one replica owns one mesh slice; on the CPU tier-1
+path replicas are thread-per-replica against the host platform
+(``--xla_force_host_platform_device_count`` splits the host into
+devices when real overlap is wanted — on a 1-core container the
+threads interleave but results stay bit-identical).
+
+- :class:`~.replica_set.ReplicaSet` / :class:`~.replica_set.EngineReplicaHandle`
+  — N engines, each on its own single-worker thread, fed through a
+  bounded window (a third instance of the
+  :class:`~deepspeed_tpu.utils.async_stage.BoundedAsyncStage`
+  substrate, after the engine's pipelined decode carry and the NVMe
+  moment stream).
+- :class:`~.router.Router` — the front end: pluggable load-balancing
+  policies (``rr`` / ``least_tokens`` / ``pressure``), sticky routing
+  for prefix-cache affinity (prompt-prefix chain hash), and an
+  admission controller (priorities, per-replica queue caps, SLO
+  burn-rate shed/defer) with loud typed rejections.
+"""
+from deepspeed_tpu.serving.replica_set import (EngineReplicaHandle,
+                                               ReplicaSet)
+from deepspeed_tpu.serving.router import (NeverSchedulableRejection,
+                                          POLICIES, QueueFullRejection,
+                                          Router, RouterRejection,
+                                          ShedRejection)
+
+__all__ = ["ReplicaSet", "EngineReplicaHandle", "Router", "POLICIES",
+           "RouterRejection", "QueueFullRejection", "ShedRejection",
+           "NeverSchedulableRejection"]

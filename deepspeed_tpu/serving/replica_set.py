@@ -1,0 +1,218 @@
+"""Replica execution layer: one ragged engine per single-worker thread,
+fed through a bounded async window.
+
+The handle is deliberately thin — the :class:`~.router.Router` owns all
+routing state (assignment, outstanding-token accounting, pressure
+snapshots) and talks to a handle only through the small protocol below,
+so router unit tests drive fake replicas with scripted behaviour and no
+threads:
+
+- ``alive`` / ``name`` / ``max_seqs`` / ``page_size``
+- ``validate(prompt, max_new)`` — the engine's submit-time
+  schedulability check (raises ``ValueError``)
+- ``put_async(prompt, kw, accept_t, on_done)`` — enqueue a request on
+  the replica thread; ``on_done(uid)`` runs at join time on the
+  ROUTER thread
+- ``step_async(on_done)`` — one engine iteration + output collection;
+  ``on_done((outputs, pool))`` at join time
+- ``join_all()`` — drain the feed window (folds every pending
+  ``on_done``; re-raises the first replica fault after the sweep)
+- ``drain_async(on_done)`` / ``close()`` — shutdown halves
+
+Every op rides the handle's :class:`BoundedAsyncStage` feed window
+(waiter = ``Future.result`` — the third instance of the substrate,
+after the engine's pipelined decode carry and the NVMe moment stream):
+the window bounds router run-ahead per replica and serializes
+``on_done`` folds onto whichever thread joins (the router's), so
+router state never needs a lock.
+"""
+from __future__ import annotations
+
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.async_stage import BoundedAsyncStage, StageTimers
+
+__all__ = ["EngineReplicaHandle", "ReplicaSet"]
+
+
+def _future_result(fut: Future) -> Any:
+    return fut.result()
+
+
+class EngineReplicaHandle:
+    """One ragged engine bound to its own single-worker executor.
+
+    The single worker is the whole concurrency story: ops submitted to
+    a handle execute in submission order on the replica's thread (the
+    engine is never touched from two threads), while DIFFERENT replicas
+    overlap freely.  The feed window bounds how many ops the router may
+    have outstanding per replica (``feed_depth``); past the bound a
+    submit first joins the oldest op, which is also where completed
+    results fold back into the router.
+    """
+
+    def __init__(self, idx: int, engine: Any, feed_depth: int = 2,
+                 name: Optional[str] = None) -> None:
+        self.idx = int(idx)
+        self.name = name if name is not None else f"r{idx}"
+        self.engine = engine
+        # stamp the replica identity into the engine's metric emitters
+        # (dstpu_request_* / dstpu_serving_stage_seconds children get a
+        # replica label so export_text() distinguishes replicas)
+        engine.set_replica(self.name)
+        self.alive = True
+        self._timers = StageTimers(cat="serving")
+        self._window = BoundedAsyncStage(
+            waiter=_future_result, depth=feed_depth,
+            timers=self._timers, name=f"replica_feed_{self.name}")
+        self._pool: Optional[ThreadPoolExecutor] = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"dstpu-replica-{self.name}")
+        self._seq = 0
+
+    # -- protocol surface (what fakes implement) -------------------------
+
+    @property
+    def max_seqs(self) -> int:
+        return int(self.engine.max_seqs)
+
+    @property
+    def page_size(self) -> int:
+        return int(self.engine.page_size)
+
+    @property
+    def in_flight(self) -> int:
+        return self._window.in_flight
+
+    def validate(self, prompt: Any, max_new: int) -> None:
+        self.engine.validate_request(prompt, max_new)
+
+    def put_async(self, prompt: Any, kw: Dict[str, Any], accept_t: float,
+                  on_done: Callable[[int], Any]) -> None:
+        eng = self.engine
+
+        def op() -> int:
+            uid = eng.put_request(prompt, **kw)
+            # router accept -> replica admit lands as its own series
+            # (router_queue_wait_ms), never folded into TTFT
+            eng.request_latency.note_router_accept(uid, accept_t)
+            return uid
+
+        self._submit(op, on_done)
+
+    def step_async(self, on_done: Callable[[Any], Any]) -> None:
+        """One engine iteration; the payload handed to ``on_done`` is
+        ``(outputs, pool)`` where ``outputs`` is the engine's
+        ``get_outputs()`` list and ``pool`` a lightweight pressure
+        snapshot taken ON the replica thread (the router never reads
+        engine state across threads)."""
+        eng = self.engine
+
+        def op() -> Tuple[List[Tuple[int, Any]], Dict[str, Any]]:
+            if eng.has_work():
+                eng.step()
+            outs = eng.get_outputs()
+            return outs, self._pool_snapshot(eng)
+
+        self._submit(op, on_done)
+
+    def drain_async(self, on_done: Callable[[Any], Any]) -> None:
+        """Run the replica to completion (shutdown half)."""
+        eng = self.engine
+
+        def op() -> Tuple[List[Tuple[int, Any]], Dict[str, Any]]:
+            outs = list(eng.drain().items())
+            return outs, self._pool_snapshot(eng)
+
+        self._submit(op, on_done)
+
+    def join_all(self) -> None:
+        """Fold every pending op (its ``on_done`` runs here, on the
+        caller's thread); first replica fault re-raises after the
+        sweep — the substrate's drain contract."""
+        self._window.drain()
+
+    def close(self) -> None:
+        """Idempotent teardown: abandon the window (faults already
+        handled or about to be surfaced elsewhere), stop the worker,
+        release engine resources."""
+        self.alive = False
+        try:
+            self._window.drain()
+        except Exception:
+            pass                  # a dead replica's pending ops may raise
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+        try:
+            self.engine.close()
+        except Exception:
+            pass
+
+    # -- internals -------------------------------------------------------
+
+    @staticmethod
+    def _pool_snapshot(eng: Any) -> Dict[str, Any]:
+        usable = max(eng.num_pages - 1, 1)
+        in_use = usable - eng.allocator.free_pages
+        return {"pages_in_use": int(in_use),
+                "waiting_requests": len(eng.waiting),
+                "pressure": round(in_use / usable + len(eng.waiting), 4)}
+
+    def _submit(self, fn: Callable[[], Any],
+                on_done: Optional[Callable[[Any], Any]]) -> None:
+        if not self.alive or self._pool is None:
+            raise RuntimeError(f"replica {self.name} is not alive")
+        key = self._seq
+        self._seq += 1
+        self._window.submit(key, self._pool.submit(fn), on_done=on_done)
+
+    def feed_stats(self) -> Dict[str, Any]:
+        """Window counters/timers (``submitted``/``completed`` +
+        ``submit_wait_s``) for the router stats printout."""
+        return self._timers.snapshot()
+
+
+class ReplicaSet:
+    """N data-parallel replicas built from ``factory(i) -> engine``.
+
+    On the CPU tier-1 path every engine shares the host platform
+    (thread-per-replica; start the process with
+    ``--xla_force_host_platform_device_count=N`` in ``XLA_FLAGS`` when
+    real device overlap is wanted); on TPU the factory places each
+    replica's params/cache on its own mesh slice and the engine's
+    existing GSPMD annotations shard WITHIN the slice — replica data
+    parallelism composes with in-replica tensor parallelism without
+    the router knowing either exists.
+    """
+
+    def __init__(self, factory: Callable[[int], Any], n: int,
+                 feed_depth: int = 2) -> None:
+        if n < 1:
+            raise ValueError("ReplicaSet needs n >= 1 replicas")
+        self.handles: List[EngineReplicaHandle] = []
+        try:
+            for i in range(int(n)):
+                self.handles.append(
+                    EngineReplicaHandle(i, factory(i),
+                                        feed_depth=feed_depth))
+        except Exception:
+            self.close()          # don't leak half-built replica threads
+            raise
+
+    def __len__(self) -> int:
+        return len(self.handles)
+
+    def __iter__(self):
+        return iter(self.handles)
+
+    def __getitem__(self, i: int) -> EngineReplicaHandle:
+        return self.handles[i]
+
+    @property
+    def alive(self) -> List[EngineReplicaHandle]:
+        return [h for h in self.handles if h.alive]
+
+    def close(self) -> None:
+        for h in self.handles:
+            h.close()

@@ -1,0 +1,462 @@
+"""SLO-aware router over a ReplicaSet: policies, sticky prefix
+affinity, and continuous admission control.
+
+The router is single-threaded by construction: all of its state
+mutates on the caller's thread, either directly in ``submit``/``pump``
+or inside ``on_done`` folds that the replica feed windows run at join
+time (see :mod:`~.replica_set`).  Replica engines run on their own
+threads; the router only ever talks to them through handle ops.
+
+Admission is CONTINUOUS, not a one-shot gate: every ``submit`` sees the
+current per-replica queue depths and the SLO burn rate, so a burst that
+fills the queues starts shedding mid-burst and stops shedding as soon
+as the replicas drain — the open-loop analogue of the engine's
+submit-time ``put_request`` rejection.
+
+Rejections are loud and typed (the ISSUE's "loud typed rejections"):
+
+- :class:`NeverSchedulableRejection` — the request could never run on
+  ANY replica (the engine's tier-aware schedulability check, surfaced
+  at the front door instead of deep inside a replica queue).
+- :class:`QueueFullRejection` — every live replica is at its
+  queue-depth cap (default ``2 * max_seqs``, seeded from the engine's
+  admission geometry).
+- :class:`ShedRejection` — SLO error-budget burn rate crossed
+  ``burn_shed`` and the request's priority is below the protected
+  tier.  Between ``burn_defer`` and ``burn_shed`` low-priority
+  requests are accepted but HELD in the router queue (deferred) while
+  high-priority traffic keeps dispatching.
+"""
+from __future__ import annotations
+
+import heapq
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.inference.prefix_cache import ROOT_HASH, _chunk_hash
+from deepspeed_tpu.telemetry import flight, trace
+
+__all__ = ["Router", "POLICIES", "RouterRejection", "QueueFullRejection",
+           "ShedRejection", "NeverSchedulableRejection"]
+
+
+class RouterRejection(RuntimeError):
+    """Base of every typed router rejection."""
+
+
+class QueueFullRejection(RouterRejection):
+    """Every live replica is at its queue-depth cap."""
+
+
+class ShedRejection(RouterRejection):
+    """SLO burn rate above ``burn_shed``; low-priority load is shed."""
+
+
+class NeverSchedulableRejection(RouterRejection):
+    """The request could never be scheduled on any replica (prompt +
+    budget beyond ``max_seq_len``, or KV pages beyond the combined
+    tier capacity) — the engine's ``ValueError`` with a router type."""
+
+
+class _RouterReq:
+    __slots__ = ("rid", "prompt", "kw", "priority", "accept_t",
+                 "affinity", "cost", "replica", "uid", "attempts")
+
+    def __init__(self, rid: int, prompt: np.ndarray, kw: Dict[str, Any],
+                 priority: int, accept_t: float, affinity: int,
+                 cost: int) -> None:
+        self.rid = rid
+        self.prompt = prompt
+        self.kw = kw
+        self.priority = priority
+        self.accept_t = accept_t
+        self.affinity = affinity
+        self.cost = cost          # prompt + max_new token budget
+        self.replica: Optional[str] = None
+        self.uid: Optional[int] = None
+        self.attempts = 0
+
+
+# -- load-balancing policies ---------------------------------------------
+# A policy picks one handle from the eligible candidates (alive, under
+# the queue cap).  Sticky prefix affinity runs BEFORE the policy; the
+# policy only sees requests with no (usable) affinity pin.
+
+def _policy_rr(router: "Router", cands: List[Any], req: _RouterReq) -> Any:
+    """Round-robin over the candidate list (per-dispatch counter)."""
+    h = cands[router._rr % len(cands)]
+    router._rr += 1
+    return h
+
+
+def _policy_least_tokens(router: "Router", cands: List[Any],
+                         req: _RouterReq) -> Any:
+    """Least outstanding token budget (prompt + max_new over every
+    dispatched-but-unfinished request), router-side accounting only —
+    deterministic and replica-thread-free."""
+    return min(cands, key=lambda h: (router._tokens[h.name], h.idx))
+
+
+def _policy_pressure(router: "Router", cands: List[Any],
+                     req: _RouterReq) -> Any:
+    """Least pool pressure (page occupancy + waiting queue), from each
+    replica's last ``serving_stages()``-shape snapshot (taken on the
+    replica thread, folded at join)."""
+    return min(cands, key=lambda h: (router._pressure.get(h.name, 0.0),
+                                     router._tokens[h.name], h.idx))
+
+
+POLICIES: Dict[str, Callable[["Router", List[Any], _RouterReq], Any]] = {
+    "rr": _policy_rr,
+    "least_tokens": _policy_least_tokens,
+    "pressure": _policy_pressure,
+}
+
+
+class Router:
+    """Front-end over a :class:`~.replica_set.ReplicaSet` (or any list
+    of handle-protocol objects — tests drive fakes).
+
+    Parameters
+    ----------
+    replicas:
+        ReplicaSet or list of handles.
+    policy:
+        ``"rr"`` | ``"least_tokens"`` | ``"pressure"`` (or a callable
+        ``(router, candidates, request) -> handle``).
+    slo:
+        optional :class:`~deepspeed_tpu.telemetry.slo.SLOSet` watching
+        router-level metrics (the router feeds ``router_e2e_ms`` per
+        finished request); its worst-objective burn rate drives
+        defer/shed.
+    queue_cap:
+        per-replica dispatched-but-unfinished cap; default
+        ``2 * max_seqs`` of the first replica.
+    burn_defer / burn_shed:
+        burn-rate thresholds: ``>= burn_defer`` holds low-priority
+        requests in the router queue; ``>= burn_shed`` rejects them at
+        submit.  Priorities ``>= protected_priority`` bypass both.
+    sticky:
+        route requests sharing a page-aligned prompt prefix to the
+        replica that saw the prefix first (prefix-cache affinity via
+        the same chain hash the cache indexes with).
+    """
+
+    def __init__(self, replicas: Any, policy: str = "least_tokens",
+                 slo: Any = None, queue_cap: Optional[int] = None,
+                 burn_defer: float = 1.0, burn_shed: float = 2.0,
+                 protected_priority: int = 1, sticky: bool = True,
+                 clock: Callable[[], float] = time.perf_counter) -> None:
+        self.handles: List[Any] = list(replicas)
+        if not self.handles:
+            raise ValueError("Router needs at least one replica")
+        if callable(policy):
+            self._policy, self.policy = policy, getattr(
+                policy, "__name__", "custom")
+        else:
+            if policy not in POLICIES:
+                raise ValueError(f"unknown router policy {policy!r} "
+                                 f"(have {sorted(POLICIES)})")
+            self._policy, self.policy = POLICIES[policy], policy
+        self.slo = slo
+        self.queue_cap = (int(queue_cap) if queue_cap is not None
+                          else 2 * int(self.handles[0].max_seqs))
+        if self.queue_cap < 1:
+            raise ValueError("queue_cap must be >= 1")
+        self.burn_defer = float(burn_defer)
+        self.burn_shed = float(burn_shed)
+        self.protected_priority = int(protected_priority)
+        self.sticky = bool(sticky)
+        self.clock = clock
+        self._chunk = max(int(getattr(self.handles[0], "page_size", 64)), 1)
+
+        self._rr = 0
+        self._rid = 0
+        self._heap: List[Tuple[int, int, _RouterReq]] = []   # (-pri, seq)
+        self._hseq = 0
+        self._live: Dict[int, _RouterReq] = {}               # accepted
+        self._assigned: Dict[str, set] = {h.name: set()
+                                          for h in self.handles}
+        self._tokens: Dict[str, int] = {h.name: 0 for h in self.handles}
+        self._pressure: Dict[str, float] = {}
+        self._uid_rid: Dict[Tuple[str, int], int] = {}
+        self._affinity: Dict[int, str] = {}                  # hash -> name
+        self._outputs: Dict[int, np.ndarray] = {}
+        self._draining = False
+        self.stats_counters: Dict[str, int] = {
+            "accepted": 0, "rejected_queue_full": 0, "rejected_shed": 0,
+            "rejected_never_schedulable": 0, "affinity_hits": 0,
+            "rerouted": 0, "finished": 0, "replica_deaths": 0}
+        self._routed: Dict[str, int] = {h.name: 0 for h in self.handles}
+
+    # -- admission -------------------------------------------------------
+
+    def _alive(self) -> List[Any]:
+        return [h for h in self.handles if h.alive]
+
+    def _max_burn(self) -> float:
+        if self.slo is None:
+            return 0.0
+        state = self.slo.evaluate()
+        return max((o["burn_rate"] for o in state.values()), default=0.0)
+
+    def _prefix_hash(self, prompt: np.ndarray) -> int:
+        """Chain hash over the page-aligned prompt prefix — the SAME
+        chunking the prefix cache indexes with, so two prompts that
+        would share cached pages land on the same replica."""
+        n = (prompt.size // self._chunk) * self._chunk
+        h = ROOT_HASH
+        for i in range(0, n, self._chunk):
+            h = _chunk_hash(h, tuple(int(t) for t in
+                                     prompt[i:i + self._chunk]))
+        return h
+
+    def submit(self, prompt: Any, priority: int = 0, **kw) -> int:
+        """Accept (or loudly reject) one request; returns the router
+        request id.  ``kw`` passes through to the replica's
+        ``put_request`` (max_new_tokens, eos_token_id, sampling...)."""
+        alive = self._alive()
+        if not alive:
+            raise RouterRejection("no live replicas")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        max_new = int(kw.get("max_new_tokens", 64))
+        try:
+            # replicas are homogeneous: one validation covers all
+            alive[0].validate(prompt, max_new)
+        except ValueError as e:
+            self.stats_counters["rejected_never_schedulable"] += 1
+            raise NeverSchedulableRejection(str(e)) from e
+        if priority < self.protected_priority:
+            burn = self._max_burn()
+            if burn >= self.burn_shed:
+                self.stats_counters["rejected_shed"] += 1
+                raise ShedRejection(
+                    f"SLO burn rate {burn:.2f} >= shed threshold "
+                    f"{self.burn_shed:.2f}; priority {priority} below "
+                    f"protected tier {self.protected_priority}")
+        # accepted-but-unfinished (dispatched + still queued) against
+        # the aggregate cap: a burst past every replica's queue depth
+        # is rejected HERE, not silently parked in the router heap
+        if len(self._live) >= self.queue_cap * len(alive):
+            self.stats_counters["rejected_queue_full"] += 1
+            raise QueueFullRejection(
+                f"{len(self._live)} requests outstanding >= queue cap "
+                f"{self.queue_cap} x {len(alive)} live replicas")
+        rid = self._rid
+        self._rid += 1
+        req = _RouterReq(rid, prompt, dict(kw), int(priority),
+                         self.clock(),
+                         self._prefix_hash(prompt) if self.sticky
+                         else ROOT_HASH,
+                         int(prompt.size) + max_new)
+        self._live[rid] = req
+        heapq.heappush(self._heap, (-req.priority, self._hseq, req))
+        self._hseq += 1
+        self.stats_counters["accepted"] += 1
+        trace.event("router_accept", cat="serving", rid=rid,
+                    priority=int(priority), prompt_len=int(prompt.size))
+        return rid
+
+    # -- dispatch --------------------------------------------------------
+
+    def _pick(self, req: _RouterReq, cands: List[Any]) -> Any:
+        if self.sticky and req.affinity != ROOT_HASH:
+            pinned = self._affinity.get(req.affinity)
+            if pinned is not None:
+                for h in cands:
+                    if h.name == pinned:
+                        self.stats_counters["affinity_hits"] += 1
+                        return h
+        h = self._policy(self, cands, req)
+        if self.sticky and req.affinity != ROOT_HASH:
+            self._affinity.setdefault(req.affinity, h.name)
+        return h
+
+    def _send(self, req: _RouterReq, h: Any) -> None:
+        name = h.name
+        self._assigned[name].add(req.rid)
+        self._tokens[name] += req.cost
+        self._routed[name] += 1
+        req.replica = name
+        req.attempts += 1
+        with trace.span("router_dispatch", cat="serving", rid=req.rid,
+                        replica=name):
+            try:
+                h.put_async(req.prompt, req.kw, req.accept_t,
+                            on_done=lambda uid, r=req, hh=h:
+                            self._on_admit(hh, r, uid))
+            except Exception as e:       # join of an older op faulted
+                self._on_replica_death(h, e)
+
+    def _on_admit(self, h: Any, req: _RouterReq, uid: int) -> None:
+        req.uid = int(uid)
+        self._uid_rid[(h.name, int(uid))] = req.rid
+
+    def _dispatch_queued(self) -> int:
+        """Send queued requests to replicas until the queue is empty,
+        every replica is at cap, or SLO defer holds the remainder;
+        returns the number dispatched."""
+        sent = 0
+        burn = self._max_burn() if (self.slo is not None
+                                    and not self._draining) else 0.0
+        while self._heap:
+            req = self._heap[0][2]
+            if (burn >= self.burn_defer and not self._draining
+                    and req.priority < self.protected_priority):
+                # deferred: held in the router queue (heap order puts
+                # protected traffic first, so nothing above this is
+                # waiting behind it)
+                break
+            cands = [h for h in self._alive()
+                     if len(self._assigned[h.name]) < self.queue_cap]
+            if not cands:
+                break
+            heapq.heappop(self._heap)
+            self._send(req, self._pick(req, cands))
+            sent += 1
+        return sent
+
+    # -- the serving loop ------------------------------------------------
+
+    def pump(self) -> None:
+        """One router round: dispatch what admission allows, then
+        submit one step op per busy replica.  Results fold back on
+        THIS thread at window joins (back-pressure, ``join_all`` or
+        ``drain``)."""
+        with trace.span("router_pump", cat="serving"):
+            self._dispatch_queued()
+            for h in list(self.handles):
+                if not h.alive:
+                    continue
+                if not self._assigned[h.name] and h.in_flight == 0:
+                    continue
+                try:
+                    h.step_async(on_done=lambda payload, hh=h:
+                                 self._on_step_done(hh, payload))
+                except Exception as e:
+                    self._on_replica_death(h, e)
+
+    def _on_step_done(self, h: Any, payload: Any) -> None:
+        outs, pool = payload
+        self._pressure[h.name] = float(pool.get("pressure", 0.0))
+        for uid, toks in outs:
+            rid = self._uid_rid.pop((h.name, int(uid)), None)
+            if rid is None:
+                continue          # a re-routed request's stale copy
+            req = self._live.pop(rid, None)
+            if req is None:
+                continue
+            self._assigned[h.name].discard(rid)
+            self._tokens[h.name] -= req.cost
+            self._outputs[rid] = np.asarray(toks)
+            self.stats_counters["finished"] += 1
+            e2e_ms = (self.clock() - req.accept_t) * 1e3
+            if self.slo is not None:
+                self.slo.record("router_e2e_ms", e2e_ms)
+            trace.event("router_finish", cat="serving", rid=rid,
+                        replica=h.name, e2e_ms=round(e2e_ms, 3),
+                        attempts=req.attempts)
+
+    def _on_replica_death(self, h: Any, exc: BaseException) -> None:
+        """Failure isolation: mark the replica dead, dump the flight
+        ring (the postmortem rides the span schema), and re-route its
+        whole queue — full-prompt resubmission preserves greedy
+        bit-parity on the surviving replicas."""
+        if not h.alive:
+            return
+        h.alive = False
+        self.stats_counters["replica_deaths"] += 1
+        orphans = sorted(self._assigned[h.name])
+        flight.dump_on_fault(
+            f"replica_death_{h.name}", exc,
+            extra={"replica": h.name,
+                   "requeued_rids": orphans,
+                   "policy": self.policy})
+        for rid in orphans:
+            req = self._live.get(rid)
+            if req is None:
+                continue
+            if req.uid is not None:
+                self._uid_rid.pop((h.name, req.uid), None)
+            self._tokens[h.name] -= req.cost
+            req.uid = None
+            req.replica = None
+            self.stats_counters["rerouted"] += 1
+            heapq.heappush(self._heap, (-req.priority, self._hseq, req))
+            self._hseq += 1
+        self._assigned[h.name] = set()
+        # affinity pins to a dead replica would strand their chains
+        for k in [k for k, v in self._affinity.items() if v == h.name]:
+            del self._affinity[k]
+        try:
+            h.close()
+        except Exception:
+            pass
+        if not self._alive() and (self._heap or self._live):
+            raise RouterRejection(
+                "all replicas dead with requests outstanding") from exc
+
+    def join(self) -> None:
+        """Fold every outstanding replica op (blocking)."""
+        for h in list(self.handles):
+            if not h.alive:
+                continue
+            try:
+                h.join_all()
+            except Exception as e:
+                self._on_replica_death(h, e)
+
+    def drain(self) -> Dict[int, np.ndarray]:
+        """Run until every accepted request finishes (deferred ones
+        included — drain dispatches regardless of burn rate); returns
+        ``{rid: tokens}`` for everything not yet collected."""
+        self._draining = True
+        try:
+            while self._heap or self._live:
+                self.pump()
+                self.join()
+        finally:
+            self._draining = False
+        return self.get_outputs()
+
+    def get_outputs(self) -> Dict[int, np.ndarray]:
+        out, self._outputs = self._outputs, {}
+        return out
+
+    def close(self) -> None:
+        for h in self.handles:
+            try:
+                h.close()
+            except Exception:
+                pass
+
+    # -- observability ---------------------------------------------------
+
+    @property
+    def queued(self) -> int:
+        return len(self._heap)
+
+    @property
+    def outstanding(self) -> int:
+        """Accepted requests not yet finished (queued + dispatched)."""
+        return len(self._live)
+
+    def stats(self) -> Dict[str, Any]:
+        """Flat router summary for the example printout / smoke gate."""
+        out: Dict[str, Any] = {"policy": self.policy,
+                               "queue_cap": self.queue_cap,
+                               "replicas": len(self.handles),
+                               "replicas_alive": len(self._alive()),
+                               "queued": len(self._heap),
+                               "in_flight": len(self._live)}
+        out.update(self.stats_counters)
+        for h in self.handles:
+            out[f"routed_{h.name}"] = self._routed[h.name]
+            out[f"outstanding_tokens_{h.name}"] = self._tokens[h.name]
+            if h.name in self._pressure:
+                out[f"pressure_{h.name}"] = self._pressure[h.name]
+        if self.slo is not None:
+            out["burn_rate_max"] = round(self._max_burn(), 4)
+        return out

@@ -20,6 +20,9 @@ means the host cannot observe a token earlier than that.
   missing or negative)
 - ``tpot``: (last − first token) / (tokens − 1), requests with ≥2 tokens
 - ``queue_wait``: first admit − submit
+- ``router_queue_wait``: first admit − router accept (only for
+  requests that arrived through the scale-out router; its own series,
+  so router queuing is never folded into TTFT)
 - ``spill_stall``: accumulated restore-bracket seconds per request
 - ``prefill``: admit → prefill-complete span, plus per-request counts
   of prefill tokens actually computed vs skipped via the prefix cache
@@ -46,6 +49,8 @@ _HIST_SPECS = {
     "ttft_ms": "Time to first harvested token (ms)",
     "tpot_ms": "Per-token decode latency after the first token (ms)",
     "queue_wait_ms": "Submit to first admission (ms)",
+    "router_queue_wait_ms":
+        "Router accept to replica slot admission (ms)",
     "spill_stall_ms": "Restore-bracket stall attributed to the request (ms)",
     "prefill_ms": "Admission to prefill-complete (ms)",
 }
@@ -66,11 +71,12 @@ class _Rec:
     __slots__ = ("uid", "submit_t", "admit_t", "first_token_t",
                  "last_token_t", "tokens", "spill_stall_s", "spills",
                  "finish_t", "prefill_end_t", "prefill_computed",
-                 "prefill_cached", "errors")
+                 "prefill_cached", "errors", "router_accept_t")
 
     def __init__(self, uid: Any, submit_t: float):
         self.uid = uid
         self.submit_t = submit_t
+        self.router_accept_t: Optional[float] = None
         self.admit_t: Optional[float] = None
         self.first_token_t: Optional[float] = None
         self.last_token_t: Optional[float] = None
@@ -91,8 +97,12 @@ class RequestLatencyTracker:
 
     def __init__(self, clock: Callable[[], float] = time.perf_counter,
                  max_completed: int = 4096,
-                 registry: Any = "auto"):
+                 registry: Any = "auto", replica: str = ""):
         self.clock = clock
+        # scale-out serving: one tracker per replica engine; the label
+        # keeps their registry children apart (solo engines keep the
+        # empty label value)
+        self.replica = str(replica)
         self._live: Dict[Any, _Rec] = {}
         self._done: deque = deque(maxlen=max_completed)
         self.submitted = 0
@@ -101,6 +111,14 @@ class RequestLatencyTracker:
         # flag); None/False: no metrics feed; else an injected registry.
         self._registry = registry
         self._hists: Dict[str, Any] = {}
+        self._hist_fams: Dict[str, Any] = {}
+
+    def set_replica(self, replica: str) -> None:
+        """Re-label after construction (ReplicaSet assigns indices);
+        drops cached children so future observations carry the label."""
+        self.replica = str(replica)
+        self._hists.clear()
+        self._hist_fams.clear()
 
     def _observe(self, name: str, value_ms: float) -> None:
         reg = self._registry
@@ -109,9 +127,13 @@ class RequestLatencyTracker:
         if not reg or not reg.enabled:
             return
         h = self._hists.get(name)
-        if h is None or h is not reg.get(f"dstpu_request_{name}"):
-            h = reg.histogram(f"dstpu_request_{name}", _HIST_SPECS[name],
-                              buckets=_metrics_mod.MS_BUCKETS)
+        if h is None or self._hist_fams.get(name) is not reg.get(
+                f"dstpu_request_{name}"):
+            fam = reg.histogram(f"dstpu_request_{name}", _HIST_SPECS[name],
+                                labels=("replica",),
+                                buckets=_metrics_mod.MS_BUCKETS)
+            self._hist_fams[name] = fam
+            h = fam.labels(replica=self.replica)
             self._hists[name] = h
         h.observe(value_ms)
 
@@ -120,6 +142,16 @@ class RequestLatencyTracker:
     def on_submit(self, uid: Any) -> None:
         self._live[uid] = _Rec(uid, self.clock())
         self.submitted += 1
+
+    def note_router_accept(self, uid: Any, accept_t: float) -> None:
+        """Router-level accept timestamp (same clock as the tracker).
+        The router calls this right after ``put_request`` returns the
+        replica uid; ``router_queue_wait_ms`` (accept -> replica slot
+        admission) then lands as its OWN series, so router queuing is
+        never silently folded into TTFT."""
+        r = self._live.get(uid)
+        if r is not None and r.router_accept_t is None:
+            r.router_accept_t = float(accept_t)
 
     def on_admit(self, uid: Any) -> None:
         r = self._live.get(uid)
@@ -183,7 +215,8 @@ class RequestLatencyTracker:
         self.finished += 1
         rec = self._rec_summary(r)
         for name in ("ttft_ms", "tpot_ms", "queue_wait_ms",
-                     "spill_stall_ms", "prefill_ms"):
+                     "router_queue_wait_ms", "spill_stall_ms",
+                     "prefill_ms"):
             v = rec.get(name)
             if v is not None:
                 self._observe(name, v)
@@ -208,6 +241,10 @@ class RequestLatencyTracker:
             "tpot_ms": tpot,
             "queue_wait_ms": ((r.admit_t - r.submit_t) * 1e3
                               if r.admit_t is not None else None),
+            "router_queue_wait_ms": (
+                (r.admit_t - r.router_accept_t) * 1e3
+                if r.admit_t is not None
+                and r.router_accept_t is not None else None),
             "spill_stall_ms": (r.spill_stall_s * 1e3 if r.spills > 0
                                else None),
             "prefill_ms": ((r.prefill_end_t - r.admit_t) * 1e3
@@ -233,6 +270,10 @@ class RequestLatencyTracker:
                         if r.tokens >= 2 and r.first_token_t is not None],
             "queue_wait_ms": [(r.admit_t - r.submit_t) * 1e3 for r in done
                               if r.admit_t is not None],
+            "router_queue_wait_ms": [
+                (r.admit_t - r.router_accept_t) * 1e3 for r in done
+                if r.admit_t is not None
+                and r.router_accept_t is not None],
             "spill_stall_ms": [r.spill_stall_s * 1e3 for r in done
                                if r.spills > 0],
             "prefill_ms": [(r.prefill_end_t - r.admit_t) * 1e3

@@ -5,6 +5,16 @@ weights; feeds a stream of variable-length requests through the ragged
 engine and prints per-request outputs as slots free up.
 
     python examples/serve_llama.py [--checkpoint /path/to/hf_dir]
+
+Scale-out serving (``--replicas N``) puts N data-parallel engine
+replicas behind the SLO-aware router (``--router-policy`` picks the
+load-balancing policy) and prints the router stats after the drain.
+On a CPU host, set ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+before launching when you want the replica threads to overlap on
+separate host devices; without it they interleave on one device
+(bit-identical results, no wall-clock overlap).
+
+    python examples/serve_llama.py --replicas 2 --router-policy pressure
 """
 import argparse
 
@@ -59,6 +69,13 @@ def main() -> None:
                         "requests: matched KV pages attach read-only "
                         "(copy-on-write on divergence) so repeated "
                         "system prompts skip their prefill")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="data-parallel engine replicas behind the "
+                        "SLO-aware router (1 = solo engine, no router)")
+    p.add_argument("--router-policy",
+                   choices=["rr", "least_tokens", "pressure"],
+                   default="least_tokens",
+                   help="router load-balancing policy for --replicas>1")
     args = p.parse_args()
 
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -87,14 +104,15 @@ def main() -> None:
         tiering = {"host_pages": args.kv_host_pages,
                    "nvme_pages": args.kv_nvme_pages,
                    "nvme_dir": args.kv_nvme_dir}
-    engine = RaggedInferenceEngineV2(
-        model, params=params, max_seqs=args.max_seqs,
-        max_seq_len=args.max_seq_len, prefill_chunk=64,
-        pipeline=not args.no_pipeline,
-        harvest_interval=args.harvest_interval,
-        speculation={"mode": args.spec_mode, "k": args.spec_k},
-        kv_cache_dtype=args.kv_cache_dtype, kv_tiering=tiering,
-        prefix_cache=args.prefix_cache, **spec_kw)
+    def build_engine(replica_idx: int = 0) -> RaggedInferenceEngineV2:
+        return RaggedInferenceEngineV2(
+            model, params=params, max_seqs=args.max_seqs,
+            max_seq_len=args.max_seq_len, prefill_chunk=64,
+            pipeline=not args.no_pipeline,
+            harvest_interval=args.harvest_interval,
+            speculation={"mode": args.spec_mode, "k": args.spec_k},
+            kv_cache_dtype=args.kv_cache_dtype, kv_tiering=tiering,
+            prefix_cache=args.prefix_cache, **spec_kw)
 
     # a burst of variable-length "requests"; with --prefix-cache they
     # share a common system prompt so later admissions hit the index
@@ -102,10 +120,44 @@ def main() -> None:
     sys_prompt = (rng.integers(1, cfg.vocab_size, size=(64,),
                                dtype=np.int32)
                   if args.prefix_cache else np.zeros((0,), np.int32))
-    for n in (5, 17, 9, 30, 12, 7):
-        prompt = np.concatenate(
-            [sys_prompt,
-             rng.integers(1, cfg.vocab_size, size=(n,), dtype=np.int32)])
+    prompts = [np.concatenate(
+        [sys_prompt,
+         rng.integers(1, cfg.vocab_size, size=(n,), dtype=np.int32)])
+        for n in (5, 17, 9, 30, 12, 7)]
+
+    if args.replicas > 1:
+        from deepspeed_tpu.serving import ReplicaSet, Router
+        from deepspeed_tpu.telemetry import SLOSet
+
+        rs = ReplicaSet(build_engine, args.replicas)
+        router = Router(rs, policy=args.router_policy,
+                        slo=SLOSet(["router_e2e_ms_p99 <= 60000"]))
+        for prompt in prompts:
+            rid = router.submit(prompt,
+                                max_new_tokens=args.max_new_tokens)
+            print(f"routed request {rid} (prompt {prompt.size} tokens)")
+        for rid, tokens in sorted(router.drain().items()):
+            print(f"request {rid} done: {tokens.size} tokens -> "
+                  f"{tokens[-8:].tolist()}")
+        s = router.stats()
+        print("router: " +
+              " ".join(f"{k}={s[k]}" for k in
+                       ("policy", "replicas_alive", "accepted",
+                        "finished", "rejected_queue_full",
+                        "rejected_shed", "affinity_hits", "rerouted")) +
+              " " + " ".join(f"routed_{h.name}={s[f'routed_{h.name}']}"
+                             for h in rs))
+        for h in rs:
+            rl = h.engine.request_latency.summary()
+            print(f"  {h.name}: ttft_p50={rl['ttft_ms_p50']}ms "
+                  f"router_queue_wait_p50="
+                  f"{rl['router_queue_wait_ms_p50']}ms "
+                  f"completed={rl['completed']}")
+        rs.close()
+        return
+
+    engine = build_engine()
+    for prompt in prompts:
         uid = engine.put_request(prompt,
                                  max_new_tokens=args.max_new_tokens)
         print(f"queued request {uid} (prompt {prompt.size} tokens)")

@@ -99,6 +99,12 @@ def main() -> int:
                         "parses, histogram vs nearest-rank percentile "
                         "agreement, tail sampling keeps the slow "
                         "request, <=5%% metrics-on wall overhead)")
+    p.add_argument("--router", action="store_true",
+                   help="also gate scale-out serving (2 replicas, "
+                        "mixed-priority open-loop workload: greedy "
+                        "outputs bit-identical to single-engine, both "
+                        "replicas served traffic, admission sheds "
+                        "loudly at the queue cap)")
     args = p.parse_args()
 
     import jax
@@ -545,8 +551,8 @@ def main() -> int:
             elif name.endswith("_count"):
                 count_vals[(name[:-len("_count")],
                             tuple(sorted(labs.items())))] = val
-        if not bucket_runs or ("dstpu_request_ttft_ms", ()) not in \
-                bucket_runs:
+        if not bucket_runs or ("dstpu_request_ttft_ms",
+                               (("replica", ""),)) not in bucket_runs:
             print("FAIL [metrics]: no request histograms in the "
                   "exposition — the gate ran vacuously "
                   f"({sorted(k[0] for k in bucket_runs)})")
@@ -576,7 +582,7 @@ def main() -> int:
         rl = m_eng.request_latency.summary()
         for mname in ("ttft_ms", "tpot_ms"):
             fam = reg.get(f"dstpu_request_{mname}")
-            child = fam.labels() if fam is not None else None
+            child = fam.labels(replica="") if fam is not None else None
             for q in (50, 99):
                 hq = child.quantile(q) if child is not None else None
                 nr = rl.get(f"{mname}_p{q}")
@@ -668,6 +674,93 @@ def main() -> int:
               f"histograms={len(bucket_runs)} "
               f"slow_uid={slow_uid} kept={sorted(kept_uids)} "
               f"thr={thr:.1f}ms overhead={m_ovh * 100:+.1f}%")
+    if args.router:
+        # ---- scale-out serving: router over 2 replicas ---------------
+        # greedy outputs are a pure function of (prompt, params), so a
+        # routed run must match the single-engine run bit-for-bit no
+        # matter how the router spread the requests
+        from deepspeed_tpu.serving import (QueueFullRejection,
+                                           ReplicaSet, Router)
+
+        r_prompts = [rng.integers(1, 64, size=(n,), dtype=np.int32)
+                     for n in (9, 14, 7, 11, 16, 8, 13, 10)]
+        r_new = min(args.tokens, 24)
+
+        def r_engine(i=0):
+            return RaggedInferenceEngineV2(
+                LlamaForCausalLM(cfg), params=params, max_seqs=2,
+                max_seq_len=max_len, prefill_chunk=16,
+                decode_block_size=4, harvest_interval=3,
+                rng=jax.random.PRNGKey(args.seed))
+
+        # single-engine reference, same seeds, greedy
+        ref_eng = r_engine()
+        r_ref = {}
+        order = {ref_eng.put_request(p, max_new_tokens=r_new): i
+                 for i, p in enumerate(r_prompts)}
+        while ref_eng.has_work():
+            ref_eng.step()
+            for uid, toks in ref_eng.get_outputs():
+                r_ref[order[uid]] = toks
+        ref_eng.sync()
+        for uid, toks in ref_eng.get_outputs():
+            r_ref[order[uid]] = toks
+
+        rs = ReplicaSet(r_engine, 2)
+        router = Router(rs, policy="least_tokens")
+        # mixed-priority open-loop arrivals: everything submitted up
+        # front, pumped between submissions (no response waiting)
+        rids = {}
+        for i, prompt in enumerate(r_prompts):
+            rids[router.submit(prompt, priority=i % 2,
+                               max_new_tokens=r_new)] = i
+            router.pump()
+        r_outs = router.drain()
+        r_stats = router.stats()
+
+        if sorted(rids[k] for k in r_outs) != sorted(r_ref):
+            print(f"FAIL [router]: request conservation broke "
+                  f"({len(r_outs)} of {len(r_ref)} finished)")
+            failures += 1
+        else:
+            diverged = [i for rid, i in rids.items()
+                        if not np.array_equal(r_outs[rid], r_ref[i])]
+            if diverged:
+                print(f"FAIL [router]: greedy outputs diverged from "
+                      f"single-engine serving for requests {diverged}")
+                failures += 1
+        # anti-vacuity: under the least-loaded policy with 8 requests
+        # over 2 replicas, a replica that served nothing means the
+        # router never actually balanced
+        if not (r_stats["routed_r0"] > 0 and r_stats["routed_r1"] > 0):
+            print(f"FAIL [router]: vacuous run — a replica served zero "
+                  f"requests (routed_r0={r_stats['routed_r0']} "
+                  f"routed_r1={r_stats['routed_r1']})")
+            failures += 1
+        # admission must shed loudly at the queue cap: a burst past
+        # 2 replicas x cap must raise the typed rejection
+        shed_router = Router(rs, policy="least_tokens", queue_cap=2)
+        cap_hit = False
+        accepted = 0
+        try:
+            for i in range(8):
+                shed_router.submit(r_prompts[i % len(r_prompts)],
+                                   max_new_tokens=r_new)
+                accepted += 1
+        except QueueFullRejection:
+            cap_hit = True
+        if not cap_hit or accepted != 4:
+            print(f"FAIL [router]: admission did not shed at queue cap "
+                  f"(accepted {accepted}, expected 4 then "
+                  "QueueFullRejection)")
+            failures += 1
+        shed_router.drain()
+        rs.close()
+        print(f"[router] requests={len(r_outs)} "
+              f"routed_r0={r_stats['routed_r0']} "
+              f"routed_r1={r_stats['routed_r1']} "
+              f"affinity_hits={r_stats['affinity_hits']} "
+              f"cap_shed={cap_hit}")
     if failures:
         print(f"serve_smoke: {failures} failure(s)")
         return 1
@@ -683,7 +776,9 @@ def main() -> int:
            if args.trace else "") +
           (", metrics exposition valid, percentiles agree, tail "
            "sampling selective within overhead budget"
-           if args.metrics else ""))
+           if args.metrics else "") +
+          (", routed serving bit-identical across 2 replicas with "
+           "loud queue-cap shedding" if args.router else ""))
     return 0
 
 

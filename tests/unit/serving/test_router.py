@@ -1,0 +1,388 @@
+"""Scale-out router tests (the PR-14 tentpole).
+
+Policy/admission logic is exercised against FAKE replicas — scripted,
+synchronous, thread-free implementations of the handle protocol — with
+an injectable clock, so routing decisions are deterministic and each
+assertion names the decision it checks.  The two integration classes at
+the bottom drive REAL engines: greedy bit-parity of routed serving
+against a single engine, and the zero-new-compilations guard with two
+live replicas.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.serving import (NeverSchedulableRejection,
+                                   QueueFullRejection, Router,
+                                   RouterRejection, ShedRejection)
+from deepspeed_tpu.telemetry import SLOSet, flight, read_flight_record
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+class FakeReplica:
+    """Handle-protocol fake: synchronous ops, scripted finish latency
+    (steps until a request completes), scripted pressure reports, and
+    an optional scripted death step."""
+
+    def __init__(self, idx, max_seqs=3, page_size=4, latency=1,
+                 pressure_script=(), die_at_step=None):
+        self.idx = idx
+        self.name = f"f{idx}"
+        self.alive = True
+        self.max_seqs = max_seqs
+        self.page_size = page_size
+        self.in_flight = 0
+        self.latency = latency
+        self.die_at_step = die_at_step
+        self.pressure_script = list(pressure_script)
+        self._uid = itertools.count(1000 * idx)
+        self.admitted = []            # [uid, steps_left, prompt]
+        self.puts = []                # (uid, prompt list) in admit order
+        self.steps = 0
+        self.closed = False
+
+    def validate(self, prompt, max_new):
+        if np.asarray(prompt).size == 0:
+            raise ValueError("empty prompt")
+        if np.asarray(prompt).size + int(max_new) > 64:
+            raise ValueError("prompt + max_new_tokens 65 > max_seq_len 64")
+
+    def put_async(self, prompt, kw, accept_t, on_done):
+        uid = next(self._uid)
+        p = np.asarray(prompt, np.int32)
+        self.puts.append((uid, p.tolist()))
+        self.admitted.append([uid, self.latency, p])
+        on_done(uid)
+
+    def step_async(self, on_done):
+        self.steps += 1
+        if self.die_at_step is not None and self.steps >= self.die_at_step:
+            raise RuntimeError(f"scripted death of {self.name}")
+        outs = []
+        keep = []
+        for ent in self.admitted:
+            ent[1] -= 1
+            if ent[1] <= 0:
+                outs.append((ent[0], np.concatenate(
+                    [ent[2], np.array([7, 8, 9], np.int32)])))
+            else:
+                keep.append(ent)
+        self.admitted = keep
+        pressure = (self.pressure_script.pop(0) if self.pressure_script
+                    else float(len(self.admitted)))
+        on_done((outs, {"pressure": pressure}))
+
+    def join_all(self):
+        pass
+
+    def close(self):
+        self.alive = False
+        self.closed = True
+
+
+def _prompt(n, base=1):
+    return np.arange(base, base + n, dtype=np.int32)
+
+
+def _drain(router):
+    outs = router.drain()
+    return outs
+
+
+class TestPolicies:
+    def test_round_robin_alternates(self):
+        fakes = [FakeReplica(0), FakeReplica(1)]
+        router = Router(fakes, policy="rr", sticky=False)
+        for i in range(6):
+            router.submit(_prompt(3, base=10 * i), max_new_tokens=4)
+        _drain(router)
+        s = router.stats()
+        assert s["routed_f0"] == 3 and s["routed_f1"] == 3, s
+
+    def test_least_tokens_prefers_lighter_replica(self):
+        fakes = [FakeReplica(0, latency=100), FakeReplica(1, latency=100)]
+        router = Router(fakes, policy="least_tokens", sticky=False)
+        # heavy request lands on f0 (tie broken by idx), then every
+        # light one piles onto f1 until it out-weighs the heavy
+        router.submit(_prompt(4), max_new_tokens=40)     # cost 44 -> f0
+        router.submit(_prompt(4), max_new_tokens=10)     # cost 14 -> f1
+        router.submit(_prompt(4), max_new_tokens=10)     # 14 -> f1 (28)
+        router.submit(_prompt(4), max_new_tokens=10)     # 14 -> f1 (42)
+        router.submit(_prompt(4), max_new_tokens=10)     # f1=42 < f0=44
+        router.pump()
+        s = router.stats()
+        assert s["routed_f0"] == 1 and s["routed_f1"] == 4, s
+        assert s["outstanding_tokens_f0"] == 44, s
+        assert s["outstanding_tokens_f1"] == 56, s
+
+    def test_pressure_policy_reads_replica_snapshots(self):
+        # f0 reports scripted high pressure, f1 low — after the first
+        # fold every new dispatch goes to f1
+        fakes = [FakeReplica(0, latency=50, pressure_script=[9.0] * 10),
+                 FakeReplica(1, latency=50, pressure_script=[0.1] * 10)]
+        router = Router(fakes, policy="pressure", sticky=False)
+        router.submit(_prompt(3), max_new_tokens=4)
+        router.submit(_prompt(3), max_new_tokens=4)
+        router.pump()          # one to each (pressure unknown -> tokens)
+        assert router.stats()["pressure_f0"] == 9.0
+        for _ in range(4):
+            router.submit(_prompt(3), max_new_tokens=4)
+        router.pump()
+        s = router.stats()
+        assert s["routed_f1"] == 5 and s["routed_f0"] == 1, s
+
+
+class TestPrefixAffinity:
+    def test_shared_prefix_routes_sticky(self):
+        # page_size=4 chunks; two prompts share the first 8 tokens ->
+        # same chain hash -> same replica, even though least_tokens
+        # would have balanced them apart
+        fakes = [FakeReplica(0, latency=50), FakeReplica(1, latency=50)]
+        router = Router(fakes, policy="least_tokens", sticky=True)
+        shared = np.array([3, 1, 4, 1, 5, 9, 2, 6], np.int32)
+        router.submit(np.concatenate([shared, [11]]), max_new_tokens=4)
+        router.submit(np.concatenate([shared, [22]]), max_new_tokens=4)
+        router.pump()
+        s = router.stats()
+        assert s["affinity_hits"] == 1, s
+        assert sorted([s["routed_f0"], s["routed_f1"]]) == [0, 2], s
+
+    def test_short_prompts_have_no_affinity(self):
+        # below one page the chain hash is ROOT -> policy decides
+        fakes = [FakeReplica(0, latency=50), FakeReplica(1, latency=50)]
+        router = Router(fakes, policy="least_tokens", sticky=True)
+        router.submit(_prompt(3), max_new_tokens=4)
+        router.submit(_prompt(3), max_new_tokens=4)
+        router.pump()
+        s = router.stats()
+        assert s["affinity_hits"] == 0, s
+        assert s["routed_f0"] == 1 and s["routed_f1"] == 1, s
+
+
+class TestAdmission:
+    def test_priority_dispatch_order(self):
+        fake = FakeReplica(0, latency=1, max_seqs=8)
+        router = Router([fake], policy="rr", sticky=False)
+        router.submit(_prompt(3, base=1), priority=0, max_new_tokens=4)
+        router.submit(_prompt(3, base=10), priority=2, max_new_tokens=4)
+        router.submit(_prompt(3, base=20), priority=1, max_new_tokens=4)
+        router.pump()
+        # dispatched highest-priority-first regardless of submit order
+        assert [p[1][0] for p in fake.puts] == [10, 20, 1]
+
+    def test_queue_full_rejection_at_cap(self):
+        fakes = [FakeReplica(0, latency=100, max_seqs=1),
+                 FakeReplica(1, latency=100, max_seqs=1)]
+        router = Router(fakes, policy="rr", sticky=False, queue_cap=2)
+        for _ in range(4):                       # 2 replicas x cap 2
+            router.submit(_prompt(3), max_new_tokens=4)
+        with pytest.raises(QueueFullRejection, match="queue cap"):
+            router.submit(_prompt(3), max_new_tokens=4)
+        assert router.stats()["rejected_queue_full"] == 1
+
+    def test_never_schedulable_rejected_at_front_door(self):
+        router = Router([FakeReplica(0)], sticky=False)
+        with pytest.raises(NeverSchedulableRejection, match="max_seq_len"):
+            router.submit(_prompt(60), max_new_tokens=30)
+        with pytest.raises(NeverSchedulableRejection, match="empty"):
+            router.submit(np.zeros(0, np.int32))
+        assert router.stats()["rejected_never_schedulable"] == 2
+        assert router.stats()["accepted"] == 0
+
+    def test_shed_at_burn_rate(self):
+        clock = FakeClock()
+        slo = SLOSet(["router_e2e_ms_p50 <= 10"], clock=clock)
+        router = Router([FakeReplica(0, max_seqs=8)], slo=slo,
+                        sticky=False, clock=clock)
+        for _ in range(4):                       # every sample breaches:
+            slo.record("router_e2e_ms", 100.0)   # burn = 1.0/0.5 = 2.0
+        with pytest.raises(ShedRejection, match="burn rate"):
+            router.submit(_prompt(3), max_new_tokens=4)
+        # protected priority is never shed
+        rid = router.submit(_prompt(3), priority=1, max_new_tokens=4)
+        assert rid in _drain(router)
+        assert router.stats()["rejected_shed"] == 1
+
+    def test_defer_holds_low_priority_only(self):
+        clock = FakeClock()
+        slo = SLOSet(["router_e2e_ms_p50 <= 10"], clock=clock)
+        router = Router([FakeReplica(0, latency=1, max_seqs=8)], slo=slo,
+                        sticky=False, clock=clock)
+        slo.record("router_e2e_ms", 100.0)       # 1 of 2 breaches:
+        slo.record("router_e2e_ms", 1.0)         # burn = 0.5/0.5 = 1.0
+        low = router.submit(_prompt(3, base=1), priority=0,
+                            max_new_tokens=4)
+        high = router.submit(_prompt(3, base=10), priority=1,
+                             max_new_tokens=4)
+        router.pump()
+        # high dispatched, low deferred (accepted, still queued)
+        assert router.queued == 1
+        assert router.handles[0].puts[0][1][0] == 10
+        # budget recovers -> the deferred request dispatches
+        clock.advance(1000.0)                    # window empties
+        router.pump()
+        assert router.queued == 0
+        outs = _drain(router)
+        assert set(outs) == {low, high}
+
+    def test_drain_overrides_defer(self):
+        clock = FakeClock()
+        slo = SLOSet(["router_e2e_ms_p50 <= 10"], clock=clock)
+        router = Router([FakeReplica(0, max_seqs=8)], slo=slo,
+                        sticky=False, clock=clock)
+        slo.record("router_e2e_ms", 100.0)       # burn = 1.0: defer
+        slo.record("router_e2e_ms", 1.0)         # range, not shed
+        rid = router.submit(_prompt(3), priority=0, max_new_tokens=4)
+        router.pump()
+        assert router.queued == 1                # held by defer
+        # shutdown drain dispatches regardless of burn rate
+        assert rid in _drain(router)
+
+
+class TestReplicaDeath:
+    def test_reroute_with_flight_dump(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DSTPU_FLIGHT_DIR", str(tmp_path))
+        fakes = [FakeReplica(0, latency=5, die_at_step=2),
+                 FakeReplica(1, latency=1)]
+        router = Router(fakes, policy="rr", sticky=False)
+        rids = [router.submit(_prompt(3, base=10 * i), max_new_tokens=4)
+                for i in range(4)]
+        outs = _drain(router)
+        # every accepted request still finished, on the survivor
+        assert set(outs) == set(rids)
+        s = router.stats()
+        assert s["replica_deaths"] == 1 and s["replicas_alive"] == 1
+        assert s["rerouted"] >= 1, s
+        assert fakes[0].closed
+        # the fault dumped a valid flight record naming the replica
+        path = flight.last_dump_path()
+        assert path is not None and str(tmp_path) in path
+        header, _events = read_flight_record(path)
+        assert header["reason"] == "replica_death_f0"
+        assert header["extra"]["replica"] == "f0"
+        assert header["extra"]["requeued_rids"], header["extra"]
+
+    def test_all_replicas_dead_raises(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DSTPU_FLIGHT_DIR", str(tmp_path))
+        router = Router([FakeReplica(0, latency=5, die_at_step=1)],
+                        policy="rr", sticky=False)
+        router.submit(_prompt(3), max_new_tokens=4)
+        with pytest.raises(RouterRejection, match="all replicas dead"):
+            router.drain()
+
+
+# -- integration against REAL engines ------------------------------------
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp                                     # noqa: E402
+
+from deepspeed_tpu.inference.v2 import RaggedInferenceEngineV2  # noqa: E402
+from deepspeed_tpu.models.llama import (LlamaForCausalLM,       # noqa: E402
+                                        get_config)
+from deepspeed_tpu.serving import ReplicaSet                    # noqa: E402
+
+CFG = get_config("tinyllama", vocab_size=64, hidden_size=32,
+                 intermediate_size=64, num_hidden_layers=2,
+                 num_attention_heads=4, num_key_value_heads=2,
+                 max_position_embeddings=128, dtype=jnp.float32,
+                 param_dtype=jnp.float32, scan_layers=True, remat=False,
+                 use_flash_attention=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = LlamaForCausalLM(CFG)
+    return jax.jit(model.init)(jax.random.PRNGKey(7),
+                               np.zeros((1, 8), np.int32))
+
+
+def _engine(params):
+    return RaggedInferenceEngineV2(
+        LlamaForCausalLM(CFG), params=params, pipeline=True,
+        rng=jax.random.PRNGKey(11), max_seqs=3, max_seq_len=128,
+        prefill_chunk=8, decode_block_size=4, harvest_interval=3)
+
+
+def _prompts(sizes, seed=3):
+    r = np.random.default_rng(seed)
+    return [r.integers(1, 64, size=(s,), dtype=np.int32) for s in sizes]
+
+
+def _single_engine_reference(params, prompts, max_new):
+    eng = _engine(params)
+    order = {eng.put_request(p, max_new_tokens=max_new): i
+             for i, p in enumerate(prompts)}
+    outs = {}
+    while eng.has_work():
+        eng.step()
+        for uid, toks in eng.get_outputs():
+            outs[order[uid]] = toks
+    eng.sync()
+    for uid, toks in eng.get_outputs():
+        outs[order[uid]] = toks
+    eng.close()
+    return outs
+
+
+class TestRoutedBitParity:
+    @pytest.mark.parametrize("policy", ["rr", "least_tokens", "pressure"])
+    def test_greedy_outputs_match_single_engine(self, params, policy):
+        prompts = _prompts((5, 9, 13, 7, 11, 6, 8, 10))
+        ref = _single_engine_reference(params, prompts, max_new=12)
+        rs = ReplicaSet(lambda i: _engine(params), 2)
+        try:
+            router = Router(rs, policy=policy)
+            rids = {router.submit(p, max_new_tokens=12): i
+                    for i, p in enumerate(prompts)}
+            outs = router.drain()
+            assert sorted(rids[r] for r in outs) == sorted(ref)
+            for rid, toks in outs.items():
+                np.testing.assert_array_equal(toks, ref[rids[rid]])
+            s = router.stats()
+            # anti-vacuity: BOTH replicas actually served traffic
+            assert s["routed_r0"] > 0 and s["routed_r1"] > 0, s
+            # router queue wait landed as its own series, per replica
+            for h in rs:
+                summ = h.engine.request_latency.summary()
+                if s[f"routed_{h.name}"]:
+                    assert summ["router_queue_wait_ms_p50"] is not None
+        finally:
+            rs.close()
+
+
+class TestNoRecompileAcrossReplicas:
+    def test_two_live_replicas_compile_nothing_new(self, params):
+        try:
+            from jax._src import test_util as jtu
+            counter = jtu.count_jit_compilation_cache_miss
+        except (ImportError, AttributeError):
+            pytest.skip("jax compilation-cache miss counter unavailable")
+        prompts = _prompts((5, 9, 13, 7, 11, 6))
+        rs = ReplicaSet(lambda i: _engine(params), 2)
+        try:
+            router = Router(rs, policy="rr")
+            for p in prompts:                    # warm both replicas
+                router.submit(p, max_new_tokens=8)
+            router.drain()
+            with counter() as misses:
+                for p in prompts:
+                    router.submit(p, max_new_tokens=8)
+                outs = router.drain()
+            assert len(outs) == len(prompts)
+            assert misses[0] == 0, (
+                f"{misses[0]} recompilations while serving through 2 "
+                "live replicas — routed steady state must reuse both "
+                "replicas' warm executables")
+        finally:
+            rs.close()

@@ -609,8 +609,8 @@ class TestEngineIntegration:
         assert st["trace_sampling"]["promoted_breach"] == 2
         assert st["trace_sampling"]["dropped"] == 0
         # the registry rode along: request histograms saw both reaps
-        assert registry.get("dstpu_request_ttft_ms").labels().merged(
-            )[2] == 2
+        assert registry.get("dstpu_request_ttft_ms").labels(
+            replica="").merged()[2] == 2
 
     def test_zero_new_compilations_with_metrics_and_sampling(
             self, registry, armed_tracer, engine_params):
