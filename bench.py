@@ -1361,6 +1361,51 @@ def bench_ragged(args) -> None:
             "nothing overlaps; speedup is not meaningful here "
             "(conservation + greedy bit-parity asserted instead)")
 
+    # closed-loop autotune: the online controller walks a deliberately
+    # mis-tuned engine (harvest=1, depth=1) back toward the hand-tuned
+    # base config above; the row records all three throughputs plus the
+    # decision trail (scripts/serve_smoke.py --autotune hard-gates
+    # convergence/guard/attribution — this is the measured record)
+    mis_kw = dict(harvest_interval=1, async_depth=1)
+    # the smoke workload is only ~9 host steps — stretch generation so
+    # the controller sees enough ticks to run whole probe trials
+    at_kw = run_kw if on_tpu else {**run_kw, "new": 40}
+    # decode_block=4 keeps a dispatch in (nearly) every host step, so
+    # the per-window blocking_gets_per_dispatch signal stays dense
+    at_block = decode_block if on_tpu else 4
+    at_tok, _, at_wall, _, _ = _ragged_run(
+        model, {"params": params}, decode_block=at_block,
+        **mis_kw, **at_kw)
+    # hand-tuned control on the SAME workload (engine defaults), so the
+    # three throughputs in the row are directly comparable
+    hd_tok, _, hd_wall, _, _ = _ragged_run(
+        model, {"params": params}, decode_block=at_block, **at_kw)
+    ctl_cfg = {"interval": 4, "settle": 1, "cooldown": 0,
+               "objective": "-blocking_gets_per_dispatch"}
+    cv_tok, _, cv_wall, _, cv_eng = _ragged_run(
+        model, {"params": params}, decode_block=at_block,
+        control=ctl_cfg, **mis_kw, **at_kw)
+    ctl = cv_eng._controller
+    assert ctl.counts["guard_violations"] == 0, (
+        f"oscillation guard violated: {ctl.counts}")
+    knob_end = ctl.knobs.snapshot()
+    detail["autotune"] = {
+        "mis_tuned": dict(mis_kw),
+        "mis_tuned_tokens_per_sec": round(at_tok / max(at_wall, 1e-9), 1),
+        "hand_tuned_tokens_per_sec": round(hd_tok / max(hd_wall, 1e-9), 1),
+        "converged_tokens_per_sec": round(cv_tok / max(cv_wall, 1e-9), 1),
+        "decisions": ctl.counts["decisions"],
+        "accepts": ctl.counts["accepts"],
+        "reverts": ctl.counts["reverts"],
+        "freezes": ctl.counts["freezes"],
+        "guard_violations": ctl.counts["guard_violations"],
+        "knob_end": {k: knob_end[k] for k in sorted(knob_end)},
+        "knob_trajectory": [
+            {"tick": d["tick"], "knob": d["knob"], "new": d["new"]}
+            for d in ctl.decision_log
+            if d["action"] in ("accept", "rule")],
+    }
+
     print(json.dumps({
         "metric": "ragged_continuous_batching_tokens_per_sec",
         "value": round(best_tps, 1),

@@ -160,6 +160,9 @@ class Autotuner:
         rec = {"zero_stage": stage, "micro_batch_size": mbs,
                self.metric_name: val,
                "tuning_seconds": time.perf_counter() - t0}
+        from deepspeed_tpu.autotuning.scheduler import \
+            record_experiment_metrics
+        record_experiment_metrics(val, rec["tuning_seconds"])
         self.records.append(rec)
         if val is not None and (self.best is None or val > self.best[1]):
             self.best = (cfg, val)

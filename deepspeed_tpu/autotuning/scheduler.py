@@ -90,6 +90,36 @@ def config_features(cfg: Dict[str, Any]) -> List[float]:
 # subprocess experiment scheduler (reference ResourceManager)
 # ---------------------------------------------------------------------------
 
+def record_experiment_metrics(metric_val: Optional[float],
+                              seconds: float) -> None:
+    """Mirror one experiment record into the MetricsRegistry.
+
+    The JSON sidecar (``exps_dir`` / ``Autotuner.records``) used to be
+    the only sink, so sweeps were invisible to ``trace_summarize
+    --metrics`` and the flight-dump header.  Registering here puts
+    experiment counts, wall seconds, and the running metric value in
+    every registry export — including the flight dump's embedded
+    metrics block — for free."""
+    from deepspeed_tpu.telemetry.metrics import metrics as _metrics
+
+    if not _metrics.enabled:
+        return
+    status = "ok" if metric_val is not None else "error"
+    _metrics.counter(
+        "dstpu_autotune_experiments_total",
+        "Autotuning experiments by outcome",
+        labels=("status",)).labels(status=status).inc()
+    _metrics.histogram(
+        "dstpu_autotune_experiment_seconds",
+        "Wall seconds per autotuning experiment").observe(
+            float(seconds))
+    if metric_val is not None:
+        _metrics.gauge(
+            "dstpu_autotune_last_metric",
+            "Most recent successful experiment's metric value").set(
+                float(metric_val))
+
+
 @dataclass
 class Experiment:
     exp_id: int
@@ -138,6 +168,7 @@ class ExperimentScheduler:
                           "metric_val": exp.metric_val,
                           "error": exp.error,
                           "seconds": round(exp.seconds, 3)}
+            record_experiment_metrics(exp.metric_val, exp.seconds)
             self.finished.append(exp)
             if self.exps_dir:
                 os.makedirs(self.exps_dir, exist_ok=True)
@@ -351,4 +382,10 @@ def tune_space(base_config: Dict[str, Any],
                   early_stopping=early_stopping)
     if best is not None:
         logger.info(f"autotuning best: {best.record}")
+        from deepspeed_tpu.telemetry.metrics import metrics as _metrics
+        if _metrics.enabled and best.metric_val is not None:
+            _metrics.gauge(
+                "dstpu_autotune_best_metric",
+                "Best metric value found by the last sweep").set(
+                    float(best.metric_val))
     return best

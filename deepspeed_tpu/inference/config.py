@@ -157,6 +157,52 @@ class PrefixCacheConfig(ConfigModel):
         return self
 
 
+class ControlConfig(ConfigModel):
+    """``v2.control`` subtree: the closed-loop autotuner.
+
+    ``enabled`` arms the online controller on the engine's host loop
+    (``DSTPU_CONTROL=0`` force-disarms regardless).  ``interval`` is
+    engine steps per controller tick.  ``settle`` ticks pass between a
+    hill-climb probe and its judgment; a relative objective change
+    inside ``±hysteresis`` is noise (quiet revert), below it is a
+    regression (revert + oscillation-guard bookkeeping: more than
+    ``guard_reverts`` regressions on one knob within ``guard_window``
+    ticks freezes that knob for ``freeze`` ticks).  ``cooldown`` ticks
+    block re-probing a just-reverted knob.  ``objective`` names the
+    signal to maximize (prefix ``-`` to minimize).  ``profile`` points
+    at a per-host profile file or directory that seeds knob values at
+    construction (fingerprint-checked; a foreign host's profile is
+    ignored)."""
+
+    enabled: bool = False
+    interval: int = 8
+    settle: int = 2
+    hysteresis: float = 0.05
+    cooldown: int = 4
+    guard_window: int = 16
+    guard_reverts: int = 2
+    freeze: int = 32
+    smooth: float = 1.0
+    objective: str = "throughput"
+    profile: Optional[str] = None
+
+    @model_validator(mode="after")
+    def _check(self):
+        for name in ("interval", "settle", "guard_window",
+                     "guard_reverts", "freeze"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"control.{name} must be >= 1")
+        if self.cooldown < 0:
+            raise ValueError("control.cooldown must be >= 0")
+        if self.hysteresis < 0:
+            raise ValueError("control.hysteresis must be >= 0")
+        if not 0.0 < self.smooth <= 1.0:
+            raise ValueError("control.smooth must be in (0, 1]")
+        if not self.objective.lstrip("-"):
+            raise ValueError("control.objective must name a signal")
+        return self
+
+
 class InferenceV2Config(ConfigModel):
     """``v2`` subtree: the serving host-path pipeline knobs.
 
@@ -184,6 +230,7 @@ class InferenceV2Config(ConfigModel):
     kv_tiering: KVTieringConfig = Field(default_factory=KVTieringConfig)
     prefix_cache: PrefixCacheConfig = Field(
         default_factory=PrefixCacheConfig)
+    control: ControlConfig = Field(default_factory=ControlConfig)
     # SLO objectives ("ttft_ms_p99 <= 150"-style strings) fed at reap
     # time; serving_stages()["slo"] reports the rolling budget burn.
     # Empty = no objectives.

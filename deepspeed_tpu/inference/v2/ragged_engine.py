@@ -237,6 +237,7 @@ class RaggedInferenceEngineV2:
                  slo: Any = None,
                  trace_sample: Optional[int] = None,
                  replica: Optional[str] = None,
+                 control: Any = None,
                  config: Any = None):
         """``kv_cache_dtype``: ``None`` (config subtree
         ``v2.kv_cache_dtype`` decides; "none" by default) | "none" |
@@ -361,6 +362,7 @@ class RaggedInferenceEngineV2:
             slo = (v2cfg.slo if slo is None else slo)
             trace_sample = (v2cfg.trace_sample if trace_sample is None
                             else trace_sample)
+            control = v2cfg.control if control is None else control
         kv_cache_dtype = ("none" if kv_cache_dtype is None
                           else str(kv_cache_dtype))
         assert kv_cache_dtype in ("none", "int8", "fp8", "fp8_e4m3"), (
@@ -678,6 +680,50 @@ class RaggedInferenceEngineV2:
             f"{tier_note} "
             f"(paged KV, fused SplitFuse step)", ranks=[0])
 
+        # -- closed-loop control plane (deepspeed_tpu.control) --
+        # Ticks on this host loop (step() counts engine steps); no
+        # thread of its own.  DSTPU_CONTROL=0 disarms regardless of
+        # config, leaving the structurally pre-control engine.
+        from deepspeed_tpu.inference.config import ControlConfig
+
+        if control is None:
+            control = ControlConfig()
+        elif isinstance(control, bool):
+            control = ControlConfig(enabled=control)
+        elif isinstance(control, dict):
+            control = ControlConfig(**{"enabled": True, **control})
+        self._control_cfg = control
+        self._controller = None
+        self._control_steps = 0
+        if control.enabled:
+            from deepspeed_tpu.control import (Controller, control_enabled,
+                                               engine_signal_feed,
+                                               load_profile, prefetch_rule)
+            if control_enabled():
+                knobs = self.knob_registry()
+                prof = load_profile(control.profile)
+                if prof is not None:
+                    # profile seeding runs pre-warmup, so recompiling
+                    # knobs (decode_block, spec k) are still fair game
+                    applied = knobs.apply_profile(prof.knobs)
+                    if applied:
+                        log_dist(
+                            f"control plane seeded from host profile "
+                            f"{prof.key}: {applied}", ranks=[0])
+                rules = []
+                if self.tiering is not None:
+                    rules.append(prefetch_rule())
+                self._controller = Controller(
+                    knobs, engine_signal_feed(self),
+                    objective=control.objective,
+                    settle=control.settle,
+                    hysteresis=control.hysteresis,
+                    cooldown=control.cooldown,
+                    guard_window=control.guard_window,
+                    guard_reverts=control.guard_reverts,
+                    freeze=control.freeze, smooth=control.smooth,
+                    rules=rules)
+
     # -- parameter / cache placement (TP) --------------------------------
 
     def _place_params(self, params):
@@ -841,6 +887,85 @@ class RaggedInferenceEngineV2:
         outs.update(self.get_outputs())
         return outs
 
+    def knob_registry(self):
+        """The engine's typed knob surface for the control plane
+        (:class:`~deepspeed_tpu.control.knobs.KnobRegistry`).
+
+        Online-safe knobs: ``harvest_interval`` is read fresh each
+        pipelined step; ``async_depth`` re-sizes the live decode window
+        in place (the substrate back-pressures on the next submit).
+        ``decode_block_size`` and ``spec_k`` are baked into compiled
+        block shapes, so they carry ``recompiles=True`` — reachable only
+        by the offline sweep / profile seeding, never the online policy
+        (the zero-new-compilations contract).  With tiering on, the
+        tier store's prefetch toggle and IO-window depths ride along
+        under ``kv.*``."""
+        from deepspeed_tpu.control.knobs import Knob, KnobRegistry
+
+        reg = KnobRegistry()
+
+        def _set_harvest(v):
+            self.harvest_interval = max(int(v), 1)
+
+        def _set_depth(v):
+            self.async_depth = max(int(v), 1)
+            if self._dev is not None:
+                self._dev["window"].depth = self.async_depth
+
+        def _set_block(v):
+            self.decode_block_size = max(int(v), 1)
+            self._decode_block_cache.clear()
+
+        reg.register(Knob(
+            "engine.harvest_interval",
+            lambda: self.harvest_interval, _set_harvest,
+            lo=1, hi=16, step=1, kind="int",
+            doc="pipelined decode blocks between token harvests"))
+        reg.register(Knob(
+            "engine.async_depth",
+            lambda: self.async_depth, _set_depth,
+            lo=1, hi=8, step=1, kind="int",
+            doc="in-flight decode blocks in the pipeline window"))
+        reg.register(Knob(
+            "engine.decode_block_size",
+            lambda: self.decode_block_size, _set_block,
+            lo=1, hi=16, step=1, kind="int", recompiles=True,
+            doc="device ticks per decode block (compiled shape)"))
+        if self.spec_mode != "off":
+            def _set_spec_k(v):
+                self.spec_k = max(int(v), 1)
+                self._spec_block_cache.clear()
+
+            reg.register(Knob(
+                "engine.spec_k", lambda: self.spec_k, _set_spec_k,
+                lo=1, hi=8, step=1, kind="int", recompiles=True,
+                doc="speculative draft length (compiled shape)"))
+        if self.tiering is not None:
+            t = self.tiering
+
+            def _set_prefetch(v):
+                t.prefetch_enabled = bool(v) and t.nvme_budget > 0
+
+            def _set_wdepth(v):
+                t._writes.depth = max(int(v), 1)
+
+            def _set_rdepth(v):
+                t._reads.depth = max(int(v), 1)
+
+            reg.register(Knob(
+                "kv.prefetch", lambda: t.prefetch_enabled,
+                _set_prefetch, kind="bool",
+                doc="NVMe read-ahead on tier restore"))
+            reg.register(Knob(
+                "kv.write_depth", lambda: t._writes.depth, _set_wdepth,
+                lo=1, hi=8, step=1, kind="int",
+                doc="bounded spill write-back window depth"))
+            reg.register(Knob(
+                "kv.read_depth", lambda: t._reads.depth, _set_rdepth,
+                lo=1, hi=8, step=1, kind="int",
+                doc="bounded restore read-ahead window depth"))
+        return reg
+
     def serving_stages(self) -> Dict[str, Any]:
         """Per-dispatch host-path breakdown + ``host_bound_fraction``
         (see :class:`~deepspeed_tpu.inference.common.HostStageStats`);
@@ -881,6 +1006,8 @@ class RaggedInferenceEngineV2:
             # the pipelined decode window's substrate counters
             # (submitted/completed blocks, submit_wait back-pressure)
             out["pipeline_window"] = self._pipe_timers.snapshot()
+        if self._controller is not None:
+            out["control"] = self._controller.stats()
         out["requests"] = self.request_latency.summary()
         if self.slo is not None:
             out["slo"] = self.slo.flat_summary()
@@ -1832,6 +1959,12 @@ class RaggedInferenceEngineV2:
         dispatches when ``pipeline=True``; any prefilling sequence
         falls back to the fused SplitFuse tick."""
         self._sched_seq += 1
+        if self._controller is not None:
+            self._control_steps += 1
+            if self._control_steps >= self._control_cfg.interval:
+                self._control_steps = 0
+                with self.host_stats.stage("plan"):
+                    self._controller.tick()
         if self._dev is not None:
             return self._pipeline_step()
         st = self.host_stats

@@ -64,6 +64,7 @@ import hashlib
 import os
 import re
 import shutil
+import time
 from functools import partial
 from typing import Any, Dict, Optional, Tuple
 
@@ -530,12 +531,16 @@ class NvmeOptimizerSwapper:
         # reference: bit-identical state, no overlap).
         self.pipeline_read = bool(pipeline_read)
         self.pipeline_write = bool(pipeline_write)
+        self._buffer_count = max(1, int(buffer_count))
         self._nbuf = max(2, int(buffer_count)) if self.pipeline_read else 1
         self._write_depth = (max(1, int(buffer_count) - 1)
                              if self.pipeline_write else 0)
         self._use_odirect = bool(aio_use_odirect)
-        self._prefetched: Optional[dict] = None
-        self._deferred_writes: list = []    # (op, arr, kb) past-apply()
+        # live prefetch marker: how many bucket reads the read-ahead
+        # window already carries into the next apply() (None = no
+        # prefetch outstanding)
+        self._prefetched: Optional[int] = None
+        self._req_buffer_count: Optional[int] = None
         # -- silent-data-corruption defense (resilience.sdc): every
         # bucket/shard the stream writes is digested (on a side thread,
         # overlapped with the in-flight IO) and re-checked on swap-in
@@ -562,8 +567,31 @@ class NvmeOptimizerSwapper:
         # (the one telemetry schema: <stage>_s floats + raw counters),
         # which also re-emits each stage as a tracer span when tracing
         # is on; stage_stats composes its snapshot with derived metrics
-        from deepspeed_tpu.utils.async_stage import StageTimers
+        from deepspeed_tpu.utils.async_stage import (BoundedAsyncStage,
+                                                     StageTimers)
         self.stage_timers = StageTimers(cat="swap")
+        # The read-ahead and write-back windows live on the shared
+        # bounded-async-stage substrate (the same skeleton the serving
+        # pipeline and the tiered KV store compose): the read window
+        # holds up to ``_nbuf`` keyed bucket preads (poller-backed so
+        # harvest can consume completed reads opportunistically, in
+        # bucket order, without blocking on ones still in flight); the
+        # write window bounds in-flight bucket write-backs at
+        # ``_write_depth`` via submit back-pressure, and ops left in it
+        # past apply() ARE the deferred write-backs (settled at the
+        # forced-drain points: start_prefetch / the next apply / drain).
+        # Both windows get their own timers so substrate-internal
+        # brackets (submit_wait/drain) don't leak extra keys into
+        # ``stage_stats`` — the stream's own t_in/t_out brackets below
+        # keep the historical swap_in_wait/swap_out_wait meaning.
+        self._reads = BoundedAsyncStage(
+            waiter=self._read_waiter, poller=self._read_poller,
+            depth=self._nbuf, timers=StageTimers(cat="swap"),
+            name="swap_readahead")
+        self._writes = BoundedAsyncStage(
+            waiter=self._write_waiter, depth=max(1, self._write_depth),
+            timers=StageTimers(cat="swap"), name="swap_writeback")
+        self._swap_out_wait = 0.0           # waiter-side t_out accumulator
         self.stage_stats: Dict[str, Any] = {}
         # leafwise-stream IO accounting (incremented where reads/writes
         # are actually submitted; _apply_leafwise resets per apply and
@@ -864,7 +892,7 @@ class NvmeOptimizerSwapper:
         ``leaf``; entries are None where moments are zero-init."""
         dt = self._meta[key][2]
         loc = self._item_loc.get(key)
-        if loc is not None and self._deferred_writes:
+        if loc is not None and self._writes.in_flight:
             # a deferred write-back may still be in flight against the
             # bucket file this read targets — settle it first
             self._drain_deferred()
@@ -1142,6 +1170,54 @@ class NvmeOptimizerSwapper:
         return (self.handle.async_pread(view, self._bucket_fname(kb), 0),
                 view)
 
+    # window adapters: the substrate only knows ``op``s — for reads
+    # that is the ``(aio_op, staged view)`` pair _issue_read returns
+    # (or None for a zero-init bucket: no file, no IO, joins
+    # instantly), for writes the ``(aio_op, pinned array, kb)`` triple
+    # _finish_write's retry path needs.
+
+    def _read_waiter(self, st: Optional[tuple]) -> Optional[np.ndarray]:
+        if st is None:
+            return None
+        self.handle.wait(st[0])
+        return st[1]
+
+    def _read_poller(self, st: Optional[tuple]) -> bool:
+        return st is None or self.handle.poll(st[0]) is not None
+
+    def _write_waiter(self, ent: tuple) -> None:
+        op, arr, kb = ent
+        t0 = time.perf_counter()
+        self._finish_write(op, arr, kb)
+        self._swap_out_wait += time.perf_counter() - t0
+
+    # -- the buffer_count knob (runtime-safe) ----------------------------
+
+    @property
+    def buffer_count(self) -> int:
+        return self._buffer_count
+
+    def set_buffer_count(self, n: int) -> None:
+        """Resize the read/write windows at the next safe point (the
+        next apply()/prefetch entry with no read-ahead in flight) —
+        the controller's runtime knob.  Numerics are unaffected: the
+        pipelined and serial streams are bit-identical by the parity
+        contract, and the window shape only changes overlap."""
+        self._req_buffer_count = max(1, int(n))
+
+    def _apply_requested_buffer_count(self) -> None:
+        if self._req_buffer_count is None or self._reads.in_flight:
+            return
+        n, self._req_buffer_count = self._req_buffer_count, None
+        if n == self._buffer_count:
+            return
+        self._buffer_count = n
+        self._nbuf = max(2, n) if self.pipeline_read else 1
+        self._write_depth = (max(1, n - 1) if self.pipeline_write else 0)
+        self._read_bufs = None              # re-sized lazily
+        self._reads.depth = self._nbuf
+        self._writes.depth = max(1, self._write_depth)
+
     def start_prefetch(self) -> None:
         """Issue the first read-ahead window's bucket reads (and settle
         any write-backs deferred from the previous step) so the stream's
@@ -1159,21 +1235,22 @@ class NvmeOptimizerSwapper:
             # apply() that follows streams zero-init moments — don't
             # kill the in-flight fwd/bwd from a prefetch
             return
+        self._apply_requested_buffer_count()
         self._ensure_read_bufs()
-        self._prefetched = {
-            kb: self._issue_read(kb)
-            for kb in range(min(self._nbuf, len(self._buckets)))}
+        n = min(self._nbuf, len(self._buckets))
+        for kb in range(n):
+            self._reads.submit(kb, self._issue_read(kb))
+        self._prefetched = n
 
     def cancel_prefetch(self) -> None:
         """Settle prefetched reads without consuming them (overflow
         skipped the step, or the stream fell back leafwise)."""
-        pf, self._prefetched = self._prefetched, None
-        for st in (pf or {}).values():
-            if st is not None:
-                try:
-                    self.handle.wait(st[0])
-                except Exception:
-                    pass
+        self._prefetched = None
+        for key in self._reads.keys():
+            try:
+                self._reads.pop(key)
+            except Exception:
+                pass
 
     def _submit_bucket_write(self, kb: int, arr: np.ndarray) -> int:
         from deepspeed_tpu.io.aio import _pretruncate
@@ -1226,14 +1303,14 @@ class NvmeOptimizerSwapper:
         persistent failure means that bucket's on-disk moments are STALE
         relative to params the step already committed — invalidate
         (moments restart zero-init) and re-raise."""
-        dw, self._deferred_writes = self._deferred_writes, []
-        err = None
-        for op, arr, kb in dw:
-            try:
-                self._finish_write(op, arr, kb)
-            except Exception as e:
-                err = err or e
-        if err is not None:
+        if self._writes.in_flight == 0:
+            return
+        try:
+            # drain(): joins EVERYTHING even after one fails, raising
+            # the first error only after the sweep — the invalidation
+            # contract (no op left racing a reused buffer)
+            self._writes.drain()
+        except Exception:
             logger.error(
                 "NVMe swap: deferred bucket write-back failed after its "
                 "step committed — on-disk moments are stale; "
@@ -1242,7 +1319,7 @@ class NvmeOptimizerSwapper:
             self._initialized.clear()
             self._bucket_ready.clear()
             self._sdc_clear()
-            raise err
+            raise
 
     def _apply_bucketed(self, params: Any, grads: Any, *, lr,
                         gscale) -> Any:
@@ -1255,7 +1332,6 @@ class NvmeOptimizerSwapper:
         every call.  Failure invalidates the swap state exactly like the
         leafwise path (moments restart zero-init)."""
         import time as _time
-        from collections import deque
 
         from deepspeed_tpu.checkpoint.sharded import path_str
         from deepspeed_tpu.io.aio import aligned_empty
@@ -1264,18 +1340,20 @@ class NvmeOptimizerSwapper:
         try:
             self._drain_deferred()
         except Exception:
-            self._prefetched = prefetched
             self.cancel_prefetch()
             raise
         if self._items_dirty:
             # a leafwise fallback wrote item files for plan keys — fold
             # them back into bucket files before streaming (prefetched
             # reads, if any, predate the fold and are discarded)
-            self._prefetched = prefetched
             self.cancel_prefetch()
             prefetched = None
             self._assemble_buckets_from_items()
             self._items_dirty = False
+        if prefetched is None:
+            # no read-ahead carried in: the safe point for a pending
+            # buffer_count knob change (windows empty, writes drained)
+            self._apply_requested_buffer_count()
         self.count += 1
         count = np.float32(self.count)
         lr = np.float32(lr)
@@ -1294,10 +1372,11 @@ class NvmeOptimizerSwapper:
         pipelined = self._nbuf > 1
         t_in = t_up = t_out = t_verify = 0.0
         bytes_read = bytes_written = 0
+        self._swap_out_wait = 0.0
         t_begin = _time.perf_counter()
 
-        pending: Dict[int, Optional[tuple]] = dict(prefetched or {})
-        next_issue = (max(pending) + 1) if pending else 0
+        reads, writes = self._reads, self._writes
+        next_issue = int(prefetched or 0)   # prefetch = reads 0..n-1 live
         ready: Dict[int, Optional[np.ndarray]] = {}   # harvested views
         verify_futs: Dict[int, Any] = {}              # kb -> digest future
         harvest_next = 0
@@ -1307,38 +1386,37 @@ class NvmeOptimizerSwapper:
             # previous tenant was bucket j - nbuf — only re-issue once
             # that bucket's compute has been FORCED (its output fetch in
             # flush()), or an in-flight dispatch could still be reading
-            # the buffer the new pread scribbles into
+            # the buffer the new pread scribbles into.  The loop keeps
+            # the read window at most ``_nbuf`` deep, so submit's own
+            # back-pressure never fires (a forced join there would
+            # consume a read outside harvest's bookkeeping).
             nonlocal next_issue
             while next_issue <= min(limit, nb - 1):
-                pending[next_issue] = self._issue_read(next_issue)
+                reads.submit(next_issue, self._issue_read(next_issue))
                 next_issue += 1
 
         def harvest(block_upto: int = -1) -> None:
-            # move completed reads, IN BUCKET ORDER, from `pending` to
-            # `ready`: the swap.read_bucket fault site fires and the
-            # read-side digest job is submitted at completion time, so
-            # verification runs on the side pool while later buckets'
-            # IO and earlier buckets' compute are still in flight —
-            # the check rides the read-ahead window, not the critical
-            # path.  Buckets <= block_upto are waited; later ones are
-            # harvested only if their read already completed.
+            # pop completed reads, IN BUCKET ORDER, from the read
+            # window into `ready`: the swap.read_bucket fault site
+            # fires and the read-side digest job is submitted at
+            # completion time, so verification runs on the side pool
+            # while later buckets' IO and earlier buckets' compute are
+            # still in flight — the check rides the read-ahead window,
+            # not the critical path.  Buckets <= block_upto are waited;
+            # later ones are harvested only if their read already
+            # completed (the window's poller-backed ready()).
             nonlocal harvest_next, t_in, bytes_read
-            while harvest_next < nb and harvest_next in pending:
+            while harvest_next < nb and harvest_next in reads:
                 kb2 = harvest_next
-                st2 = pending[kb2]
-                if st2 is None:
-                    pending.pop(kb2)
-                    ready[kb2] = None
-                    harvest_next += 1
-                    continue
-                if (kb2 > block_upto
-                        and self.handle.poll(st2[0]) is None):
+                if kb2 > block_upto and not reads.ready(kb2):
                     break
                 t0 = _time.perf_counter()
-                self.handle.wait(st2[0])
+                view = reads.pop(kb2)
                 t_in += _time.perf_counter() - t0
-                pending.pop(kb2)
-                view = st2[1]
+                harvest_next += 1
+                if view is None:          # zero-init bucket: no file
+                    ready[kb2] = None
+                    continue
                 bytes_read += view.nbytes
                 action = _faults.hook("swap.read_bucket",
                                       path=self._bucket_fname(kb2))
@@ -1349,17 +1427,6 @@ class NvmeOptimizerSwapper:
                     verify_futs[kb2] = self._pool().submit(
                         self._digest, view)
                 ready[kb2] = view
-                harvest_next += 1
-
-        write_q: Any = deque()            # (op, staged array, kb)
-
-        def reap(budget: int) -> None:
-            nonlocal t_out
-            while len(write_q) > budget:
-                op, arr, kb = write_q.popleft()
-                t0 = _time.perf_counter()
-                self._finish_write(op, arr, kb)
-                t_out += _time.perf_counter() - t0
 
         def flush(entry) -> None:
             nonlocal t_up, t_out, bytes_written
@@ -1374,20 +1441,26 @@ class NvmeOptimizerSwapper:
                 a[:] = mv_np.ravel()
                 mv_np = a
             try:
-                write_q.append((self._submit_bucket_write(kb, mv_np),
-                                mv_np, kb))
+                op = self._submit_bucket_write(kb, mv_np)
             except OSError:
                 # submit-time failure (e.g. preallocation): blocking
                 # retry path, same as a failed in-flight op
                 t0 = _time.perf_counter()
                 self._sync_rewrite_bucket(kb, mv_np)
                 t_out += _time.perf_counter() - t0
+                op = None
+            if op is not None:
+                # submit's back-pressure IS the write bound: past
+                # ``_write_depth`` in flight it joins the oldest first
+                # (through the timed waiter — the old reap())
+                writes.submit(kb, (op, mv_np, kb))
             # write-side digest on the side pool, overlapped with the
-            # write it describes (mv_np is pinned by the write queue
-            # until reaped, so the job races nothing)
+            # write it describes (mv_np is pinned by the write window
+            # until joined, so the job races nothing)
             self._note_bucket_sum(kb, mv_np)
             bytes_written += mv_np.nbytes
-            reap(self._write_depth)       # bound in-flight write buffers
+            if self._write_depth == 0:
+                writes.drain()            # serial mode: settle now
             self._bucket_ready.add(kb)
             for it in buckets[kb]["items"]:
                 self._initialized.add((it["key"], it["tag"]))
@@ -1442,28 +1515,25 @@ class NvmeOptimizerSwapper:
                 prev_out = (kb, mv_out)
             if prev_out is not None:
                 flush(prev_out)
-            if self.pipeline_write and write_q:
-                # trailing write-backs drain under the NEXT step's
-                # fwd/bwd (settled in start_prefetch / the next apply /
-                # drain); their buffers stay pinned in the deferred list
-                self._deferred_writes.extend(write_q)
-                write_q.clear()
-            else:
-                reap(0)
+            if not self.pipeline_write:
+                writes.drain()            # reap(0): settle every write
+            # else: trailing write-backs stay in the write window and
+            # drain under the NEXT step's fwd/bwd (settled at the
+            # forced points: start_prefetch / the next apply / drain);
+            # their buffers stay pinned by the window until joined
             ok = True
         finally:
-            for st in pending.values():
-                if st is not None:
-                    try:
-                        self.handle.wait(st[0])
-                    except Exception:
-                        pass
-            err = None
-            for op, arr, kb in write_q:
+            for key in reads.keys():
                 try:
-                    self._finish_write(op, arr, kb)
+                    reads.pop(key)
+                except Exception:
+                    pass
+            err = None
+            if not ok and writes.in_flight:
+                try:
+                    writes.drain()
                 except Exception as e:
-                    err = err or e
+                    err = e
             if not ok or err is not None:
                 logger.error(
                     "NVMe optimizer bucketed apply() failed mid-stream; "
@@ -1477,6 +1547,10 @@ class NvmeOptimizerSwapper:
             if ok and err is not None:
                 raise err
         total = _time.perf_counter() - t_begin
+        # the write window's waiter timed every join it performed
+        # (back-pressure and drains alike) into the accumulator — that
+        # plus the sync-fallback residual is the historical t_out
+        t_out += self._swap_out_wait
         st = self.stage_timers
         st.reset()
         # swap_verify is the main-thread residual of swap-in

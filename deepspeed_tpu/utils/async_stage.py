@@ -132,12 +132,20 @@ class BoundedAsyncStage:
         bound generalized.
     timers:
         optional shared :class:`StageTimers`; one is created if absent.
+    poller:
+        optional ``poller(op) -> bool`` non-blocking completion probe
+        (e.g. wraps ``aio_handle.poll``).  Enables :meth:`ready`, the
+        opportunistic-harvest check the swap read-ahead needs: consume
+        completed reads in submission order without blocking on ones
+        still in flight.
     """
 
     def __init__(self, waiter: Callable[[Any], Any], depth: int = 2,
                  timers: Optional[StageTimers] = None,
-                 name: str = "stage") -> None:
+                 name: str = "stage",
+                 poller: Optional[Callable[[Any], bool]] = None) -> None:
         self._waiter = waiter
+        self._poller = poller
         self.depth = max(1, int(depth))
         self.name = name
         self.timers = timers if timers is not None else StageTimers()
@@ -156,6 +164,19 @@ class BoundedAsyncStage:
 
     def keys(self) -> List[Any]:
         return list(self._inflight)
+
+    def ready(self, key: Any) -> bool:
+        """Non-blocking: would ``pop(key)`` return without waiting?
+        Requires a ``poller``; a poller-less stage conservatively
+        reports not-ready for every live key (callers fall back to
+        their blocking join point).  Unknown keys are trivially ready
+        (``pop`` would return the default immediately)."""
+        ent = self._inflight.get(key)
+        if ent is None:
+            return True
+        if self._poller is None:
+            return False
+        return bool(self._poller(ent[0]))
 
     # -- the three verbs -------------------------------------------------
 

@@ -15,6 +15,13 @@ separate host devices; without it they interleave on one device
 (bit-identical results, no wall-clock overlap).
 
     python examples/serve_llama.py --replicas 2 --router-policy pressure
+
+Closed-loop control (``--control``) arms the online controller on the
+serving host loop; ``--autotune DIR`` first runs the offline knob
+sweep and saves a per-host profile the controller seeds from.
+
+    python examples/serve_llama.py --control
+    python examples/serve_llama.py --autotune /tmp/dstpu_profiles
 """
 import argparse
 
@@ -76,6 +83,18 @@ def main() -> None:
                    choices=["rr", "least_tokens", "pressure"],
                    default="least_tokens",
                    help="router load-balancing policy for --replicas>1")
+    p.add_argument("--control", action="store_true",
+                   help="arm the closed-loop controller on the serving "
+                        "host loop: adapts harvest/depth/tiering knobs "
+                        "from live signals (DSTPU_CONTROL=0 disarms)")
+    p.add_argument("--control-profile", default=None,
+                   help="host-profile .json or dir that seeds the "
+                        "controller's starting knobs (see --autotune)")
+    p.add_argument("--autotune", metavar="DIR", default=None,
+                   help="offline knob sweep on a short probe workload "
+                        "first; saves a per-host profile (fingerprinted "
+                        "by cores/device/NVMe) under DIR, then serves "
+                        "with the controller seeded from it")
     args = p.parse_args()
 
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -104,6 +123,50 @@ def main() -> None:
         tiering = {"host_pages": args.kv_host_pages,
                    "nvme_pages": args.kv_nvme_pages,
                    "nvme_dir": args.kv_nvme_dir}
+
+    if args.autotune is not None:
+        # offline sweep: measure a short probe workload at each knob
+        # point, persist the winner keyed by this host's fingerprint
+        import time
+
+        from deepspeed_tpu.control import autotune_serving
+
+        probe_rng = np.random.default_rng(1)
+        probe = [probe_rng.integers(1, cfg.vocab_size, size=(n,),
+                                    dtype=np.int32)
+                 for n in (5, 17, 9)]
+
+        def probe_runner(point):
+            eng = RaggedInferenceEngineV2(
+                model, params=params, max_seqs=args.max_seqs,
+                max_seq_len=args.max_seq_len, prefill_chunk=64,
+                harvest_interval=int(
+                    point.get("engine.harvest_interval", 4)),
+                async_depth=int(point.get("engine.async_depth", 2)))
+            t0 = time.perf_counter()
+            outs = eng.generate_all(list(probe), max_new_tokens=16)
+            return sum(t.size for t in outs.values()) / (
+                time.perf_counter() - t0)
+
+        prof = autotune_serving(
+            probe_runner,
+            {"engine.harvest_interval": [1, 2, 4, 8],
+             "engine.async_depth": [1, 2, 4]},
+            save_to=args.autotune)
+        if prof is None:
+            raise SystemExit("autotune: every sweep point failed")
+        print(f"autotune: host {prof.key} best knobs {prof.knobs} "
+              f"({prof.metric_name}={prof.metric:.1f}), profile saved "
+              f"under {args.autotune}")
+        args.control = True
+        if args.control_profile is None:
+            args.control_profile = args.autotune
+
+    control = None
+    if args.control or args.control_profile:
+        control = ({"profile": args.control_profile}
+                   if args.control_profile else True)
+
     def build_engine(replica_idx: int = 0) -> RaggedInferenceEngineV2:
         return RaggedInferenceEngineV2(
             model, params=params, max_seqs=args.max_seqs,
@@ -112,7 +175,7 @@ def main() -> None:
             harvest_interval=args.harvest_interval,
             speculation={"mode": args.spec_mode, "k": args.spec_k},
             kv_cache_dtype=args.kv_cache_dtype, kv_tiering=tiering,
-            prefix_cache=args.prefix_cache, **spec_kw)
+            prefix_cache=args.prefix_cache, control=control, **spec_kw)
 
     # a burst of variable-length "requests"; with --prefix-cache they
     # share a common system prompt so later admissions hit the index
@@ -174,6 +237,13 @@ def main() -> None:
           " ".join(f"{k}={stages[k]}" for k in
                    ("plan_ms", "upload_ms", "dispatch_ms", "device_ms",
                     "harvest_ms", "host_bound_fraction")))
+    ctl = stages.get("control")
+    if ctl:
+        print("control: " +
+              " ".join(f"{k}={ctl[k]}" for k in
+                       ("ticks", "decisions", "accepts", "reverts",
+                        "freezes", "guard_violations", "objective")) +
+              f" knobs={ctl['knobs']}")
     spec = stages.get("speculation")
     if spec:
         print("speculation: " +

@@ -17,9 +17,14 @@ dump) into a terminal report:
   histograms with count/sum/p50/p90/p99;
 - ``--slo``: render only the SLO objective table (window samples,
   breaches, error rate, budget burn) from a metrics export;
+- ``--control``: render the closed-loop control plane's decision log
+  (tick, action, knob, old -> new, the signal that motivated it) from
+  the ``cat="control"`` events any chrome/flight export carries when
+  the controller ran with the tracer on;
 - ``--validate``: schema gate (used by ``serve_smoke.py --trace`` /
   ``--metrics``) — exits nonzero on a malformed file instead of
-  printing a report; covers all three formats.
+  printing a report; covers all three formats plus the
+  ``control_decision`` span schema.
 
 Usage::
 
@@ -42,6 +47,10 @@ from deepspeed_tpu.telemetry import (percentile, read_flight_record,  # noqa: E4
 
 # the ph values the tracer emits: complete spans, instants, metadata
 _KNOWN_PH = {"X", "i", "M"}
+
+# the control plane's decision vocabulary (controller.Controller)
+_CONTROL_ACTIONS = {"probe", "accept", "revert", "settle", "rule",
+                    "freeze", "unfreeze"}
 
 
 def load_events(path: str) -> Tuple[List[Dict[str, Any]], str]:
@@ -180,6 +189,25 @@ def validate_events(events: List[Dict[str, Any]]) -> List[str]:
             if not isinstance(dur, (int, float)) or dur < 0:
                 problems.append(f"event {i} ({ev.get('name')}): "
                                 f"bad dur {dur!r}")
+        if (ev.get("cat") == "control" and ph == "i"
+                and ev.get("name") == "control_decision"):
+            # control decisions are a reconstruction contract: every
+            # knob change must name its tick, action, knob, and the
+            # signal that motivated it
+            a = ev.get("args", {})
+            if not isinstance(a.get("tick"), int) or a["tick"] < 1:
+                problems.append(f"event {i}: control_decision "
+                                f"bad tick {a.get('tick')!r}")
+            if a.get("action") not in _CONTROL_ACTIONS:
+                problems.append(f"event {i}: control_decision "
+                                f"unknown action {a.get('action')!r}")
+            for key in ("knob", "signal"):
+                if not isinstance(a.get(key), str) or not a[key]:
+                    problems.append(f"event {i}: control_decision "
+                                    f"missing {key}")
+            if "old" not in a or "new" not in a:
+                problems.append(f"event {i}: control_decision missing "
+                                "old/new values")
         if len(problems) >= 20:
             problems.append("... (stopping after 20 problems)")
             break
@@ -248,6 +276,55 @@ def summarize_requests(events: List[Dict[str, Any]]
     return reqs
 
 
+def summarize_control(events: List[Dict[str, Any]]
+                      ) -> List[Dict[str, Any]]:
+    """The control plane's decision log, reconstructed from
+    ``cat="control"`` instants in submission order."""
+    rows = []
+    for ev in events:
+        if (ev.get("cat") != "control" or ev.get("ph") != "i"
+                or ev.get("name") != "control_decision"):
+            continue
+        a = ev.get("args", {})
+        rows.append({"ts": ev.get("ts", 0),
+                     "tick": a.get("tick"), "action": a.get("action"),
+                     "knob": a.get("knob"), "old": a.get("old"),
+                     "new": a.get("new"), "signal": a.get("signal"),
+                     "objective": a.get("objective"),
+                     "gain": a.get("gain")})
+    rows.sort(key=lambda r: (r["ts"], r["tick"] or 0))
+    return rows
+
+
+def print_control_report(path: str, events: List[Dict[str, Any]],
+                         kind: str) -> None:
+    rows = summarize_control(events)
+    ticks = sum(1 for ev in events
+                if ev.get("ph") == "X" and ev.get("cat") == "control"
+                and ev.get("name") == "control_tick")
+    print(f"{path}: {kind} file, {len(rows)} control decision(s), "
+          f"{ticks} decision-bearing tick span(s)")
+    if not rows:
+        print("\n(no cat=\"control\" events — run the engine with "
+              "v2.control.enabled and the tracer on)")
+        return
+    print(f"\n{'tick':>6} {'action':<9} {'knob':<26} "
+          f"{'old -> new':<18} {'signal':<26} {'objective':>11} "
+          f"{'gain':>8}")
+    by_action: Dict[str, int] = {}
+    for r in rows:
+        by_action[r["action"]] = by_action.get(r["action"], 0) + 1
+        change = f"{r['old']} -> {r['new']}"
+        obj = ("-" if r["objective"] is None
+               else f"{r['objective']:.4g}")
+        gain = "-" if r["gain"] is None else f"{r['gain']:+.2%}"
+        print(f"{r['tick']:>6} {str(r['action']):<9} "
+              f"{str(r['knob']):<26} {change:<18} "
+              f"{str(r['signal']):<26} {obj:>11} {gain:>8}")
+    tally = "  ".join(f"{k}={by_action[k]}" for k in sorted(by_action))
+    print(f"\nby action: {tally}")
+
+
 def print_report(path: str, events: List[Dict[str, Any]],
                  kind: str) -> None:
     print(f"{path}: {kind} file, {len(events)} events")
@@ -290,6 +367,10 @@ def main(argv=None) -> int:
     ap.add_argument("--slo", action="store_true",
                     help="treat paths as metrics exports; render only "
                          "the SLO objective/budget-burn table")
+    ap.add_argument("--control", action="store_true",
+                    help="render the control plane's decision log "
+                         "(tick, action, knob, old -> new, driving "
+                         "signal) from cat=\"control\" trace events")
     args = ap.parse_args(argv)
     failures = 0
     for path in args.paths:
@@ -341,6 +422,8 @@ def main(argv=None) -> int:
         if args.validate:
             print(f"OK {path}: {kind}, {len(events)} events, "
                   "schema valid")
+        elif args.control:
+            print_control_report(path, events, kind)
         else:
             print_report(path, events, kind)
     return 1 if failures else 0
