@@ -34,6 +34,8 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.checkpoint.reshard import (gather_padded_partitions,
+                                              padded_partition_size)
 from deepspeed_tpu.utils.logging import logger
 
 __all__ = ["consolidate_reference_zero_checkpoint",
@@ -213,13 +215,14 @@ def _consolidate_one_mp(model_file: str,
             offsets = [0] * world
             for name, shape in shapes.items():
                 numel = int(np.prod(shape)) if shape else 1
-                per = -(-numel // world)            # padded per-rank slice
+                per = padded_partition_size(numel, world)
                 parts = []
                 for rk in range(world):
                     sl = flats[rk][offsets[rk]:offsets[rk] + per]
                     parts.append(sl)
                     offsets[rk] += per
-                out[name] = np.concatenate(parts)[:numel].reshape(shape)
+                out[name] = gather_padded_partitions(
+                    parts, numel).reshape(shape)
     else:
         # stage 1/2: each group's fp32 master is flat-partitioned across
         # ranks (reference single_partition_of_fp32_groups); concat then

@@ -1,0 +1,115 @@
+"""World re-slicing math: map a partitioned leaf from world W to W′.
+
+The ZeRO stage-3 flat layout (reference ``zero_to_fp32`` /
+``ds_to_universal``) pads every param to ``ceil(numel / world)`` elements
+per rank and round-robins the padded slices — so a checkpoint written at
+world W cannot be read back at W′ by reinterpreting offsets; the slices
+must be gathered to the full tensor and re-cut.  This module holds that
+math in one place, shared by the reference-checkpoint importer
+(:mod:`~deepspeed_tpu.checkpoint.ds_import`), the NVMe moment swapper's
+topology-change path (:mod:`~deepspeed_tpu.runtime.swap_tensor`), and
+the elastic agent's re-slice story.
+
+Everything here is per-LEAF and pure numpy: callers iterate leaves so no
+more than one full tensor is ever materialized at a time, which is what
+keeps W→W′ re-sharding inside the memory budget of a single host.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "padded_partition_size",
+    "partition_padded",
+    "gather_padded_partitions",
+    "reshard_padded_partitions",
+    "assemble_from_slices",
+]
+
+# explicit slice record: ((start, stop), ...) — one (start, stop) pair
+# per dimension, matching swap_tensor's normalized index form
+Slices = Tuple[Tuple[int, int], ...]
+
+
+def padded_partition_size(numel: int, world: int) -> int:
+    """``ceil(numel / world)`` — the per-rank padded slice length of the
+    stage-3 round-robin layout."""
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    return -(-int(numel) // int(world))
+
+
+def partition_padded(full: np.ndarray, world: int) -> List[np.ndarray]:
+    """Cut ``full`` (any shape) into ``world`` padded flat slices.
+
+    Every slice has exactly ``padded_partition_size(numel, world)``
+    elements; the tail of the last slice is zero-padded (the reference
+    layout's round-robin padding).  Inverse of
+    :func:`gather_padded_partitions`.
+    """
+    flat = np.ascontiguousarray(full).reshape(-1)
+    per = padded_partition_size(flat.size, world)
+    parts: List[np.ndarray] = []
+    for rk in range(world):
+        sl = flat[rk * per:(rk + 1) * per]
+        if sl.size < per:                      # uneven tail -> pad
+            sl = np.concatenate(
+                [sl, np.zeros(per - sl.size, dtype=flat.dtype)])
+        parts.append(sl)
+    return parts
+
+
+def gather_padded_partitions(parts: Sequence[np.ndarray],
+                             numel: int) -> np.ndarray:
+    """Concatenate per-rank padded slices and strip the padding — the
+    flat full tensor (caller reshapes).  Inverse of
+    :func:`partition_padded`."""
+    world = len(parts)
+    if world < 1:
+        raise ValueError("gather needs at least one partition")
+    per = padded_partition_size(numel, world)
+    for rk, p in enumerate(parts):
+        if p.size != per:
+            raise ValueError(
+                f"partition {rk} holds {p.size} elements, layout expects "
+                f"{per} (numel {numel} @ world {world})")
+    return np.concatenate([np.asarray(p).reshape(-1)
+                           for p in parts])[:numel]
+
+
+def reshard_padded_partitions(parts: Sequence[np.ndarray], numel: int,
+                              new_world: int) -> List[np.ndarray]:
+    """Map one leaf's padded partitions from world ``len(parts)`` to
+    ``new_world`` — gather then re-cut, materializing only this leaf."""
+    return partition_padded(gather_padded_partitions(parts, numel),
+                            new_world)
+
+
+def assemble_from_slices(
+        shape: Sequence[int],
+        shards: Iterable[Tuple[Slices, np.ndarray]],
+        dtype=np.float32,
+        fill: float = 0.0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Rebuild one full leaf from explicit slice records.
+
+    ``shards`` yields ``(slices, data)`` where ``slices`` is the
+    normalized ``((start, stop), ...)`` index (one pair per dim, the
+    form swap_tensor records in ``swap_meta``) and ``data`` the shard's
+    values (flat or shaped).  Returns ``(full, covered)`` — the
+    assembled array and a bool mask of which elements some shard
+    provided, so the caller can distinguish "re-sharded" from "restarts
+    from zero" per element.  Overlapping shards are last-writer-wins
+    (identical by construction when they come from one save).
+    """
+    shape = tuple(int(d) for d in shape)
+    full = np.full(shape, fill, dtype=dtype)
+    covered = np.zeros(shape, dtype=bool)
+    for slices, data in shards:
+        idx = tuple(slice(int(a), int(b)) for a, b in slices)
+        ext = tuple(int(b) - int(a) for a, b in slices)
+        full[idx] = np.asarray(data, dtype=dtype).reshape(ext)
+        covered[idx] = True
+    return full, covered

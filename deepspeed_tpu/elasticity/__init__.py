@@ -5,10 +5,13 @@ from deepspeed_tpu.elasticity.elasticity import (
     compute_elastic_config,
     elasticity_enabled,
     ensure_immutable_elastic_config,
+    nearest_valid_worlds,
+    validate_world_size,
 )
 
 __all__ = [
     "compute_elastic_config", "elasticity_enabled",
-    "ensure_immutable_elastic_config", "ElasticityError",
+    "ensure_immutable_elastic_config", "nearest_valid_worlds",
+    "validate_world_size", "ElasticityError",
     "ElasticityConfigError", "ElasticityIncompatibleWorldSize",
 ]

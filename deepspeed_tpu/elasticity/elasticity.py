@@ -54,7 +54,27 @@ class ElasticityConfigError(ElasticityError):
 
 
 class ElasticityIncompatibleWorldSize(ElasticityError):
-    """Current world size is not on the valid chip-count menu."""
+    """Current world size is not on the valid chip-count menu.
+
+    Carries the menu so callers (the elastic agent, the launcher) can
+    steer toward a schedulable allocation instead of burning restarts:
+    ``valid_worlds`` is the full menu in CHIPS (dp * model_parallel) and
+    ``nearest`` the menu entries closest to the offending world.
+    """
+
+    def __init__(self, msg: str, valid_worlds: Sequence[int] = (),
+                 nearest: Sequence[int] = ()):
+        super().__init__(msg)
+        self.valid_worlds = list(valid_worlds)
+        self.nearest = list(nearest)
+
+
+def nearest_valid_worlds(menu: Sequence[int], world: int,
+                         k: int = 3) -> List[int]:
+    """The ``k`` menu entries closest to ``world`` (ties toward the
+    smaller entry, result sorted ascending) — the 'did you mean'
+    suggestion for an off-menu allocation."""
+    return sorted(sorted(menu, key=lambda n: (abs(n - world), n))[:k])
 
 
 def _largest_hcn_multiple(base: int, cap: int) -> int:
@@ -154,16 +174,35 @@ def _solve_v02(micro_batches: Sequence[int], max_batch: int,
     per_mb = [mb * current_dp * (max_batch // (mb * current_dp))
               for mb in micro_batches if mb * current_dp <= max_batch]
     if not per_mb:
+        chips_menu = [n * model_parallel_size for n in dp_menu]
+        near = nearest_valid_worlds(chips_menu, current_chips)
         raise ElasticityIncompatibleWorldSize(
             f"no configured micro batch fits: every micro_batch * dp "
             f"({micro_batches} * {current_dp}) exceeds "
-            f"max_train_batch_size {max_batch}")
+            f"max_train_batch_size {max_batch}; nearest valid worlds "
+            f"(chips): {near}", valid_worlds=chips_menu, nearest=near)
     batch = max(per_mb) if prefer_larger else min(per_mb)
     return batch, [current_dp], pick_micro(batch)
 
 
 def elasticity_enabled(ds_config: Dict) -> bool:
     return bool(ds_config.get(ELASTICITY, {}).get("enabled", False))
+
+
+def validate_world_size(ds_config: Dict, world_size: int) -> None:
+    """Fail FAST when the discovered device/process count cannot run the
+    requested elastic config.
+
+    Called at launch (and on every elastic re-slice) with the world the
+    hardware actually provides — today an impossible world only surfaces
+    deep inside mesh construction as an opaque reshape error.  No-op
+    when elasticity is disabled; raises
+    :class:`ElasticityIncompatibleWorldSize` with the nearest valid
+    worlds otherwise.
+    """
+    if not elasticity_enabled(ds_config):
+        return
+    compute_elastic_config(ds_config, world_size=int(world_size))
 
 
 def ensure_immutable_elastic_config(runtime_elastic_config_dict: Dict
@@ -253,9 +292,13 @@ def compute_elastic_config(ds_config: Dict, target_deepspeed_version:
         # when mp == 1 — we use the dp size consistently
         dp = world_size // mp_size
         if dp not in menu:
+            chips_menu = [n * mp_size for n in menu]
+            near = nearest_valid_worlds(chips_menu, world_size)
             raise ElasticityIncompatibleWorldSize(
                 f"dp world size {dp} (world {world_size} / mp {mp_size}) "
-                f"not in valid menu {menu}")
+                f"not in valid menu {menu}; nearest valid worlds "
+                f"(chips): {near}",
+                valid_worlds=chips_menu, nearest=near)
         return batch, menu, micro_for(dp)
     if return_microbatch:
         micro = candidate_micro if version == 0.2 else micro_for(menu[-1])

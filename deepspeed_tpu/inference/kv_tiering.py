@@ -157,7 +157,8 @@ class TieredKVStore:
             "pages_restored": 0, "pages_verified": 0, "demotions": 0,
             "nvme_spills": 0, "prefetch_hits": 0, "prefetch_misses": 0,
             "rereads": 0, "reread_recovered": 0, "quarantined": 0,
-            "spill_fallbacks": 0, "bytes_spilled": 0, "bytes_restored": 0}
+            "spill_fallbacks": 0, "bytes_spilled": 0, "bytes_restored": 0,
+            "exports": 0, "imports": 0}
 
         self.spill_dir: Optional[str] = None
         if self.nvme_budget > 0:
@@ -506,6 +507,89 @@ class TieredKVStore:
         ent = self._entries.get(uid)
         if ent is not None:
             self._drop(ent)
+
+    # -- cross-replica handoff (elastic shrink) --------------------------
+
+    def export_spilled(self, uid: Key) -> Dict[str, Any]:
+        """Hand off ``uid``'s payload in SPILL FORMAT — the packed page
+        bytes plus the spill-time digests — without unpacking.  The
+        receiving store installs the donor digests alongside the bytes,
+        so its ``restore()`` verifies the pages against what the DONOR
+        computed at spill time: the handoff is integrity-checked
+        end-to-end, not re-trusted at the import boundary.  Drops the
+        entry (ownership moves with the bytes)."""
+        ent = self._entries.get(uid)
+        assert ent is not None, f"uid {uid} not spilled"
+        n = ent.n_pages
+        work = self._fetch(ent)
+        digests = self._digests.pop(uid) if self.verify else None
+        self._drop(ent)
+        self.counters["exports"] += 1
+        return {"n_pages": n,
+                "page_stride": int(self.page_stride),
+                "algo": self.algo,
+                "payload": bytes(work[:n * self.page_stride]),
+                "digests": ([tuple(d) for d in digests]
+                            if digests is not None else None)}
+
+    def import_spilled(self, uid: Key, blob: Dict[str, Any]) -> None:
+        """Receiving half of the handoff: install an exported payload
+        under ``uid`` as a host-tier entry (demoting/overflowing to
+        NVMe exactly like a local spill).  Raises ``ValueError`` on a
+        layout mismatch and ``RuntimeError`` when no tier has room —
+        the caller falls back to a re-prefill continuation."""
+        assert uid not in self._entries, f"uid {uid} already spilled"
+        if int(blob["page_stride"]) != self.page_stride:
+            raise ValueError(
+                f"kv tiering: imported payload page_stride "
+                f"{blob['page_stride']} != local {self.page_stride} — "
+                "handoff requires homogeneous replica cache layouts")
+        n = int(blob["n_pages"])
+        if not self.can_spill(n):
+            self.counters["spill_fallbacks"] += 1
+            raise RuntimeError(
+                f"kv tiers full: cannot import {n} pages "
+                f"(free {self.free_pages()})")
+        from deepspeed_tpu.io.aio import aligned_empty
+
+        raw = np.frombuffer(blob["payload"], np.uint8)
+        assert raw.size == n * self.page_stride, (raw.size, n)
+        buf = aligned_empty(n * self.page_stride)
+        buf[:] = raw
+        ent = _Entry(uid, n)
+        self._seq += 1
+        ent.seq = self._seq
+        ent.buf = buf
+        host_free = self.host_budget - self._host_used
+        try:
+            if n <= self.host_budget:
+                if n > host_free:
+                    self._demote(n - host_free)
+                self._entries[uid] = ent
+                self._host_used += n
+            else:
+                self._entries[uid] = ent
+                self._nvme_spill(ent)
+        except RuntimeError:
+            self._entries.pop(uid, None)
+            self.counters["spill_fallbacks"] += 1
+            raise
+        if self.verify:
+            donor = blob.get("digests")
+            if donor is not None and str(blob.get("algo")) == self.algo:
+                # the donor's spill-time digests ARE the reference
+                self._digests.submit(
+                    uid, lambda d=donor: [tuple(x) for x in d])
+            else:
+                # algo mismatch (or unverified donor): digest what we
+                # received — integrity from here on, not end-to-end
+                self._digests.submit(
+                    uid, lambda: [sdc_digest(b, self.algo)
+                                  for b in buf.reshape(n,
+                                                       self.page_stride)])
+        self.counters["imports"] += 1
+        self.counters["pages_spilled"] += n
+        self.counters["bytes_spilled"] += buf.nbytes
 
     # -- accounting / telemetry ------------------------------------------
 

@@ -887,6 +887,118 @@ class RaggedInferenceEngineV2:
         outs.update(self.get_outputs())
         return outs
 
+    # -- elastic shrink: parked-session handoff --------------------------
+
+    def export_parked(self) -> List[Dict[str, Any]]:
+        """Pop every PARKED session (the waiting queue: not yet
+        admitted, spilled out of the pool, or queued as a re-prefill
+        continuation) and return portable session blobs for
+        :meth:`import_parked` on another replica.  A spilled session's
+        private pages travel in SPILL FORMAT (packed bytes + the
+        spill-time digests via ``TieredKVStore.export_spilled``), so
+        the receiver's restore verifies them end-to-end.  Shared-prefix
+        pages are rows in THIS engine's HBM and cannot travel — a
+        session holding any folds to a re-prefill continuation
+        (``ctx = prompt + generated``), which is output-identical under
+        greedy decode, just re-paying its prefill."""
+        sessions: List[Dict[str, Any]] = []
+        while self.waiting:
+            r = self.waiting.popleft()
+            blob: Dict[str, Any] = {
+                "uid": int(r.uid),
+                "prompt": np.asarray(r.prompt, np.int32),
+                "max_new_tokens": int(r.max_new_tokens),
+                "eos_token_id": r.eos_token_id,
+                "do_sample": bool(r.do_sample),
+                "temperature": float(r.temperature),
+                "top_k": int(r.top_k),
+                "top_p": float(r.top_p),
+                "generated": [int(t) for t in r.generated],
+                "ctx": (None if r.ctx is None
+                        else np.asarray(r.ctx, np.int32)),
+                "prefill_done": int(r.prefill_done),
+                "spill": None}
+            if r.spilled is not None:
+                shared = [int(p) for p in r.spilled.get("shared_pages",
+                                                        ())]
+                n_priv = int(r.spilled.get("n_pages", 0))
+                holds = (self.tiering is not None
+                         and self.tiering.holds(r.uid))
+                if shared or (n_priv > 0 and not holds):
+                    # fold to a re-prefill continuation; release the
+                    # spill-holds and the orphaned payload
+                    for p in shared:
+                        self.allocator.decref(p)
+                    if self.tiering is not None:
+                        self.tiering.drop(r.uid)
+                    blob["ctx"] = np.concatenate(
+                        [r.prompt, np.asarray(r.generated, np.int32)])
+                    blob["prefill_done"] = 0
+                else:
+                    blob["spill"] = {
+                        "last_tok": int(r.spilled["last_tok"]),
+                        "live_tokens": int(r.spilled["live_tokens"]),
+                        "payload": (self.tiering.export_spilled(r.uid)
+                                    if n_priv > 0 else None)}
+            if trace.enabled:
+                trace.event("request_export", cat="request", uid=r.uid,
+                            spilled=blob["spill"] is not None)
+            sessions.append(blob)
+        return sessions
+
+    def import_parked(self, sessions: List[Dict[str, Any]]) -> List[int]:
+        """Receiving half of the handoff: install each exported session
+        as a local waiting :class:`Request` under a FRESH uid (uids are
+        per-engine) and park its spill payload in the local tier store
+        with the donor's digests.  Returns the new uids in input order
+        — the router re-keys its ledger with them.  A payload the local
+        tiers can't hold folds to a re-prefill continuation instead of
+        being dropped."""
+        new_uids: List[int] = []
+        for s in sessions:
+            req = Request(uid=next(self._uid),
+                          prompt=np.asarray(s["prompt"], np.int32),
+                          max_new_tokens=int(s.get("max_new_tokens", 64)),
+                          eos_token_id=s.get("eos_token_id"),
+                          do_sample=bool(s.get("do_sample", False)),
+                          temperature=float(s.get("temperature", 1.0)),
+                          top_k=int(s.get("top_k", 0)),
+                          top_p=float(s.get("top_p", 1.0)))
+            req.generated = [int(t) for t in s.get("generated", ())]
+            ctx = s.get("ctx")
+            req.ctx = None if ctx is None else np.asarray(ctx, np.int32)
+            req.prefill_done = int(s.get("prefill_done", 0))
+            sp = s.get("spill")
+            if sp is not None:
+                payload = sp.get("payload")
+                installed = payload is None
+                if payload is not None and self.tiering is not None:
+                    try:
+                        self.tiering.import_spilled(req.uid, payload)
+                        installed = True
+                    except (ValueError, RuntimeError):
+                        installed = False
+                if installed:
+                    req.spilled = {
+                        "last_tok": int(sp["last_tok"]),
+                        "n_pages": (int(payload["n_pages"])
+                                    if payload is not None else 0),
+                        "live_tokens": int(sp["live_tokens"]),
+                        "shared_pages": []}
+                else:
+                    req.ctx = np.concatenate(
+                        [req.prompt,
+                         np.asarray(req.generated, np.int32)])
+                    req.prefill_done = 0
+            self.waiting.append(req)
+            self.request_latency.on_submit(req.uid)
+            if trace.enabled:
+                trace.event("request_import", cat="request", uid=req.uid,
+                            donor_uid=int(s.get("uid", -1)),
+                            spilled=req.spilled is not None)
+            new_uids.append(req.uid)
+        return new_uids
+
     def knob_registry(self):
         """The engine's typed knob surface for the control plane
         (:class:`~deepspeed_tpu.control.knobs.KnobRegistry`).

@@ -31,6 +31,7 @@ import jax
 
 from deepspeed_tpu.resilience.distributed import CollectiveTimeout
 from deepspeed_tpu.resilience.guards import SwapCorruptionError
+from deepspeed_tpu.telemetry import trace
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
@@ -134,6 +135,24 @@ class DSElasticAgent:
         self.restarts = 0
         self.hard_failures = 0
         self.backoff_history: list = []
+        self.restart_reasons: Dict[str, int] = {}
+        self._last_world: Optional[int] = None
+
+    def _note_restart(self, reason: str, **attrs) -> None:
+        """Every restart decision leaves a control-plane record: a
+        ``cat="control"`` trace event plus the
+        ``dstpu_restarts_total{reason}`` counter — re-slices must be as
+        auditable as the autotuner's knob moves."""
+        self.restart_reasons[reason] = self.restart_reasons.get(reason, 0) + 1
+        trace.event("elastic_restart", cat="control", reason=reason,
+                    restart=self.restarts, budget=self.max_restarts,
+                    **attrs)
+        from deepspeed_tpu.telemetry.metrics import metrics as _metrics
+        if _metrics.enabled:
+            _metrics.counter(
+                "dstpu_restarts_total",
+                "Elastic agent restarts by reason",
+                labels=("reason",)).labels(reason=reason).inc()
 
     def _backoff(self) -> None:
         """Jittered exponential delay before retrying after a HARD
@@ -156,6 +175,25 @@ class DSElasticAgent:
         import deepspeed_tpu.comm as dist
 
         world = len(devices)
+        if self._last_world is not None and world != self._last_world:
+            # topology CHANGED across a restart: the elastic solve keeps
+            # the global batch constant while micro x GAS reshuffle, the
+            # sharded store re-slices params/optimizer on load, and the
+            # NVMe swapper re-buckets moments from the saved shard
+            # records — emit the decision so operators can see the
+            # re-slice, not just infer it from step timing
+            solved = elastic_batch_config(self.ds_config, world)
+            trace.event(
+                "elastic_reslice", cat="control",
+                old_world=self._last_world, new_world=world,
+                batch=int(solved.get("train_batch_size", 0) or 0),
+                micro=int(solved.get(
+                    "train_micro_batch_size_per_gpu", 0) or 0),
+                gas=int(solved.get(
+                    "gradient_accumulation_steps", 0) or 0))
+            log_dist(f"elastic agent: re-slicing world "
+                     f"{self._last_world} -> {world}", ranks=[0])
+        self._last_world = world
         # the config system re-solves the elastic batch triple itself for
         # the topology's dp world size (config.py _apply_elasticity) — the
         # agent only rebuilds the mesh and hands the config through
@@ -183,11 +221,25 @@ class DSElasticAgent:
 
         Returns the final engine (for evaluation / state extraction).
         """
+        from deepspeed_tpu.elasticity import (
+            ElasticityIncompatibleWorldSize, validate_world_size)
+
         last_err: Optional[BaseException] = None
         while self.restarts <= self.max_restarts:
             devices = list(self.device_provider())
             if not devices:
                 raise RuntimeError("elastic agent: no healthy devices")
+            try:
+                # fail FAST on an unschedulable world instead of burning
+                # the restart budget against mesh-construction errors;
+                # the exception lists the nearest valid worlds so the
+                # scheduler can converge
+                validate_world_size(self.ds_config, len(devices))
+            except ElasticityIncompatibleWorldSize as e:
+                trace.event("elastic_world_rejected", cat="control",
+                            world=len(devices),
+                            nearest=list(getattr(e, "nearest", [])))
+                raise
             try:
                 engine, cfg = self._make_engine(devices)
             except (PreemptionError, jax.errors.JaxRuntimeError,
@@ -197,6 +249,7 @@ class DSElasticAgent:
                 # not crash the supervisor
                 last_err = e
                 self.restarts += 1
+                self._note_restart("rebuild_failure", error=repr(e))
                 logger.warning(
                     f"elastic agent: engine rebuild failed, restart "
                     f"{self.restarts}/{self.max_restarts} ({e})")
@@ -227,6 +280,8 @@ class DSElasticAgent:
             except PreemptionError as e:
                 last_err = e
                 self.restarts += 1
+                self._note_restart("membership_change", step=step,
+                                   world=len(devices))
                 logger.warning(
                     f"elastic agent: restart {self.restarts}/"
                     f"{self.max_restarts} ({e})")
@@ -242,11 +297,25 @@ class DSElasticAgent:
                 # save was torn)
                 last_err = e
                 self.restarts += 1
+                self._note_restart("hard_failure", step=step,
+                                   error=repr(e))
                 logger.warning(
                     f"elastic agent: hard failure, restart "
                     f"{self.restarts}/{self.max_restarts} ({e})")
                 if self.restarts <= self.max_restarts:
                     self._backoff()
-        raise RuntimeError(
-            f"elastic agent: exceeded {self.max_restarts} restarts"
-        ) from last_err
+        # budget exhausted: leave a black box before dying — the ring
+        # holds the restart timeline the post-mortem needs
+        err = RuntimeError(
+            f"elastic agent: exceeded {self.max_restarts} restarts")
+        from deepspeed_tpu.telemetry import flight
+        flight.dump_on_fault(
+            "restart_budget_exhausted", last_err or err,
+            extra={"restarts": self.restarts,
+                   "hard_failures": self.hard_failures,
+                   "max_restarts": self.max_restarts,
+                   "restart_reasons": dict(self.restart_reasons),
+                   "backoff_history": [round(b, 3)
+                                       for b in self.backoff_history],
+                   "last_world": self._last_world})
+        raise err from last_err

@@ -15,6 +15,8 @@ import numpy as np
 
 import jax
 
+from deepspeed_tpu.utils.logging import logger
+
 
 class RepeatingLoader:
     """Wraps an iterator to restart on StopIteration (reference
@@ -72,13 +74,14 @@ class DeepSpeedDataLoader:
 
     def __init__(self, dataset, batch_size: int, shuffle: bool = True,
                  seed: int = 1234, drop_last: bool = True,
-                 collate_fn=None):
+                 collate_fn=None, world_size: int = 1):
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.seed = seed
         self.drop_last = drop_last
         self.collate_fn = collate_fn or _stack
+        self.world_size = int(world_size)   # recorded for elastic resume
         self.epoch = 0              # epoch the NEXT batch comes from
         self.cursor = 0             # batches already served this epoch
         if not hasattr(dataset, "__len__") or not hasattr(dataset, "__getitem__"):
@@ -113,12 +116,44 @@ class DeepSpeedDataLoader:
 
     def state_dict(self) -> Dict[str, Any]:
         return {"seed": int(self.seed), "epoch": int(self.epoch),
-                "cursor": int(self.cursor)}
+                "cursor": int(self.cursor),
+                "batch_size": int(self.batch_size),
+                "world_size": int(self.world_size)}
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.seed = int(state["seed"])
         self.epoch = int(state["epoch"])
-        self.cursor = int(state["cursor"])
+        cursor = int(state["cursor"])
+        saved_bs = int(state.get("batch_size", self.batch_size))
+        saved_world = int(state.get("world_size", self.world_size))
+        if saved_bs != self.batch_size:
+            # elastic re-slice changed the GLOBAL batch size: the cursor
+            # counts batches of the OLD size, so re-map it through the
+            # sample position.  Floor division re-visits at most one
+            # partial batch rather than skipping samples.
+            samples = cursor * saved_bs
+            cursor = samples // self.batch_size
+            if samples % self.batch_size:
+                logger.warning(
+                    f"dataloader resume: global batch {saved_bs} -> "
+                    f"{self.batch_size} does not divide the {samples} "
+                    f"consumed samples; re-visiting "
+                    f"{samples % self.batch_size} samples of batch "
+                    f"{cursor} rather than dropping them")
+            logger.info(
+                f"dataloader resume: re-mapped cursor {state['cursor']} "
+                f"(batch {saved_bs}, world {saved_world}) -> {cursor} "
+                f"(batch {self.batch_size}, world {self.world_size})")
+        elif saved_world != self.world_size:
+            # same global batch at a different world (elastic contract:
+            # constant global batch across the menu) -> the cursor is a
+            # count of GLOBAL batches and remains exact; log the
+            # re-slice so resumes are auditable
+            logger.info(
+                f"dataloader resume: world {saved_world} -> "
+                f"{self.world_size} with unchanged global batch "
+                f"{self.batch_size}; cursor {cursor} carries over")
+        self.cursor = cursor
 
 
 def shard_batch(batch, sharding) -> Any:

@@ -461,7 +461,8 @@ class DeepSpeedEngine:
         if training_data is not None:
             self.training_dataloader = RepeatingLoader(DeepSpeedDataLoader(
                 training_data, batch_size=config.train_batch_size,
-                seed=config.seed, drop_last=config.dataloader_drop_last))
+                seed=config.seed, drop_last=config.dataloader_drop_last,
+                world_size=self.topology.world_size))
         self._data_iter = None
 
         # -- compiled steps (built lazily per batch structure) ------------

@@ -602,6 +602,19 @@ class NvmeOptimizerSwapper:
         # (leaf key, shard index tag) pairs with moments on disk — THIS
         # process's shards only; other processes track their own
         self._initialized: set = set()
+        # (key, tag) -> normalized ((start, stop), ...) slice ranges.
+        # Tags are sha1 digests — non-invertible — so the geometry each
+        # tag covers must travel explicitly for a checkpoint to be
+        # re-sliceable at a different world size
+        self._shard_idx: Dict[tuple, tuple] = {}
+        # key -> [(tag, slices, checkpoint path, digests, algo)] over
+        # EVERY process's swap_meta in the restored checkpoint — the
+        # source material for re-bucketing moments after a world change
+        self._saved_shards: Dict[str, list] = {}
+        self._resharded_keys: set = set()
+        # (key, tag) shards already rejected at restore — never re-read
+        # (and never re-counted) by the re-slice path
+        self._rejected_shards: set = set()
         # leaf registry: key -> (file basename, full shape, np dtype)
         self._meta: Dict[str, Tuple[str, tuple, np.dtype]] = {}
         flat = jax.tree_util.tree_flatten_with_path(params)[0]
@@ -897,7 +910,19 @@ class NvmeOptimizerSwapper:
             # bucket file this read targets — settle it first
             self._drain_deferred()
         out: Dict[tuple, Optional[tuple]] = {}
-        for idx, sh in _unique_shards(leaf).items():
+        uniq = _unique_shards(leaf)
+        if self._restored and key in self._saved_shards \
+                and key not in self._resharded_keys:
+            missing = [idx for idx in uniq
+                       if (key, _idx_tag(idx)) not in self._initialized]
+            if missing:
+                # the restored checkpoint's shard tags don't match the
+                # CURRENT layout (world changed since save): re-slice
+                # this leaf from the saved slice records before falling
+                # back to zero-init
+                self._resharded_keys.add(key)
+                self._reshard_key(key, missing)
+        for idx, sh in uniq.items():
             tag = _idx_tag(idx)
             if (loc is not None and tag == loc[2]
                     and loc[0] in self._bucket_ready
@@ -917,19 +942,18 @@ class NvmeOptimizerSwapper:
                 continue
             if (key, tag) not in self._initialized:
                 if self._restored and not self._reshard_warned:
-                    # shard tags are topology-keyed: a resumed run on a
-                    # DIFFERENT process/device layout cannot match the
-                    # saved moment files — moments restart zero.  (The
-                    # params themselves reshard fine via the checkpoint
-                    # store; only NVMe-swapped moments are layout-bound —
-                    # resuming an NVMe-swap run on a new topology should
-                    # go through a device-resident optimizer checkpoint.)
+                    # the re-slice above could not produce this shard —
+                    # either the checkpoint predates slice records (only
+                    # full-extent tags are recognizable then) or every
+                    # covering saved file failed verification (counted
+                    # in restore_rejected) — so this moment restarts
+                    # zero, loudly
                     self._reshard_warned = True
                     logger.warning(
                         f"NVMe swap: restored moment set has no shard "
-                        f"for {key!r} under the CURRENT sharding — the "
-                        "topology changed since save; affected moments "
-                        "restart from zero")
+                        f"for {key!r} under the CURRENT sharding and it "
+                        "could not be re-sliced from the saved records; "
+                        "affected moments restart from zero")
                 out[idx] = None
                 continue
             shp = tuple(sh.data.shape)
@@ -1006,6 +1030,7 @@ class NvmeOptimizerSwapper:
             self._note_item_sums(key, tag, m_np, v_np)
             self._io_write_bytes += m_np.nbytes + v_np.nbytes
             self._initialized.add((key, tag))
+            self._shard_idx[(key, tag)] = idx
             if self._buckets is not None and key in self._plan_keys:
                 # a leafwise write of a plan key leaves moments in item
                 # files — the next bucketed step must fold them back in
@@ -1784,6 +1809,19 @@ class NvmeOptimizerSwapper:
                     "adam_w_mode": self.adam_w_mode,
                     "betas": [self.b1, self.b2], "eps": self.eps,
                     "weight_decay": self.wd}
+            # explicit slice geometry per shard tag: the tag itself is a
+            # hash, so without these records a checkpoint can only be
+            # resumed at the EXACT topology that wrote it — with them a
+            # world-change resume re-buckets the moments (load_from)
+            shards = []
+            for key, tag in sorted(self._initialized):
+                idx = self._shard_idx.get((key, tag))
+                if idx is None and tag == _full_tag(self._meta[key][1]):
+                    idx = tuple((0, int(d)) for d in self._meta[key][1])
+                if idx is not None:
+                    shards.append([key, tag, [list(r) for r in idx]])
+            if shards:
+                meta["shards"] = shards
             if sums:
                 meta["checksum_algo"] = self._sdc_algo
                 meta["sums"] = sums
@@ -1833,6 +1871,17 @@ class NvmeOptimizerSwapper:
         for kb, b in enumerate(self._buckets):
             if kb in self._bucket_ready:
                 continue                  # bucket file is authoritative
+            for it in b["items"]:
+                # bucketed items address the FULL leaf extent, so a
+                # world-change checkpoint (per-shard tags) can always be
+                # re-sliced up front from its saved slice records
+                if (it["key"], it["tag"]) not in self._initialized \
+                        and it["key"] in self._saved_shards \
+                        and it["key"] not in self._resharded_keys:
+                    self._resharded_keys.add(it["key"])
+                    self._reshard_key(
+                        it["key"],
+                        [tuple((0, int(d)) for d in it["shape"])])
             present = [it for it in b["items"]
                        if (it["key"], it["tag"]) in self._initialized]
             missing += len(b["items"]) - len(present)
@@ -1894,6 +1943,8 @@ class NvmeOptimizerSwapper:
         ck_algo = meta.get("checksum_algo", self._sdc_algo)
         ck_sums = {(k, t): ((dm[0], dm[1]), (dv[0], dv[1]))
                    for k, t, dm, dv in meta.get("sums", [])}
+        own_idx = {(k, t): tuple(tuple(int(x) for x in r) for r in sl)
+                   for k, t, sl in meta.get("shards", [])}
         for entry in meta["initialized"]:
             key, tag = entry
             if key not in self._meta:
@@ -1906,9 +1957,139 @@ class NvmeOptimizerSwapper:
                     key, tag, ck_sums.get((key, tag)), ck_algo):
                 continue                    # rejected: restarts zero-init
             self._initialized.add((key, tag))
+            if (key, tag) in own_idx:
+                self._shard_idx[(key, tag)] = own_idx[(key, tag)]
+        self._index_saved_shards(src)
         self._restored = True
         self._assemble_buckets_from_items()
         return True
+
+    def _index_saved_shards(self, src: str) -> None:
+        """Union EVERY process's ``swap_meta.p*.json`` slice records into
+        ``_saved_shards`` — the raw material for re-slicing moments when
+        the world changed between save and resume.  A world-W checkpoint
+        read by world-W′ leaves per-process shard sets that no longer
+        line up; the explicit (tag → slice ranges) records make each
+        saved file addressable regardless of which process wrote it."""
+        import glob as _glob
+        import json
+
+        self._saved_shards = {}
+        self._resharded_keys = set()
+        for meta_f in sorted(_glob.glob(
+                os.path.join(src, "swap_meta.p*.json"))):
+            try:
+                with open(meta_f) as f:
+                    m = json.load(f)
+            except (OSError, ValueError) as e:
+                logger.warning(f"unreadable swap meta {meta_f}: {e}")
+                continue
+            algo = m.get("checksum_algo", self._sdc_algo)
+            sums = {(k, t): ((dm[0], dm[1]), (dv[0], dv[1]))
+                    for k, t, dm, dv in m.get("sums", [])}
+            recs = {(k, t): tuple(tuple(int(x) for x in r) for r in sl)
+                    for k, t, sl in m.get("shards", [])}
+            for entry in m.get("initialized", []):
+                key, tag = entry
+                if key not in self._meta \
+                        or (key, tag) in self._rejected_shards:
+                    continue
+                slices = recs.get((key, tag))
+                if slices is None:
+                    # pre-record checkpoints: only the full-extent tag
+                    # is recognizable (its index is a pure function of
+                    # the shape); other tags stay layout-bound
+                    shape = self._meta[key][1]
+                    if tag != _full_tag(shape):
+                        continue
+                    slices = tuple((0, int(d)) for d in shape)
+                path = os.path.join(src, os.path.basename(
+                    self._shard_fname(key, tag)))
+                self._saved_shards.setdefault(key, []).append(
+                    (tag, slices, path, sums.get((key, tag)), algo))
+
+    def _reshard_key(self, key: str, targets) -> bool:
+        """Re-bucket one leaf's moments from the checkpoint's saved
+        shard set onto the CURRENT layout: assemble the full fp32
+        ``[m; v]`` leaf from every process's saved slices (each file
+        digest-verified — a torn or stale shard is rejected, counted in
+        ``restore_rejected``, and its range restarts zero), then cut and
+        write the shard files ``targets`` (normalized indices) ask for.
+        Only this one leaf is ever materialized in full.  Returns True
+        when at least one target shard was produced."""
+        from deepspeed_tpu.checkpoint.reshard import assemble_from_slices
+        from deepspeed_tpu.resilience.sdc import checksum
+
+        recs = self._saved_shards.get(key)
+        if not recs:
+            return False
+        shape, dt = self._meta[key][1], self._meta[key][2]
+        m_shards, v_shards = [], []
+        rejected = 0
+        for tag, slices, path, exp, algo in recs:
+            try:
+                data = np.fromfile(path, np.uint8)
+            except OSError as e:
+                logger.error(f"NVMe swap reshard: moment shard "
+                             f"{os.path.basename(path)} unreadable ({e})")
+                self.sdc_counters["restore_rejected"] += 1
+                rejected += 1
+                continue
+            ext = tuple(int(b) - int(a) for a, b in slices)
+            n = int(np.prod(ext)) if ext else 1
+            nb = n * dt.itemsize
+            m_b, v_b = data[:nb], data[nb:2 * nb]
+            if data.nbytes != 2 * nb or (exp is not None and (
+                    checksum(m_b, algo) != exp[0][0]
+                    or checksum(v_b, algo) != exp[1][0])):
+                self.sdc_counters["restore_rejected"] += 1
+                self._rejected_shards.add((key, tag))
+                rejected += 1
+                logger.error(
+                    f"NVMe swap reshard: saved moments for {key!r} "
+                    f"shard {tag} FAILED verification; that range "
+                    "restarts zero-init")
+                continue
+            m_shards.append((slices, m_b.view(dt)))
+            v_shards.append((slices, v_b.view(dt)))
+        if not m_shards:
+            return False
+        m_full, covered = assemble_from_slices(shape, m_shards, dtype=dt)
+        v_full, _ = assemble_from_slices(shape, v_shards, dtype=dt)
+        if not covered.all() and not rejected:
+            # a hole WITHOUT a rejection means a process's meta/file
+            # never made it into the checkpoint — surface it through the
+            # same counter the acceptance contract watches (zeros must
+            # never be silent; rejected shards already counted)
+            self.sdc_counters["restore_rejected"] += 1
+            logger.error(
+                f"NVMe swap reshard: saved shards cover only "
+                f"{int(covered.sum())}/{covered.size} elements of "
+                f"{key!r}; uncovered moments restart zero-init")
+        made = 0
+        for idx in targets:
+            idx = tuple(tuple(int(x) for x in r) for r in idx)
+            tag = _idx_tag(idx)
+            sl = tuple(slice(a, b) for a, b in idx)
+            m_sl = np.ascontiguousarray(m_full[sl])
+            v_sl = np.ascontiguousarray(v_full[sl])
+            _write_item_file(self._shard_fname(key, tag), m_sl, v_sl)
+            self._note_item_sums(key, tag, m_sl, v_sl, defer=False)
+            self._initialized.add((key, tag))
+            self._shard_idx[(key, tag)] = idx
+            made += 1
+        if made:
+            logger.info(
+                f"NVMe swap: re-sliced moments for {key!r} — {made} "
+                f"shard(s) for the new layout from {len(m_shards)} saved "
+                f"slice(s)" + (f", {rejected} rejected" if rejected
+                               else ""))
+            if _registry_metrics.enabled:
+                _registry_metrics.counter(
+                    "dstpu_swap_resharded_total",
+                    "Moment leaves re-sliced across a world change"
+                ).inc()
+        return made > 0
 
     def _restore_item_file(self, src_path: str, dst: str, key: str,
                            tag: str, exp: Optional[tuple],
@@ -1933,6 +2114,7 @@ class NvmeOptimizerSwapper:
             if (data.nbytes != nm + nv or checksum(m, algo) != dm
                     or checksum(v, algo) != dv):
                 self.sdc_counters["restore_rejected"] += 1
+                self._rejected_shards.add((key, tag))
                 logger.error(
                     f"NVMe swap: checkpointed moments for {key!r} FAILED "
                     f"checksum verification at restore "

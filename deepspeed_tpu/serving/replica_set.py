@@ -126,6 +126,29 @@ class EngineReplicaHandle:
 
         self._submit(op, on_done)
 
+    def export_parked_async(self, on_done: Callable[[Any], Any]) -> None:
+        """Pull the engine's parked sessions (spill-format blobs) off
+        the replica thread — the shrink half of elastic re-slicing;
+        ``on_done(sessions)`` at join time."""
+        eng = self.engine
+
+        def op() -> List[Dict[str, Any]]:
+            return eng.export_parked()
+
+        self._submit(op, on_done)
+
+    def import_parked_async(self, sessions: List[Dict[str, Any]],
+                            on_done: Callable[[Any], Any]) -> None:
+        """Install handed-off sessions on this replica's thread;
+        ``on_done(new_uids)`` at join time (the router re-keys its
+        uid ledger with them)."""
+        eng = self.engine
+
+        def op() -> List[int]:
+            return eng.import_parked(sessions)
+
+        self._submit(op, on_done)
+
     def join_all(self) -> None:
         """Fold every pending op (its ``on_done`` runs here, on the
         caller's thread); first replica fault re-raises after the
@@ -190,15 +213,61 @@ class ReplicaSet:
                  feed_depth: int = 2) -> None:
         if n < 1:
             raise ValueError("ReplicaSet needs n >= 1 replicas")
+        # retained: grow() builds new replicas from the same factory
+        self._factory = factory
+        self._feed_depth = int(feed_depth)
+        self._next_idx = 0
         self.handles: List[EngineReplicaHandle] = []
         try:
-            for i in range(int(n)):
-                self.handles.append(
-                    EngineReplicaHandle(i, factory(i),
-                                        feed_depth=feed_depth))
+            for _ in range(int(n)):
+                self._spawn()
         except Exception:
             self.close()          # don't leak half-built replica threads
             raise
+
+    def _spawn(self) -> EngineReplicaHandle:
+        i = self._next_idx
+        self._next_idx += 1       # indices (and names) are never reused
+        h = EngineReplicaHandle(i, self._factory(i),
+                                feed_depth=self._feed_depth)
+        self.handles.append(h)
+        return h
+
+    def grow(self, n: int = 1) -> List[EngineReplicaHandle]:
+        """Build ``n`` new replicas from the retained factory (fresh,
+        never-reused indices/names) and return their handles.  The
+        handles are NOT yet routed — the caller admits each via
+        ``Router.add_replica`` (optionally prefix-warmed) once it is
+        ready for traffic."""
+        made: List[EngineReplicaHandle] = []
+        try:
+            for _ in range(int(n)):
+                made.append(self._spawn())
+        except Exception:
+            for h in made:
+                self.handles.remove(h)
+                h.close()
+            raise
+        return made
+
+    def shrink(self, names) -> List[EngineReplicaHandle]:
+        """Remove (and close) replicas by name.  The router retires a
+        replica FIRST — drain + parked-session handoff — so the close
+        here is an idempotent resource release, never a request drop.
+        Refuses to empty the set."""
+        names = {names} if isinstance(names, str) else set(names)
+        have = {h.name for h in self.handles}
+        unknown = names - have
+        if unknown:
+            raise ValueError(f"unknown replicas {sorted(unknown)} "
+                             f"(have {sorted(have)})")
+        if len(self.handles) - len(names) < 1:
+            raise ValueError("shrink would leave an empty replica set")
+        dropped = [h for h in self.handles if h.name in names]
+        self.handles = [h for h in self.handles if h.name not in names]
+        for h in dropped:
+            h.close()
+        return dropped
 
     def __len__(self) -> int:
         return len(self.handles)

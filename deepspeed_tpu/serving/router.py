@@ -185,16 +185,25 @@ class Router:
         self._affinity: Dict[int, str] = {}                  # hash -> name
         self._outputs: Dict[int, np.ndarray] = {}
         self._draining = False
+        self._retiring: set = set()
         self.stats_counters: Dict[str, int] = {
             "accepted": 0, "rejected_queue_full": 0, "rejected_shed": 0,
             "rejected_never_schedulable": 0, "affinity_hits": 0,
-            "rerouted": 0, "finished": 0, "replica_deaths": 0}
+            "rerouted": 0, "finished": 0, "replica_deaths": 0,
+            "replicas_added": 0, "replicas_retired": 0,
+            "sessions_handed_off": 0}
         self._routed: Dict[str, int] = {h.name: 0 for h in self.handles}
 
     # -- admission -------------------------------------------------------
 
     def _alive(self) -> List[Any]:
         return [h for h in self.handles if h.alive]
+
+    def _dispatchable(self) -> List[Any]:
+        """Alive AND not mid-retire: a retiring replica finishes its
+        in-flight work but takes no new assignments."""
+        return [h for h in self.handles
+                if h.alive and h.name not in self._retiring]
 
     def _max_burn(self) -> float:
         if self.slo is None:
@@ -309,7 +318,7 @@ class Router:
                 # protected traffic first, so nothing above this is
                 # waiting behind it)
                 break
-            cands = [h for h in self._alive()
+            cands = [h for h in self._dispatchable()
                      if len(self._assigned[h.name]) < self.queue_cap]
             if not cands:
                 break
@@ -397,6 +406,166 @@ class Router:
         if not self._alive() and (self._heap or self._live):
             raise RouterRejection(
                 "all replicas dead with requests outstanding") from exc
+
+    # -- elasticity: live grow / shrink ----------------------------------
+    # The serving half of elastic re-slicing: replicas join and leave a
+    # RUNNING router.  Growth admits a fresh handle (optionally prefix-
+    # warmed from a donor so sticky chains hit on arrival); retirement
+    # drains a replica without dropping work — parked sessions travel to
+    # a survivor in spill format, in-flight requests finish in place,
+    # and affinity pins re-home.
+
+    def add_replica(self, handle: Any, warm_from: Any = None,
+                    warm_limit: int = 8) -> None:
+        """Admit ``handle`` to the routed set.  ``warm_from`` names a
+        donor handle whose prefix-cache chains are replayed on the new
+        replica first (up to ``warm_limit`` longest chains), so sticky
+        traffic re-pinned here starts warm instead of cold."""
+        if any(h.name == handle.name for h in self.handles):
+            raise ValueError(f"replica {handle.name!r} already routed")
+        warmed = 0
+        if warm_from is not None:
+            warmed = self._warm_from(handle, warm_from, warm_limit)
+        self.handles.append(handle)
+        self._assigned[handle.name] = set()
+        self._tokens[handle.name] = 0
+        self._routed[handle.name] = 0
+        self.stats_counters["replicas_added"] += 1
+        trace.event("router_grow", cat="control", replica=handle.name,
+                    warmed_chains=warmed, replicas=len(self.handles))
+
+    def _warm_from(self, handle: Any, donor: Any, limit: int) -> int:
+        """Replay the donor's longest cached prefix chains as 1-token
+        generations on the new replica (outputs discarded) — the new
+        prefix cache ends up holding the same chains the donor's sticky
+        pins reference.  Best-effort: a donor without a prefix cache
+        (or with none populated) warms nothing."""
+        pfx = getattr(getattr(donor, "engine", None), "_pfx", None)
+        entries = getattr(pfx, "_entries", None)
+        if not entries:
+            return 0
+        parents = {e.parent for e in entries.values()}
+        chains: List[List[int]] = []
+        for key, ent in entries.items():
+            if key in parents:
+                continue          # interior node — a longer chain covers it
+            toks: List[int] = []
+            cur, ok = ent, True
+            while True:
+                toks[:0] = cur.tokens
+                if cur.parent == ROOT_HASH:
+                    break
+                cur = entries.get(cur.parent)
+                if cur is None:   # chain broken mid-walk (evicted link)
+                    ok = False
+                    break
+            if ok and toks:
+                chains.append(toks)
+        chains.sort(key=len, reverse=True)
+        warmed = 0
+        for toks in chains[:max(int(limit), 0)]:
+            p = np.asarray(toks, np.int32)
+            try:
+                handle.validate(p, 1)
+            except ValueError:
+                continue          # chain outgrew the new replica's limits
+            handle.put_async(p, {"max_new_tokens": 1}, self.clock(),
+                             on_done=None)
+            warmed += 1
+        if warmed:
+            handle.drain_async(on_done=None)
+            handle.join_all()     # discard warm-up outputs
+        return warmed
+
+    def retire_replica(self, name: str,
+                       target: Optional[str] = None) -> Dict[str, Any]:
+        """Drain ``name`` out of the routed set without losing work:
+        stop routing to it, hand its parked sessions (waiting queue,
+        spilled KV travelling in spill format with donor digests) to a
+        survivor, finish its in-flight requests in place, migrate its
+        affinity pins, then close and remove it.  Returns a summary
+        dict; raises :class:`RouterRejection` when no survivor exists."""
+        h = next((x for x in self.handles if x.name == name), None)
+        if h is None:
+            raise ValueError(f"unknown replica {name!r}")
+        survivors = [x for x in self.handles
+                     if x.alive and x.name != name
+                     and x.name not in self._retiring]
+        if not survivors:
+            raise RouterRejection(
+                f"cannot retire {name!r}: no surviving replica "
+                f"to absorb its sessions")
+        if target is not None:
+            tgt = next((x for x in survivors if x.name == target), None)
+            if tgt is None:
+                raise ValueError(f"target {target!r} is not a live, "
+                                 f"non-retiring survivor")
+        else:
+            tgt = min(survivors,
+                      key=lambda x: (self._tokens[x.name], x.idx))
+        self._retiring.add(name)
+        handed_off = 0
+        try:
+            # 1. parked-session handoff: settle queued admits so every
+            # waiting request has a uid, then export the waiting queue
+            sessions: List[Dict[str, Any]] = []
+            exporter = getattr(h, "export_parked_async", None)
+            if h.alive and exporter is not None:
+                try:
+                    h.join_all()
+                    box: List[Any] = []
+                    exporter(on_done=box.append)
+                    h.join_all()
+                    sessions = box[0] if box else []
+                except Exception as e:
+                    self._on_replica_death(h, e)
+                    sessions = []
+            if sessions and tgt.alive:
+                nbox: List[Any] = []
+                tgt.import_parked_async(sessions, on_done=nbox.append)
+                tgt.join_all()
+                new_uids = nbox[0] if nbox else []
+                for s, new_uid in zip(sessions, new_uids):
+                    rid = self._uid_rid.pop((name, int(s["uid"])), None)
+                    if rid is None:
+                        continue
+                    req = self._live.get(rid)
+                    self._uid_rid[(tgt.name, int(new_uid))] = rid
+                    self._assigned[name].discard(rid)
+                    self._assigned[tgt.name].add(rid)
+                    if req is not None:
+                        self._tokens[name] -= req.cost
+                        self._tokens[tgt.name] += req.cost
+                        req.replica = tgt.name
+                        req.uid = int(new_uid)
+                    handed_off += 1
+            # 2. finish the retiring replica's in-flight work in place
+            # (it takes no new assignments — _dispatchable excludes it)
+            while h.alive and self._assigned.get(name):
+                self.pump()
+                self.join()
+        finally:
+            self._retiring.discard(name)
+        # 3. re-home sticky pins so chains follow the sessions
+        moved_pins = 0
+        for k in [k for k, v in self._affinity.items() if v == name]:
+            self._affinity[k] = tgt.name
+            moved_pins += 1
+        try:
+            h.close()
+        except Exception:
+            pass
+        self.handles = [x for x in self.handles if x.name != name]
+        self._assigned.pop(name, None)
+        self._tokens.pop(name, None)
+        self._pressure.pop(name, None)
+        self.stats_counters["replicas_retired"] += 1
+        self.stats_counters["sessions_handed_off"] += handed_off
+        trace.event("router_shrink", cat="control", replica=name,
+                    target=tgt.name, handed_off=handed_off,
+                    moved_pins=moved_pins, replicas=len(self.handles))
+        return {"replica": name, "target": tgt.name,
+                "handed_off": handed_off, "moved_pins": moved_pins}
 
     def join(self) -> None:
         """Fold every outstanding replica op (blocking)."""

@@ -29,7 +29,16 @@ checkpoint, and let a rebuilt engine resume from it — the elastic
 restart story end-to-end.  Any corruption that trains on undetected
 exits nonzero.
 
-``--all`` = the base checkpoint-fault schedule + ``--comm`` + ``--sdc``.
+``--reslice`` runs the ELASTIC RE-SLICE pass: one of two ranks is
+killed mid-step (a preemption with no scheduler notice), and the
+elastic agent must relaunch at world-1 — re-solving the batch menu,
+re-slicing the ZeRO checkpoint across the smaller world, resuming loss
+from the last verified tag — and land on a final trained state matching
+an uninterrupted 2-device run, with the restart decision recorded as a
+``cat="control"`` trace event.
+
+``--all`` = the base checkpoint-fault schedule + ``--comm`` + ``--sdc``
++ ``--reslice``.
 
 Every hard-failure class the soak exercises must additionally leave a
 PARSEABLE flight-recorder dump (``deepspeed_tpu/telemetry/flight.py``):
@@ -45,6 +54,7 @@ Usage::
     python scripts/chaos_train.py --steps 30 --seed 0
     python scripts/chaos_train.py --steps 50 --faults 8 --seed 3
     python scripts/chaos_train.py --steps 10 --comm
+    python scripts/chaos_train.py --steps 10 --reslice
     python scripts/chaos_train.py --steps 10 --all
 """
 import argparse
@@ -58,6 +68,11 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests",
                                 "unit"))
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--reslice" in sys.argv or "--all" in sys.argv:
+    # the re-slice pass kills one of two ranks; give the CPU backend two
+    # virtual devices (must land before jax initializes its backend)
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=2")
 
 import jax  # noqa: E402
 import numpy as np  # noqa: E402
@@ -375,6 +390,120 @@ def sdc_fault_pass(seed: int) -> int:
     return undetected
 
 
+def reslice_pass(seed: int) -> int:
+    """Elastic re-slice pass (returns the number of failed checks):
+    kill one of two ranks MID-STEP (preemption with no notice), let
+    :class:`DSElasticAgent` relaunch at world-1 — the batch menu
+    re-solves, the checkpoint re-slices across the smaller world, loss
+    continues from the last verified tag — and require the final
+    trained state to match an uninterrupted 2-device run."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.launcher import DSElasticAgent, PreemptionError
+    from deepspeed_tpu.telemetry import trace
+
+    if len(jax.devices()) < 2:
+        print(f"FAIL: reslice pass needs >= 2 devices, got "
+              f"{len(jax.devices())}")
+        return 1
+
+    class ElasticNet(nn.Module):
+        @nn.compact
+        def __call__(self, batch):
+            h = nn.Dense(32)(batch["x"])
+            out = nn.Dense(1)(nn.relu(h))
+            return jnp.mean((out - batch["y"]) ** 2)
+
+    # no explicit batch triple: the elasticity menu owns it, so both
+    # world 2 (4x2) and world 1 (4x4) solve to the same global batch 16
+    ds_cfg = {
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+        "elasticity": {"enabled": True, "version": 0.2,
+                       "micro_batch_sizes": [2, 4],
+                       "max_train_batch_size": 16,
+                       "min_gpus": 1, "max_gpus": 8,
+                       "num_gpus_per_node": 1},
+        "steps_per_print": 1_000_000,
+    }
+
+    def elastic_data(step, gbs):
+        rng = np.random.default_rng(seed * 1000 + 100 + step)
+        x = rng.standard_normal((gbs, 8)).astype(np.float32)
+        return {"x": x, "y": np.sum(x, axis=1, keepdims=True) * 0.1}
+
+    def build(topo, cfg):
+        eng, *_ = deepspeed_tpu.initialize(
+            model=ElasticNet(), config=cfg, topology=topo,
+            example_batch=jax.tree_util.tree_map(
+                lambda a: a[:1], elastic_data(0, 16)),
+            rng=jax.random.PRNGKey(0))
+        return eng
+
+    steps = 8
+    baseline = DSElasticAgent(
+        build, ds_cfg, tempfile.mkdtemp(prefix="chaos_reslice_base_"),
+        device_provider=lambda: jax.devices()[:2],
+        save_interval=100).run(elastic_data, steps)
+    want = jax.tree_util.tree_map(np.asarray,
+                                  baseline.module_state_dict())
+
+    world = {"n": 2}
+    tripped = {"done": False}
+
+    def provider():
+        return jax.devices()[:world["n"]]
+
+    def killing_data(step, gbs):
+        if step == 4 and not tripped["done"]:
+            tripped["done"] = True      # rank 1 dies mid-step: the
+            world["n"] = 1              # next rendezvous sees world-1
+            raise PreemptionError("rank 1 lost mid-step")
+        return elastic_data(step, gbs)
+
+    agent = DSElasticAgent(
+        build, ds_cfg, tempfile.mkdtemp(prefix="chaos_reslice_"),
+        device_provider=provider, save_interval=2)
+    engine = agent.run(killing_data, steps)
+
+    failures = 0
+    if (agent.restarts != 1
+            or agent.restart_reasons != {"membership_change": 1}):
+        print(f"FAIL: expected one membership_change restart, got "
+              f"restarts={agent.restarts} "
+              f"reasons={agent.restart_reasons}")
+        failures += 1
+    new_world = len(engine.mesh.devices.flatten())
+    if new_world != 1:
+        print(f"FAIL: re-sliced mesh has {new_world} devices, "
+              "expected 1")
+        failures += 1
+    got = jax.tree_util.tree_map(np.asarray, engine.module_state_dict())
+    try:
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4,
+                                                    atol=2e-5),
+            want, got)
+    except AssertionError as e:
+        print(f"FAIL: post-reslice final state diverged from the "
+              f"uninterrupted 2-device run: {e}")
+        failures += 1
+    events = [e for e in trace.snapshot()
+              if e.get("name") == "elastic_restart"]
+    if (not events or events[-1].get("cat") != "control"
+            or events[-1]["args"].get("reason") != "membership_change"):
+        print("FAIL: no cat=control elastic_restart trace event "
+              "recorded for the re-slice")
+        failures += 1
+    if not failures:
+        print("  reslice: killed 1 of 2 ranks mid-step; relaunched at "
+              "world 1, resumed from the last verified tag, final "
+              "state matches the uninterrupted run "
+              f"(restarts={agent.restarts})")
+    return failures
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--steps", type=int, default=30)
@@ -389,13 +518,19 @@ def main(argv=None) -> int:
                          "(bit flips in the NVMe swap hot path: "
                          "transient heals, persistent quarantines + "
                          "emergency checkpoint + restart)")
+    ap.add_argument("--reslice", action="store_true",
+                    help="also run the elastic re-slice pass (kill one "
+                         "of two ranks mid-step; the agent relaunches "
+                         "at world-1, re-slices the checkpoint, and "
+                         "lands on the uninterrupted final state)")
     ap.add_argument("--all", action="store_true",
-                    help="the full sweep: base schedule + --comm + --sdc")
+                    help="the full sweep: base schedule + --comm + "
+                         "--sdc + --reslice")
     ap.add_argument("--dir", default=None,
                     help="checkpoint dir (default: fresh tmpdir)")
     args = ap.parse_args(argv)
     if args.all:
-        args.comm = args.sdc = True
+        args.comm = args.sdc = args.reslice = True
 
     ckpt_dir = args.dir or tempfile.mkdtemp(prefix="chaos_ckpt_")
     # isolate this soak's flight dumps so the parseability assertions
@@ -483,6 +618,12 @@ def main(argv=None) -> int:
             print(f"FAIL: {sdc_undetected} silent corruptions went "
                   "undetected")
             return 1
+    if args.reslice:
+        print("elastic re-slice pass:")
+        reslice_failures = reslice_pass(args.seed)
+        if reslice_failures:
+            print(f"FAIL: {reslice_failures} re-slice check(s) failed")
+            return 1
     print("flight recorder pass:")
     flight_failures += flight_fault_pass()
     if flight_failures:
@@ -493,6 +634,7 @@ def main(argv=None) -> int:
           f"{recovered} recoveries, final checkpoint verified"
           + (", comm fault pass clean" if args.comm else "")
           + (", sdc fault pass clean" if args.sdc else "")
+          + (", elastic re-slice exact" if args.reslice else "")
           + ", flight dumps parseable")
     return 0
 

@@ -55,6 +55,16 @@ seats — the queued one's TTFT breaches a calibrated SLO) while
 dropping the fast ones, and metrics+sampling-on wall must stay within
 5% of all-off (min of 3 runs each).
 
+With ``--elastic`` it additionally gates elastic serving: one replica
+grows to two mid-traffic (the newcomer prefix-warmed from the donor),
+then the original retires — parked sessions (including one with
+SPILLED private KV pages) travel to the survivor in spill format with
+the donor's spill-time digests, in-flight requests finish in place —
+and the run exits NONZERO if any request is lost or duplicated, if any
+greedy output diverges from a static single engine, if the shrink
+handed off nothing (vacuous), or if any restored page on the survivor
+skipped digest verification.
+
 With ``--autotune`` it additionally gates the closed-loop control
 plane: a deliberately mis-tuned engine (harvest_interval=1,
 async_depth=1) served by the online controller must converge back to
@@ -70,6 +80,7 @@ already-tuned config (min of 3 runs each).
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --kv-quant
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --trace
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --metrics
+    JAX_PLATFORMS=cpu python scripts/serve_smoke.py --elastic
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --autotune
 """
 import argparse
@@ -115,6 +126,13 @@ def main() -> int:
                         "outputs bit-identical to single-engine, both "
                         "replicas served traffic, admission sheds "
                         "loudly at the queue cap)")
+    p.add_argument("--elastic", action="store_true",
+                   help="also gate elastic serving (grow 1->2 then "
+                        "retire the original under open-loop traffic: "
+                        "request conservation, greedy bit-parity vs a "
+                        "static single engine, parked sessions handed "
+                        "off in spill format and restored "
+                        "digest-verified on the survivor)")
     p.add_argument("--autotune", action="store_true",
                    help="also gate the closed-loop control plane "
                         "(mis-tuned engine converges to hand-tuned "
@@ -777,6 +795,116 @@ def main() -> int:
               f"routed_r1={r_stats['routed_r1']} "
               f"affinity_hits={r_stats['affinity_hits']} "
               f"cap_shed={cap_hit}")
+    if args.elastic:
+        # ---- elastic serving: grow 1->2, then retire the original ----
+        # world-size change as a recoverable event: a replica joins a
+        # RUNNING router (prefix-warmed from the donor), the original
+        # retires mid-traffic (parked sessions travel to the survivor
+        # in spill format with the donor's digests; in-flight requests
+        # finish in place), and the whole run stays bit-identical to a
+        # static single engine
+        from deepspeed_tpu.serving import ReplicaSet, Router
+
+        e_rng = np.random.default_rng(args.seed + 5)
+        e_prompts = [e_rng.integers(1, 64, size=(n,), dtype=np.int32)
+                     for n in (12, 20, 9, 16, 10, 14, 18, 8)]
+        e_new = min(args.tokens, 40)
+
+        def e_engine(i=0):
+            # pool sized so the first wave cannot stay resident: the
+            # engine parks spilled sessions in its waiting queue, which
+            # is exactly what the retirement handoff must carry over
+            return RaggedInferenceEngineV2(
+                LlamaForCausalLM(cfg), params=params, max_seqs=4,
+                max_seq_len=max_len, prefill_chunk=16, page_size=16,
+                num_pages=9, decode_block_size=4,
+                kv_reserve="on_demand", kv_tiering={"host_pages": 64},
+                rng=jax.random.PRNGKey(args.seed))
+
+        ref_eng = e_engine()
+        e_ref = {}
+        e_order = {ref_eng.put_request(p, max_new_tokens=e_new): i
+                   for i, p in enumerate(e_prompts)}
+        while ref_eng.has_work():
+            ref_eng.step()
+            for uid, toks in ref_eng.get_outputs():
+                e_ref[e_order[uid]] = toks
+        ref_eng.sync()
+        for uid, toks in ref_eng.get_outputs():
+            e_ref[e_order[uid]] = toks
+        ref_eng.close()
+
+        rs = ReplicaSet(e_engine, 1)
+        router = Router(rs, policy="least_tokens")
+        e_rids = {}
+        for i, prompt in enumerate(e_prompts[:4]):
+            e_rids[router.submit(prompt, max_new_tokens=e_new)] = i
+        # open-loop pumping until pool pressure parks a SPILLED session
+        # in the waiting queue (all ops joined before the peek)
+        donor_eng = rs[0].engine
+        spill_parked = False
+        for _ in range(400):
+            router.pump()
+            router.join()
+            if any(r.spilled is not None for r in donor_eng.waiting):
+                spill_parked = True
+                break
+            if not router.outstanding:
+                break
+        if not spill_parked:
+            print("FAIL [elastic]: vacuous run — no spilled session was "
+                  "parked on the donor before the shrink")
+            failures += 1
+        (h2,) = rs.grow(1)
+        router.add_replica(h2, warm_from=rs.handles[0])
+        for i, prompt in enumerate(e_prompts[4:], start=4):
+            e_rids[router.submit(prompt, max_new_tokens=e_new)] = i
+        routed_r0 = router.stats()["routed_r0"]
+        summary = router.retire_replica("r0")
+        rs.shrink("r0")
+        e_outs = router.drain()
+        e_stats = router.stats()
+
+        if sorted(e_rids[k] for k in e_outs) != sorted(e_ref):
+            print(f"FAIL [elastic]: request conservation broke across "
+                  f"grow+shrink ({len(e_outs)} of {len(e_ref)} "
+                  f"finished)")
+            failures += 1
+        else:
+            diverged = [i for rid, i in e_rids.items()
+                        if not np.array_equal(e_outs[rid], e_ref[i])]
+            if diverged:
+                print(f"FAIL [elastic]: greedy outputs diverged from "
+                      f"the static single engine for requests "
+                      f"{diverged}")
+                failures += 1
+        if summary["handed_off"] < 1:
+            print("FAIL [elastic]: vacuous shrink — the retired "
+                  "replica handed off zero parked sessions")
+            failures += 1
+        if not (routed_r0 > 0 and e_stats["routed_r1"] > 0):
+            print(f"FAIL [elastic]: a replica served zero requests "
+                  f"(routed_r0={routed_r0} "
+                  f"routed_r1={e_stats['routed_r1']})")
+            failures += 1
+        tc = rs[0].engine.tiering.counters
+        if spill_parked and tc["imports"] < 1:
+            print("FAIL [elastic]: the parked spilled session did not "
+                  "travel in spill format (survivor imports=0)")
+            failures += 1
+        if tc["pages_verified"] != tc["pages_restored"]:
+            print(f"FAIL [elastic]: restored pages skipped digest "
+                  f"verification (verified={tc['pages_verified']} "
+                  f"restored={tc['pages_restored']})")
+            failures += 1
+        rs.close()
+        print(f"[elastic] requests={len(e_outs)} "
+              f"handed_off={summary['handed_off']} "
+              f"moved_pins={summary['moved_pins']} "
+              f"routed_r0={routed_r0} "
+              f"routed_r1={e_stats['routed_r1']} "
+              f"survivor_imports={tc['imports']} "
+              f"pages_verified={tc['pages_verified']}")
     if args.autotune:
         # ---- closed-loop control plane over a mis-tuned engine -------
         # the controller must walk a deliberately detuned engine back
@@ -926,6 +1054,8 @@ def main() -> int:
            if args.metrics else "") +
           (", routed serving bit-identical across 2 replicas with "
            "loud queue-cap shedding" if args.router else "") +
+          (", elastic grow+shrink conserved every request bit-exactly "
+           "with digest-verified handoff" if args.elastic else "") +
           (", control plane converged the mis-tuned engine with clean "
            "guard and attributable decisions" if args.autotune else ""))
     return 0

@@ -29,7 +29,8 @@ def _gpt2_cfg(**kw):
                       remat=False, **kw)
 
 
-@pytest.mark.parametrize("family", ["llama", "gpt2"])
+@pytest.mark.parametrize(
+    "family", [pytest.param("llama", marks=pytest.mark.slow), "gpt2"])
 def test_cached_decode_matches_full_forward(devices, family):
     """Prefill+incremental decode logits == full-sequence forward logits."""
     if family == "llama":
@@ -69,6 +70,7 @@ def test_cached_decode_matches_full_forward(devices, family):
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_generate_greedy_matches_manual_argmax(devices):
     """engine.generate(greedy) == repeated full-forward argmax."""
     from deepspeed_tpu.models.llama import LlamaForCausalLM
@@ -160,6 +162,7 @@ def test_generate_async_deferred_harvest(devices):
             "harvest_ms", "host_bound_fraction"} <= set(stages)
 
 
+@pytest.mark.slow
 def test_engine_tp_sharded_generation(devices):
     """TP=2 serving: params sharded over `tensor`, same greedy tokens."""
     from deepspeed_tpu.models.llama import LlamaForCausalLM

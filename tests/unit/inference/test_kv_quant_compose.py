@@ -174,7 +174,8 @@ class TestPlumbing:
 
 class TestComposition:
 
-    @pytest.mark.parametrize("fmt", ["int8", "fp8"])
+    @pytest.mark.parametrize(
+        "fmt", ["int8", pytest.param("fp8", marks=pytest.mark.slow)])
     def test_tiering_spill_restore_byte_identical(self, params, fmt):
         """Spilling a quantized sequence and restoring it changes
         NOTHING: greedy outputs equal the never-spilled quantized run,

@@ -78,6 +78,7 @@ class TestParityWithV1:
                                          max_new_tokens=4).values()))
         np.testing.assert_array_equal(out, solo(v1, prompt, 4))
 
+    @pytest.mark.slow
     def test_queueing_more_requests_than_slots(self, params, v1):
         prompts = _prompts([4, 6, 3, 7, 5, 8], seed=3)
         eng = make_v2(params, max_seqs=2)
@@ -221,6 +222,7 @@ class TestTensorParallelServing:
                                     devices=devices[:max(tp, 1)])
         return make_v2(params, topology=topo, **kw)
 
+    @pytest.mark.slow
     def test_tp2_matches_single_device(self, params, v1, devices):
         prompts = _prompts([5, 9, 3, 12], seed=12)
         eng = self._tp_engine(params, 2, devices, decode_block_size=4)
@@ -285,6 +287,7 @@ class TestModelBreadth:
                                          do_sample=False))[0]
             np.testing.assert_array_equal(outs[uid], ref)
 
+    @pytest.mark.slow
     def test_phi3_ragged_serving(self):
         from deepspeed_tpu.models.phi3 import Phi3ForCausalLM, get_config
 
@@ -294,6 +297,7 @@ class TestModelBreadth:
                          max_position_embeddings=64)
         self._serve_matches_v1(Phi3ForCausalLM, cfg, seed=21)
 
+    @pytest.mark.slow
     def test_qwen2_moe_ragged_serving(self):
         from deepspeed_tpu.models.qwen2_moe import (Qwen2MoeForCausalLM,
                                                     get_config)
@@ -304,6 +308,7 @@ class TestModelBreadth:
                          max_position_embeddings=64)
         self._serve_matches_v1(Qwen2MoeForCausalLM, cfg, seed=22)
 
+    @pytest.mark.slow
     def test_qwen2_moe_ragged_tp2(self, devices):
         """Ragged MoE decode under tensor parallelism: expert banks shard
         w1/w3 on their output dim, w2 on input (AutoTP 3D rules)."""
@@ -338,6 +343,7 @@ class TestModelBreadth:
         for got, ref in zip([outs[u] for u in sorted(outs)], sols):
             np.testing.assert_array_equal(got, ref)
 
+    @pytest.mark.slow
     def test_falcon_ragged_serving(self):
         """Falcon (parallel-residual MQA) through the ragged paged path —
         4th family through FastGen v2 (reference falcon/model.py)."""
@@ -350,6 +356,7 @@ class TestModelBreadth:
                          max_position_embeddings=64)
         self._serve_matches_v1(FalconForCausalLM, cfg, seed=23)
 
+    @pytest.mark.slow
     def test_phi_ragged_serving(self):
         """Phi (partial rotary + parallel residual) through the ragged
         paged path (reference phi/model.py) — partial rotary composes
@@ -362,6 +369,7 @@ class TestModelBreadth:
                          max_position_embeddings=64)
         self._serve_matches_v1(PhiForCausalLM, cfg, seed=29)
 
+    @pytest.mark.slow
     def test_gptj_ragged_serving(self):
         """GPT-J (interleaved->half partial rotary, parallel residual)
         through the ragged paged path."""
@@ -373,6 +381,7 @@ class TestModelBreadth:
                          max_position_embeddings=64)
         self._serve_matches_v1(GPTJForCausalLM, cfg, seed=31)
 
+    @pytest.mark.slow
     def test_gptneox_ragged_serving(self):
         """GPT-NeoX (twin-LN parallel residual, qkv+out biases) through
         the ragged paged path."""

@@ -134,6 +134,7 @@ def test_weight_quant_ragged_engine(params):
         assert np.isfinite(toks).all()
 
 
+@pytest.mark.slow
 def test_w8a8_native_int8_dots(params):
     """quantize_weights="w8a8" (explicit opt-in: it quantizes
     activations too) runs the NATIVE path on Llama-family models:

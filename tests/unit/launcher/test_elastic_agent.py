@@ -96,6 +96,7 @@ def test_reslice_8_to_4_matches_uninterrupted(tmp_path, devices):
                            device_provider=provider, save_interval=100)
     engine = agent.run(shrinking_data, 8)
     assert agent.restarts == 1
+    assert agent.restart_reasons == {"membership_change": 1}
     assert len(engine.mesh.devices.flatten()) == 4
     got = _final_params(engine)
     jax.tree_util.tree_map(
@@ -127,7 +128,11 @@ def test_hard_failure_resumes_from_periodic_save(tmp_path, devices):
         baseline, got)
 
 
-def test_restart_budget_exhausts(tmp_path, devices):
+def test_restart_budget_exhausts(tmp_path, devices, monkeypatch):
+    """Budget exhaustion leaves a black box: a flight record carrying
+    the restart timeline (reasons, backoffs, last world)."""
+    monkeypatch.setenv("DSTPU_FLIGHT_DIR", str(tmp_path / "flight"))
+
     def always_failing(step, gbs):
         raise PreemptionError("flaky")
 
@@ -136,3 +141,70 @@ def test_restart_budget_exhausts(tmp_path, devices):
                            max_restarts=2)
     with pytest.raises(RuntimeError, match="exceeded 2 restarts"):
         agent.run(always_failing, 4)
+    assert agent.restart_reasons == {"membership_change": 3}
+    from deepspeed_tpu.telemetry import flight
+    path = flight.last_dump_path()
+    assert path and os.path.dirname(path) == str(tmp_path / "flight")
+    header, _events = flight.read_flight_record(path)
+    assert header["reason"] == "restart_budget_exhausted"
+    assert header["extra"]["restarts"] == 3
+    assert header["extra"]["restart_reasons"] == {"membership_change": 3}
+    assert header["extra"]["last_world"] == 8
+
+
+def test_restart_counter_and_trace_emitted(tmp_path, devices):
+    """Satellite contract: every restart decision is a cat="control"
+    trace event plus a dstpu_restarts_total{reason} counter tick."""
+    from deepspeed_tpu import telemetry
+    from deepspeed_tpu.telemetry import trace
+    from deepspeed_tpu.telemetry.metrics import metrics as _metrics
+
+    telemetry.configure(enabled=True)
+    fam = _metrics.counter("dstpu_restarts_total",
+                           "Elastic agent restarts by reason",
+                           labels=("reason",))
+    before = fam.labels(reason="membership_change").value()
+    try:
+        tripped = {"done": False}
+
+        def failing_data(step, gbs):
+            if step == 2 and not tripped["done"]:
+                tripped["done"] = True
+                raise PreemptionError("simulated chip loss")
+            return data_fn(step, gbs)
+
+        agent = DSElasticAgent(build_engine, DS,
+                               str(tmp_path / "traced"),
+                               device_provider=lambda: jax.devices(),
+                               save_interval=2)
+        agent.run(failing_data, 4)
+        events = [e for e in trace.snapshot()
+                  if e.get("name") == "elastic_restart"]
+        assert events and events[-1]["cat"] == "control"
+        assert events[-1]["args"]["reason"] == "membership_change"
+        assert fam.labels(reason="membership_change").value() == before + 1
+        assert 'dstpu_restarts_total{reason="membership_change"}' \
+            in _metrics.export_text()
+    finally:
+        telemetry.configure(enabled=False)
+
+
+def test_incompatible_world_fails_fast(tmp_path, devices):
+    """An impossible world must raise the elasticity error (listing
+    nearest valid worlds) BEFORE engine/mesh construction — not burn
+    down the restart budget."""
+    from deepspeed_tpu.elasticity import ElasticityIncompatibleWorldSize
+
+    cfg = {**DS, "elasticity": {**DS["elasticity"],
+                                "micro_batch_sizes": [4],
+                                "max_train_batch_size": 8}}
+
+    def never_build(topo, c):               # must not be reached
+        raise AssertionError("engine built despite invalid world")
+
+    agent = DSElasticAgent(never_build, cfg, str(tmp_path / "bad"),
+                           device_provider=lambda: jax.devices()[:3])
+    with pytest.raises(ElasticityIncompatibleWorldSize) as exc:
+        agent.run(data_fn, 4)
+    assert agent.restarts == 0
+    assert exc.value.nearest                # suggests schedulable worlds

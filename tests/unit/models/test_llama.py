@@ -85,6 +85,7 @@ def test_flash_matches_naive_attention():
     assert abs(l_naive - l_flash) < 1e-4
 
 
+@pytest.mark.slow
 def test_scan_matches_unrolled():
     rng = np.random.default_rng(4)
     batch = _batch(rng, B=2, S=16)
@@ -119,6 +120,7 @@ def test_scan_matches_unrolled():
                                float(m_u.apply(p_u, batch)), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_llama_trains_with_zero3_tp(devices):
     topo = dist.initialize_mesh(dp=4, tp=2)
     cfg = _cfg(tensor_parallel=True)

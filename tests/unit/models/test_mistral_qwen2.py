@@ -69,6 +69,7 @@ def _parity(hf, ours, seq=12, tol=5e-4):
 
 
 class TestMistral:
+    @pytest.mark.slow
     def test_logits_parity(self):
         hf, ours, _ = _mistral_pair()
         _parity(hf, ours)

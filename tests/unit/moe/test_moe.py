@@ -126,6 +126,7 @@ def test_moe_grads_flow_to_experts_and_gate():
         assert float(jnp.sum(jnp.abs(g[name]))) > 0, f"zero grad for {name}"
 
 
+@pytest.mark.slow
 def test_mixtral_tiny_trains(devices):
     """End-to-end: tiny Mixtral under the engine on dp=2 x ep=4 mesh with
     ZeRO-1 — BASELINE.md config #5 shape (EP + ZeRO)."""

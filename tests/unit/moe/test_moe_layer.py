@@ -1,3 +1,6 @@
+import pytest
+
+
 
 
 def test_gather_dispatch_matches_einsum(devices):
@@ -59,6 +62,7 @@ def _run_moe_on_mesh(impl, devices, dp, ep, expert_parallel=True,
     return np.asarray(y), float(l_aux)
 
 
+@pytest.mark.slow
 def test_alltoall_matches_einsum_on_mesh(devices):
     """The shard_map all-to-all dispatch (per-shard sorted + explicit
     lax.all_to_all over the expert axis) matches the GSPMD einsum oracle

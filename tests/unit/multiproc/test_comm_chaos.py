@@ -74,6 +74,7 @@ def _launch(mode: str, fault: str = None, fault_rank: int = 1,
     return results, time.monotonic() - t0
 
 
+@pytest.mark.slow
 def test_corrupted_all_reduce_detected():
     """A lossy link corrupts rank 1's local view of one all_reduce; the
     cross-rank desync check must catch it on EVERY rank and abort with
@@ -90,6 +91,7 @@ def test_corrupted_all_reduce_detected():
                for _, out in results)
 
 
+@pytest.mark.slow
 def test_straggler_rank_named():
     """An injected slow rank (arrives 0.4s late on 3 calls) must be
     NAMED in the cross-rank straggler report and log_summary on every
@@ -105,6 +107,7 @@ def test_straggler_rank_named():
         assert "STRAGGLER rank 1" in out
 
 
+@pytest.mark.slow
 def test_dropped_collective_watchdog_abort():
     """Rank 1 silently skips an all_reduce; rank 0 must NOT hang — the
     collective watchdog fires its deadline and both workers exit

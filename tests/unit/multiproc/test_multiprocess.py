@@ -83,6 +83,7 @@ def _launch(mode: str, scratch: str, nproc: int = 2, timeout: int = 480,
 # jax.distributed.initialize now rides the resilience.retry backoff
 # decorator, which holds on this transport — the skip is gone
 @pytest.mark.parametrize("mode", ["train", "nvme"])
+@pytest.mark.slow
 def test_two_process_zero3_train_checkpoint(tmp_path, mode):
     results = _launch(mode, str(tmp_path))
     r0, r1 = results[0], results[1]

@@ -61,6 +61,7 @@ def test_cross_attention_lengths():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_causal_cross_attention_decode_alignment():
     """Bottom-right-aligned causal: a 1-token query over a 64-token KV cache
     (decode step) must attend to ALL keys, and gradients must match."""

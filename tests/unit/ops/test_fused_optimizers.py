@@ -101,6 +101,7 @@ def test_lion_kernel_interpret_matches_jnp():
     np.testing.assert_allclose(np.asarray(m_k), np.asarray(m_j), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_engine_trains_with_fused_adam(devices):
     """End-to-end: engine with explicit FusedAdam converges."""
     import deepspeed_tpu

@@ -135,6 +135,7 @@ def _ds_cfg(stage=0):
     }
 
 
+@pytest.mark.slow
 def test_engine_pp_params_sharded_on_pipe_axis(devices):
     topo = dist.initialize_mesh(dp=4, pp=2)
     rng = np.random.default_rng(5)
@@ -155,6 +156,7 @@ def test_engine_pp_params_sharded_on_pipe_axis(devices):
     assert all(np.isfinite(l) for l in losses)
 
 
+@pytest.mark.slow
 def test_engine_pp_zero1_tp_composes(devices):
     """pp=2 x tp=2 x dp=2 with ZeRO-1: the full 3D-parallel stack."""
     topo = dist.initialize_mesh(dp=2, tp=2, pp=2)

@@ -35,6 +35,7 @@ def _batch(rng):
     return {"input_ids": rng.integers(0, 128, size=(8, 32), dtype=np.int32)}
 
 
+@pytest.mark.slow
 def test_model_init_carries_partitioning(devices):
     model = GPT2LMLoss(_tiny_cfg(tp=True))
     rng = np.random.default_rng(0)
@@ -74,6 +75,7 @@ def test_tp_engine_params_sharded_on_tensor_axis(devices):
     assert shard[-1] == leaf.shape[-1] // 4
 
 
+@pytest.mark.slow
 def test_tp_matches_dp_loss_trajectory(devices):
     """tp=4 x dp=2 must train identically to pure dp=8 (same seed)."""
     rng = np.random.default_rng(2)

@@ -60,6 +60,7 @@ class TestChunkedAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-3, atol=2e-3)
 
+    @pytest.mark.slow
     def test_gradients_match_full(self):
         q, k, v = _qkv(B=1, H=2, S=64, D=8)
 

@@ -336,7 +336,10 @@ def _single_engine_reference(params, prompts, max_new):
 
 
 class TestRoutedBitParity:
-    @pytest.mark.parametrize("policy", ["rr", "least_tokens", "pressure"])
+    @pytest.mark.parametrize(
+        "policy",
+        [pytest.param("rr", marks=pytest.mark.slow), "least_tokens",
+         pytest.param("pressure", marks=pytest.mark.slow)])
     def test_greedy_outputs_match_single_engine(self, params, policy):
         prompts = _prompts((5, 9, 13, 7, 11, 6, 8, 10))
         ref = _single_engine_reference(params, prompts, max_new=12)

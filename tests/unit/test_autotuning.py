@@ -111,6 +111,7 @@ class TestModelInfo:
 
 
 class TestEngineBackedTuning:
+    @pytest.mark.slow
     def test_real_engine_runner(self):
         """End-to-end: tune a tiny model with real timed engine steps."""
         import deepspeed_tpu.comm as dist
@@ -209,6 +210,7 @@ class TestOrchestration:
         t.tune(early_stopping=3)
         assert len(sched.finished) < 16
 
+    @pytest.mark.slow
     def test_subprocess_runner_real_engine(self, tmp_path):
         """Isolation end-to-end: a real engine measurement in a fresh
         interpreter, plus a bad config quarantined WITHOUT killing the

@@ -223,6 +223,7 @@ class TestRandomLTD:
 
 
 class TestEngineCurriculum:
+    @pytest.mark.slow
     def test_seqlen_curriculum_truncates_then_grows(self, capsys):
         from tests.unit.simple_model import random_tokens, tiny_gpt2
 

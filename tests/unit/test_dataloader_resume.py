@@ -22,10 +22,11 @@ from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
 from simple_model import tiny_gpt2
 
 
-def _mk_loader(n=20, batch=4, seed=7):
+def _mk_loader(n=20, batch=4, seed=7, world=1):
     ds = [np.array([i]) for i in range(n)]
     return RepeatingLoader(DeepSpeedDataLoader(ds, batch_size=batch,
-                                               seed=seed))
+                                               seed=seed,
+                                               world_size=world))
 
 
 def _drain(loader, n):
@@ -42,7 +43,8 @@ def test_resume_mid_epoch_matches_uninterrupted_run():
     # the generator pauses before its end-of-epoch rollover, so the
     # boundary state reads (epoch 0, cursor 5) — resuming it skips the
     # whole served epoch and rolls into epoch 1, same stream
-    assert state == {"seed": 7, "epoch": 0, "cursor": 5}
+    assert state == {"seed": 7, "epoch": 0, "cursor": 5,
+                     "batch_size": 4, "world_size": 1}
 
     b = _mk_loader()                        # the "restarted process"
     b.load_state_dict(state)
@@ -59,6 +61,50 @@ def test_resume_mid_epoch_cursor_inside_epoch():
     b = _mk_loader()
     b.load_state_dict(state)
     assert head + _drain(b, 5) == reference
+
+
+@pytest.mark.parametrize("src_world,dst_world", [(2, 1), (1, 2)])
+def test_resume_across_world_change_same_global_batch(src_world,
+                                                      dst_world):
+    """Elastic re-slice regression (W=2->1 and W=1->2): the elastic
+    solver keeps the GLOBAL batch constant across the menu, so the
+    cursor — a count of global batches — carries over exactly and the
+    resumed stream is the uninterrupted one (no dropped, no
+    double-visited sample)."""
+    reference = _drain(_mk_loader(world=src_world), 12)
+    a = _mk_loader(world=src_world)
+    head = _drain(a, 7)
+    state = a.state_dict()
+    assert state["world_size"] == src_world
+    b = _mk_loader(world=dst_world)         # relaunched at the new world
+    b.load_state_dict(state)
+    assert b.loader.world_size == dst_world  # live world wins
+    assert head + _drain(b, 5) == reference
+
+
+def test_resume_global_batch_change_remaps_cursor():
+    """A re-slice that DOES change the global batch re-maps the cursor
+    through the sample position instead of resuming a wrong stride."""
+    a = _mk_loader(n=24, batch=4)
+    _drain(a, 3)                             # 12 samples consumed
+    state = a.state_dict()
+    b = _mk_loader(n=24, batch=6)
+    b.load_state_dict(state)
+    assert b.loader.cursor == 2              # 12 samples / batch 6
+    c = _mk_loader(n=24, batch=8)
+    c.load_state_dict(state)
+    # 12 % 8 != 0: floor re-visits 4 samples rather than dropping them
+    assert c.loader.cursor == 1
+
+
+def test_old_state_without_world_keys_still_loads():
+    a = _mk_loader()
+    _drain(a, 2)
+    state = {k: v for k, v in a.state_dict().items()
+             if k in ("seed", "epoch", "cursor")}
+    b = _mk_loader()
+    b.load_state_dict(state)                 # pre-elastic checkpoint
+    assert b.loader.cursor == 2
 
 
 def test_shuffle_off_and_state_roundtrip():
@@ -109,7 +155,8 @@ def test_engine_checkpoint_carries_dataloader_cursor(tmp_path, devices):
     tag, _ = resumed.load_checkpoint(ck)
     assert tag is not None
     assert resumed.training_dataloader.state_dict() == \
-        {"seed": 1234, "epoch": 0, "cursor": 3}
+        {"seed": 1234, "epoch": 0, "cursor": 3,
+         "batch_size": 8, "world_size": 8}
     nxt = resumed._next_batch(None)["input_ids"]
     np.testing.assert_array_equal(nxt, ref_batches[3])
     np.testing.assert_array_equal(

@@ -80,6 +80,39 @@ class TestComputeElasticConfig:
         with pytest.raises(ElasticityIncompatibleWorldSize):
             compute_elastic_config(cfg, world_size=bad)
 
+    def test_off_menu_exception_lists_nearest_valid_worlds(self):
+        cfg = v01()
+        _, menu = compute_elastic_config(v01())
+        bad = max(menu) + 1
+        while bad in menu:
+            bad += 1
+        with pytest.raises(ElasticityIncompatibleWorldSize) as exc:
+            compute_elastic_config(cfg, world_size=bad)
+        e = exc.value
+        assert e.valid_worlds == menu
+        assert e.nearest and set(e.nearest) <= set(menu)
+        assert max(menu) in e.nearest       # closest entry to menu+1
+        assert str(max(menu)) in str(e)     # message names the nearest
+
+    def test_nearest_valid_worlds_helper(self):
+        from deepspeed_tpu.elasticity import nearest_valid_worlds
+        assert nearest_valid_worlds([1, 2, 4, 8, 16], 5) == [2, 4, 8]
+        assert nearest_valid_worlds([10, 20], 1, k=1) == [10]
+        assert nearest_valid_worlds([], 3) == []
+
+    def test_validate_world_size_fails_fast_off_menu(self):
+        from deepspeed_tpu.elasticity import validate_world_size
+        cfg = v01()
+        _, menu = compute_elastic_config(v01())
+        validate_world_size(cfg, menu[0])            # on-menu: fine
+        validate_world_size({"elasticity": {"enabled": False}}, 3)
+        validate_world_size({}, 3)                   # disabled: no-op
+        bad = max(menu) + 1
+        while bad in menu:
+            bad += 1
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            validate_world_size(cfg, bad)
+
     def test_disabled_raises(self):
         cfg = v01()
         cfg["elasticity"]["enabled"] = False

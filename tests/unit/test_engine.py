@@ -41,7 +41,8 @@ def make_engine(stage=0, dp=8, config_overrides=None, **mesh_kw):
     return engine
 
 
-@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+@pytest.mark.parametrize(
+    "stage", [0, 1, 2, pytest.param(3, marks=pytest.mark.slow)])
 def test_train_loss_decreases(stage, devices):
     engine = make_engine(stage)
     batch = random_tokens(16, seed=1)
@@ -50,6 +51,7 @@ def test_train_loss_decreases(stage, devices):
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_zero_stages_agree(devices):
     """All ZeRO stages are pure re-shardings: identical math, so identical
     loss trajectories (up to reduction-order noise) — the reference's

@@ -43,6 +43,7 @@ def _poison(eng):
     eng.state = eng.state.replace(params=nan_params)
 
 
+@pytest.mark.slow
 def test_fp32_steps_skip_nonfinite_and_abort_after_n(devices):
     eng = _engine(check_grad_finite=2)
     assert eng._skip_guard is not None and eng._skip_guard.bound == 2

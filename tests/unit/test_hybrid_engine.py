@@ -35,6 +35,7 @@ class TestHybridEngine:
         assert out.shape == (2, 8)
         assert out.dtype == np.int32
 
+    @pytest.mark.slow
     def test_training_updates_are_visible_to_generate(self, hybrid):
         prompt = np.ones((2, 4), np.int32)
         before = hybrid.generate(prompt, max_new_tokens=4,

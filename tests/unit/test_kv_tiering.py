@@ -231,6 +231,7 @@ class TestEngineTiering:
         assert st["pages_verified"] == st["pages_restored"] > 0
         eon.close()
 
+    @pytest.mark.slow
     def test_seeded_sampling_deterministic_across_spill(self, params):
         kw = dict(do_sample=True, temperature=0.9, top_k=12,
                   max_new_tokens=30)

@@ -359,6 +359,7 @@ def test_nvme_leafwise_fallback_then_bucketed_keeps_moments(tmp_path,
     sw.close()
 
 
+@pytest.mark.slow
 def test_fused_checkpoint_resumes_into_swapped_tier(tmp_path, devices):
     """A checkpoint saved with device-resident (fused) optimizer state
     resumes under the NVMe-swapped tier with its Adam moments INGESTED,

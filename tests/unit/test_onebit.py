@@ -248,6 +248,7 @@ class TestOnebitLamb:
 
 
 class TestEngineIntegration:
+    @pytest.mark.slow
     def test_onebit_adam_engine_stage0(self, topo):
         """Engine accepts OneBitAdam at stage 0 and trains (compressed
         momentum path inside the jitted step)."""

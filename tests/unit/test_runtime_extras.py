@@ -91,6 +91,7 @@ class TestEigenvalue:
         assert ev["1"] == pytest.approx(1.0)          # normalized max
         assert ev["0"] == pytest.approx(0.25, abs=0.02)
 
+    @pytest.mark.slow
     def test_nonconvex_model(self):
         from tests.unit.simple_model import random_tokens, tiny_gpt2
 

@@ -114,6 +114,7 @@ class TestPipelineParity:
         _assert_same_outputs(on, off)
 
     @pytest.mark.parametrize("sample", [False, True])
+    @pytest.mark.slow
     def test_eviction_backpressure(self, params, sample):
         """Tight pool: growth stalls force mid-flight eviction/requeue;
         the pipeline reconciles at exactly the same blocks, so even
@@ -133,6 +134,7 @@ class TestPipelineParity:
         assert eng_on.evictions == eng_off.evictions
         _assert_same_outputs(on, off)
 
+    @pytest.mark.slow
     def test_eos_early_finish(self, params):
         """EOS-bearing sequences force per-block harvests (device-side
         finish detection can't be projected) — outputs still match."""

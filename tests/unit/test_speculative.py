@@ -255,6 +255,7 @@ class TestGreedyParity:
         _assert_same_outputs(on, off)
         eon.allocator.audit()
 
+    @pytest.mark.slow
     def test_k_longer_than_remaining_budget(self, params):
         """max_new_tokens < k: the emission clamp caps the accepted
         prefix at the budget."""
@@ -277,6 +278,7 @@ class TestGreedyParity:
         assert any(v.size == 32 for v in on.values()), \
             "workload should reach the max_seq_len cap"
 
+    @pytest.mark.slow
     def test_eos_early_finish(self, params):
         probe = _serve(params, "off", [5], max_new_tokens=2)[0]
         eos = int(next(iter(probe.values()))[-2])
@@ -286,6 +288,7 @@ class TestGreedyParity:
         _assert_same_outputs(on, off)
         assert any(t[-1] == eos and t.size < 5 + 30 for t in on.values())
 
+    @pytest.mark.slow
     def test_rollback_spanning_harvest_window(self, params):
         """Deferred harvests span several speculative blocks, each with
         data-dependent rollback — fold-back still reconstructs the
@@ -315,6 +318,7 @@ class TestSampledParity:
         off, _ = _serve(params, "ngram", [4, 12, 3], pipeline=False, **kw)
         _assert_same_outputs(on, off)
 
+    @pytest.mark.slow
     def test_draft_pipeline_on_off_bit_identical(self, params,
                                                  draft_params):
         kw = dict(max_new_tokens=9, do_sample=True, temperature=0.9,
@@ -325,6 +329,7 @@ class TestSampledParity:
                         draft_params=draft_params, **kw)
         _assert_same_outputs(on, off)
 
+    @pytest.mark.slow
     def test_mixed_greedy_and_sampled_slots(self, params):
         """One compiled program serves heterogeneous slots; greedy
         slots must still match spec-off exactly."""
