@@ -848,6 +848,76 @@ def bench_ragged(args) -> None:
     t_on.close()
     t_off.close()
 
+    # million-token context (partial residency): the tiered KV store as
+    # virtual memory for attention — the first sink_pages + most recent
+    # window_pages stay HBM-resident while the parked middle streams
+    # back through the chunked attention scan.  One sequence decodes on
+    # a FIXED tiny HBM pool at growing context lengths; the row records
+    # tokens/s vs context, the page-in (restore) stall p99 from the
+    # dstpu_kv_pagein_stall_ms histogram, and the residency ratio
+    # (HBM-resident pages / total KV pages) at each length.
+    from deepspeed_tpu.models.llama import LlamaForCausalLM as _CausalLM
+
+    lc_cfg = get_config(
+        "tinyllama", vocab_size=64, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=1024, dtype=jnp.float32,
+        param_dtype=jnp.float32, scan_layers=False, remat=False,
+        use_flash_attention=False)
+    lc_params = jax.jit(_CausalLM(lc_cfg).init)(
+        jax.random.PRNGKey(2), np.zeros((1, 8), np.int32))
+    lc_tier = {"host_pages": 512, "long_context": True,
+               "sink_pages": 1, "window_pages": 2, "chunk_pages": 2}
+    lc_pool, lc_page, lc_new = 8, 16, 32
+    lc_resident = (lc_tier["sink_pages"] + lc_tier["window_pages"]
+                   + lc_tier["chunk_pages"] + 1)
+    lc_rng = np.random.default_rng(7)
+    lc_ctxs = (128, 256, 512)
+
+    def _lc_serve(ctx, warm=False):
+        prompt = lc_rng.integers(1, 64, size=(ctx - lc_new,),
+                                 dtype=np.int32)
+        eng = RaggedInferenceEngineV2(
+            _CausalLM(lc_cfg), params=lc_params, max_seqs=2,
+            max_seq_len=1024, prefill_chunk=16, page_size=lc_page,
+            num_pages=lc_pool, decode_block_size=4,
+            kv_reserve="on_demand", kv_tiering=dict(lc_tier))
+        t0 = time.perf_counter()
+        outs = eng.generate_all([prompt], max_new_tokens=lc_new)
+        lc_wall = time.perf_counter() - t0
+        assert all(len(t) == ctx for t in outs.values())
+        st = eng.serving_stages()["kv_tiering"]
+        eng.close()
+        total_pages = _pages_for(ctx, lc_page)
+        return {
+            "tokens_per_sec": round(lc_new / max(lc_wall, 1e-9), 1),
+            "wall_s": round(lc_wall, 3),
+            "pageins": st["pageins"],
+            "pagein_pages": st["pagein_pages"],
+            "residency_ratio": round(
+                min(lc_resident, total_pages) / total_pages, 3),
+        }
+
+    _lc_serve(lc_ctxs[0])           # warmup: compiles the scan programs
+    lc_by_ctx = {str(c): _lc_serve(c) for c in lc_ctxs}
+    _lc_hist = _registry.get("dstpu_kv_pagein_stall_ms")
+    _lc_p99 = _lc_hist.quantile(99) if _lc_hist is not None else None
+    detail["long_context"] = {
+        "hbm_pool_pages": lc_pool,
+        "page_size": lc_page,
+        "hbm_resident_pages": lc_resident,
+        "knobs": {k: lc_tier[k] for k in
+                  ("sink_pages", "window_pages", "chunk_pages")},
+        "by_context": lc_by_ctx,
+        "restore_stall_ms_p50": (
+            round(_lc_hist.quantile(50), 3) if _lc_hist else None),
+        "restore_stall_ms_p99": (
+            round(_lc_p99, 3) if _lc_p99 is not None else None),
+        "max_over_hbm_ratio": round(
+            _pages_for(lc_ctxs[-1], lc_page) / (lc_pool - 1), 2),
+    }
+
     # cross-request prefix cache: sessions share a common system
     # prompt; the index attaches fully-matched resident KV pages
     # read-only (copy-on-write on divergence) so each admission

@@ -100,6 +100,23 @@ class KVTieringConfig(ConfigModel):
     verify: bool = True
     checksum: str = "sum64"
     max_reread: int = 2
+    # -- partial residency (long context): a live sequence's page list
+    # may split between HBM-resident pages and parked pages.  The first
+    # ``sink_pages`` (attention sinks) and the most recent
+    # ``window_pages`` stay resident; full middle groups of
+    # ``chunk_pages`` demote through the host/NVMe tiers and stream
+    # back through a fixed staging buffer during the chunked attention
+    # scan.  ``prefetch_lookahead`` bounds how many waiting spilled
+    # sessions the pipeline's restore-prefetch scans ahead (the old
+    # hardcoded islice(waiting, 8)).  ``long_context`` arms the
+    # partial-residency admission path (a request whose full KV exceeds
+    # HBM is admitted as long as its resident window fits HBM and its
+    # total fits the combined tiers).
+    long_context: bool = False
+    sink_pages: int = 1
+    window_pages: int = 8
+    chunk_pages: int = 4
+    prefetch_lookahead: int = 8
 
     @model_validator(mode="after")
     def _check(self):
@@ -114,6 +131,18 @@ class KVTieringConfig(ConfigModel):
                 "kv_tiering.nvme_pages > 0 requires kv_tiering.nvme_dir")
         if self.max_reread < 0:
             raise ValueError("kv_tiering.max_reread must be >= 0")
+        if self.sink_pages < 1:
+            raise ValueError("kv_tiering.sink_pages must be >= 1")
+        if self.window_pages < 1:
+            raise ValueError("kv_tiering.window_pages must be >= 1")
+        if self.chunk_pages < 1:
+            raise ValueError("kv_tiering.chunk_pages must be >= 1")
+        if self.prefetch_lookahead < 1:
+            raise ValueError("kv_tiering.prefetch_lookahead must be >= 1")
+        if self.long_context and not self.enabled:
+            raise ValueError(
+                "kv_tiering.long_context requires kv_tiering.enabled — "
+                "partial residency parks middle pages in the spill tiers")
         from deepspeed_tpu.resilience.sdc import CHECKSUM_ALGOS
 
         if self.checksum not in CHECKSUM_ALGOS:

@@ -34,6 +34,10 @@ import numpy as np
 
 TRASH_PAGE = 0
 
+# same mask-value family as ops/flash_attention.py and the Pallas
+# quantized kernel: vanishes under softmax, (mask - mask) stays exact 0
+_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
 
 # ---------------------------------------------------------------------------
 # Host-side page allocator (reference blocked_allocator.py)
@@ -173,6 +177,17 @@ class PageAllocator:
         self.decref(old)
         return old, new
 
+    def release_pages(self, slot: int, pages: List[int]) -> None:
+        """Release a specific subset of ``slot``'s pages while the slot
+        stays live (partial-residency parking: the parked middle leaves
+        the page list, sinks and the recent window remain).  The
+        remaining pages keep their relative order, so page-table rows
+        rebuilt from :meth:`owned_pages` stay position-consistent."""
+        owned = self._owned[slot]
+        for p in pages:
+            owned.remove(p)
+            self.decref(p)
+
     def free(self, slot: int) -> None:
         for p in self._owned.pop(slot, ()):
             self.decref(p)
@@ -231,14 +246,63 @@ class PageAllocator:
 # XLA-compilable reference attention (CPU path / parity oracle)
 # ---------------------------------------------------------------------------
 
+def _masked_stats(att: jax.Array, mask: jax.Array, v_r: jax.Array):
+    """Streaming-softmax statistics of masked logits.
+
+    att: ``[H, T, R]`` scaled logits already filled with ``_MASK_VALUE``
+    where masked; mask: ``[T, R]``; v_r: ``[R, H, D]``.  Returns
+    ``(m [T,H], l [T,H], acc [T,H,D])`` — the flash-attention carry
+    triple; a query row with no valid key keeps the neutral carry
+    ``(m=_MASK_VALUE, l=0, acc=0)``.
+    """
+    m_cur = jnp.max(att, axis=-1).T                        # [T, H]
+    m_safe = jnp.where(m_cur > jnp.float32(_MASK_VALUE * 0.5), m_cur,
+                       jnp.float32(_MASK_VALUE))
+    p = jnp.exp(att - m_safe.T[:, :, None])                # [H, T, R]
+    p = jnp.where(mask[None], p, 0.0)
+    l_cur = jnp.sum(p, axis=-1).T                          # [T, H]
+    acc_cur = jnp.einsum("htr,rhd->thd", p, v_r)           # [T, H, D]
+    return m_safe, l_cur, acc_cur
+
+
+def fold_stats(carry, m_cur, l_cur, acc_cur):
+    """Fold an incoming flash-attention carry ``(m, l, acc)`` with a
+    fresh stat triple — the associative streaming-softmax combine the
+    chunked partial-residency scan threads across dispatches.  The
+    neutral carry ``(m=_MASK_VALUE, l=0, acc=0)`` folds exactly."""
+    m0, l0, acc0 = (x.astype(jnp.float32) for x in carry)
+    m_new = jnp.maximum(m0, m_cur)
+    a0 = jnp.exp(m0 - m_new)
+    a1 = jnp.exp(m_cur - m_new)
+    l_new = a0 * l0 + a1 * l_cur
+    acc_new = a0[..., None] * acc0 + a1[..., None] * acc_cur
+    return m_new, l_new, acc_new
+
+
+def neutral_carry(T: int, H: int, D: int):
+    """The identity element for :func:`fold_stats` (all-masked stats)."""
+    return (jnp.full((T, H), _MASK_VALUE, jnp.float32),
+            jnp.zeros((T, H), jnp.float32),
+            jnp.zeros((T, H, D), jnp.float32))
+
+
 def ref_paged_attention(q: jax.Array, pages: jax.Array, kv_lens: jax.Array,
                         page_indices: jax.Array, cu_q_lens: jax.Array,
                         num_seqs: jax.Array, *, sm_scale: float,
-                        sliding_window=None) -> jax.Array:
+                        sliding_window=None, carry=None) -> jax.Array:
     """Same math as the kernel's ``ref_ragged_paged_attention`` but with
     static control flow (where-masks over the flat page buffer), so it
     jits on any backend.  ``page_indices`` may pad unused entries with -1
-    (never matches a real page).  O(T * P * page_size) — test scale.
+    (never matches a real page — including interior holes, which is how
+    a partially-resident sequence's parked columns drop out while the
+    surviving columns keep their true positions).  O(T * P * page_size)
+    — test scale.
+
+    ``carry``: optional incoming flash-attention stats ``(m [T,H],
+    l [T,H], acc [T,H,D])`` from earlier dispatches of a chunked scan;
+    when given the output folds them in via streaming-softmax math
+    (bit-identical shapes, ulp-level numeric difference vs the plain
+    softmax path, which is preserved untouched when ``carry is None``).
     """
     T, H, D = q.shape
     P, page, combined, _ = pages.shape
@@ -281,10 +345,15 @@ def ref_paged_attention(q: jax.Array, pages: jax.Array, kv_lens: jax.Array,
     v_r = jnp.repeat(v_flat, groups, axis=1)
     att = jnp.einsum("thd,rhd->htr", q.astype(jnp.float32),
                      k_r.astype(jnp.float32)) * sm_scale
-    att = jnp.where(mask[None], att, jnp.float32(-0.7 * np.finfo(
-        np.float32).max))
-    p = jax.nn.softmax(att, axis=-1)
-    y = jnp.einsum("htr,rhd->thd", p, v_r.astype(jnp.float32))
+    att = jnp.where(mask[None], att, jnp.float32(_MASK_VALUE))
+    if carry is None:
+        p = jax.nn.softmax(att, axis=-1)
+        y = jnp.einsum("htr,rhd->thd", p, v_r.astype(jnp.float32))
+        return jnp.where(token_valid[:, None, None], y, 0.0).astype(
+            q.dtype)
+    m_c, l_c, acc_c = _masked_stats(att, mask, v_r.astype(jnp.float32))
+    m_n, l_n, acc_n = fold_stats(carry, m_c, l_c, acc_c)
+    y = acc_n / jnp.maximum(l_n, 1e-30)[..., None]
     return jnp.where(token_valid[:, None, None], y, 0.0).astype(q.dtype)
 
 
@@ -292,7 +361,7 @@ def ref_paged_attention_quant(q: jax.Array, pages: jax.Array,
                               scales: jax.Array, kv_lens: jax.Array,
                               page_indices: jax.Array, cu_q_lens: jax.Array,
                               num_seqs: jax.Array, *, sm_scale: float,
-                              sliding_window=None) -> jax.Array:
+                              sliding_window=None, carry=None) -> jax.Array:
     """Dequant-free XLA read path for a QUANTIZED page pool: gather each
     sequence's attended pages (still 1-byte) through ``page_indices``,
     dequantize ONLY the gathered operand, then masked attention.  The
@@ -300,7 +369,11 @@ def ref_paged_attention_quant(q: jax.Array, pages: jax.Array,
     pages sequences actually attend, never the ``[P, ...]`` pool
     (``test_paged_quant.py`` pins that on the traced jaxpr).  Rows
     gathered in page-table order sit at their kv position directly, so
-    masking is a plain ``row < kv_len`` + causal bound.
+    masking is ``row < kv_len`` + causal bound + per-column validity
+    (a ``-1`` page-table entry — padding or a parked partial-residency
+    hole — gathers the trash page, so its rows must mask out even when
+    they sit below ``kv_len``).  ``carry`` as
+    :func:`ref_paged_attention`.
 
     q: ``[T, H, D]``; pages: ``[P, page, 2*Hkv, D]`` int8/fp8_e4m3;
     scales: ``[P, page, 2*Hkv]`` fp32.  O(T * pp * page_size) — the
@@ -332,8 +405,12 @@ def ref_paged_attention_quant(q: jax.Array, pages: jax.Array,
              (t_idx - jnp.take(cu_q_lens[:-1], seq_of_t)))  # [T]
     r_idx = jnp.arange(R, dtype=jnp.int32)
     kv_len_t = jnp.take(kv_lens, seq_of_t)                # [T]
+    # column validity: -1 entries (padding OR interior residency holes)
+    # gathered the trash page above — their rows never attend
+    col_valid = jnp.repeat(page_indices >= 0, page, axis=1)  # [S, R]
     mask = ((r_idx[None, :] <= q_pos[:, None]) &
             (r_idx[None, :] < kv_len_t[:, None]) &
+            jnp.take(col_valid, seq_of_t, axis=0) &
             token_valid[:, None])                         # [T, R]
     if sliding_window is not None:
         mask = mask & (r_idx[None, :] > q_pos[:, None] - sliding_window)
@@ -343,16 +420,82 @@ def ref_paged_attention_quant(q: jax.Array, pages: jax.Array,
     v_t = jnp.repeat(jnp.take(v_g, seq_of_t, axis=0), groups, axis=2)
     att = jnp.einsum("thd,trhd->htr", q.astype(jnp.float32),
                      k_t) * sm_scale
-    att = jnp.where(mask[None], att, jnp.float32(-0.7 * np.finfo(
-        np.float32).max))
-    p = jax.nn.softmax(att, axis=-1)
-    y = jnp.einsum("htr,trhd->thd", p, v_t)
+    att = jnp.where(mask[None], att, jnp.float32(_MASK_VALUE))
+    if carry is None:
+        p = jax.nn.softmax(att, axis=-1)
+        y = jnp.einsum("htr,trhd->thd", p, v_t)
+        return jnp.where(token_valid[:, None, None], y, 0.0).astype(
+            q.dtype)
+    # carry path reuses the flat-row helper: v as [T, R, H, D] must be
+    # indexed per token, so fold with einsum over the token-gathered v
+    m_cur = jnp.max(att, axis=-1).T                       # [T, H]
+    m_safe = jnp.where(m_cur > jnp.float32(_MASK_VALUE * 0.5), m_cur,
+                       jnp.float32(_MASK_VALUE))
+    p = jnp.exp(att - m_safe.T[:, :, None])
+    p = jnp.where(mask[None], p, 0.0)
+    l_cur = jnp.sum(p, axis=-1).T                         # [T, H]
+    acc_cur = jnp.einsum("htr,trhd->thd", p, v_t)         # [T, H, D]
+    m_n, l_n, acc_n = fold_stats(carry, m_safe, l_cur, acc_cur)
+    y = acc_n / jnp.maximum(l_n, 1e-30)[..., None]
     return jnp.where(token_valid[:, None, None], y, 0.0).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
 # Flax-side: write new KV into pages, attend
 # ---------------------------------------------------------------------------
+
+def _staged_attend_stats(mdl, q: jax.Array, ragged_meta, cfg) -> jax.Array:
+    """Chunk-stats dispatch of the partial-residency scan: attend the
+    query tokens against a STAGED dense KV block (parked pages uploaded
+    through the staging buffer, never entering the pool) and sow the
+    flash-attention stat triple into the ``"carry"`` collection instead
+    of producing attention output.
+
+    ``ragged_meta`` carries ``staged_kv [R, 2*Hkv, D]`` (store dtype —
+    int8/fp8 pages stay 1-byte and dequantize here via
+    ``staged_scales [R, 2*Hkv]``), ``staged_kpos [R]`` absolute key
+    positions, ``staged_qpos [T]`` absolute query positions, and
+    optionally an incoming carry (``carry_m``/``carry_l``/``carry_acc``)
+    folded before sowing.  The pool is untouched: no cache variable is
+    created, so chunk dispatches need no ``cache`` collection at all.
+    Returns zeros shaped like the normal attention output — the driver
+    reads the stats, not the module output.
+    """
+    _, H, T, D = q.shape
+    staged = ragged_meta["staged_kv"]
+    R, combined, _ = staged.shape
+    Hkv = combined // 2
+    sf = staged.astype(jnp.float32)
+    if "staged_scales" in ragged_meta:
+        sf = sf * ragged_meta["staged_scales"][..., None].astype(
+            jnp.float32)
+    k_s = sf[:, 0::2, :]                                   # [R, Hkv, D]
+    v_s = sf[:, 1::2, :]
+    groups = H // Hkv
+    k_r = jnp.repeat(k_s, groups, axis=1)                  # [R, H, D]
+    v_r = jnp.repeat(v_s, groups, axis=1)
+    qt = q[0].transpose(1, 0, 2).astype(jnp.float32)       # [T, H, D]
+    sm_scale = float(1.0 / np.sqrt(D))
+    att = jnp.einsum("thd,rhd->htr", qt, k_r) * sm_scale
+    kpos = ragged_meta["staged_kpos"]
+    qpos = ragged_meta["staged_qpos"]
+    # parked groups are full pages of live tokens strictly below the
+    # query frontier, so the causal bound is usually all-true — kept
+    # anyway (with the window bound) so a partially-covered group near
+    # a sliding window stays exact
+    mask = kpos[None, :] <= qpos[:, None]                  # [T, R]
+    window = getattr(cfg, "sliding_window", None)
+    if window is not None:
+        mask = mask & (kpos[None, :] > qpos[:, None] - window)
+    att = jnp.where(mask[None], att, jnp.float32(_MASK_VALUE))
+    m_c, l_c, acc_c = _masked_stats(att, mask, v_r)
+    if "carry_m" in ragged_meta:
+        m_c, l_c, acc_c = fold_stats(
+            (ragged_meta["carry_m"], ragged_meta["carry_l"],
+             ragged_meta["carry_acc"]), m_c, l_c, acc_c)
+    mdl.sow("carry", "stats", (m_c, l_c, acc_c))
+    return jnp.zeros((1, H, T, D), q.dtype)
+
 
 def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
                             ragged_meta: Dict[str, jax.Array], cfg
@@ -362,8 +505,22 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
 
     q: [1, H, T, D]; k, v: [1, Hkv, T, D] (rotary already applied).
     Returns [1, H, T, D].  Requires ``mutable=["cache"]`` on apply.
+
+    Partial residency hooks (the chunked multi-dispatch scan): a
+    ``staged_kv`` key in ``ragged_meta`` short-circuits to
+    :func:`_staged_attend_stats` BEFORE any cache variable exists —
+    chunk dispatches never touch the pool.  ``carry_m``/``carry_l``/
+    ``carry_acc`` keys make the normal (finish) dispatch fold the
+    accumulated chunk stats into its attention via the explicit-carry
+    paths of the reference functions / quantized kernel.
     """
     _, H, T, D = q.shape
+    if "staged_kv" in ragged_meta:
+        return _staged_attend_stats(mdl, q, ragged_meta, cfg)
+    carry = None
+    if "carry_m" in ragged_meta:
+        carry = (ragged_meta["carry_m"], ragged_meta["carry_l"],
+                 ragged_meta["carry_acc"])
     Hkv = k.shape[1]
     P, page = cfg.kv_num_pages, cfg.kv_page_size
     assert P > 1, "paged_decode requires kv_num_pages (engine sets it)"
@@ -439,8 +596,10 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
         # the vLLM-TPU kernel is built for head_dim 128 (its lane-width
         # row stats assert on smaller D); other dims take the XLA
         # reference — correct but O(T * total_page_rows), serving-shape
-        # models should use 128-dim heads
-        if jax.default_backend() == "tpu" and D == 128:
+        # models should use 128-dim heads.  An incoming chunk-scan
+        # carry always routes to the reference: the upstream kernel has
+        # no carry operand (the quantized pool's own kernel does).
+        if carry is None and jax.default_backend() == "tpu" and D == 128:
             from jax.experimental.pallas.ops.tpu.ragged_paged_attention \
                 import kernel as rpa
 
@@ -448,7 +607,7 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
                 qt, pages, kv_lens, jnp.maximum(page_indices, 0),
                 cu_q_lens, num_seqs, sm_scale=sm_scale,
                 sliding_window=window)
-        if jax.default_backend() == "tpu":
+        if carry is None and jax.default_backend() == "tpu":
             from deepspeed_tpu.utils.logging import logger
 
             logger.warning(
@@ -456,7 +615,7 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
                 "ragged kernel needs 128; using the dense XLA fallback")
         return ref_paged_attention(
             qt, pages, kv_lens, page_indices, cu_q_lens, num_seqs,
-            sm_scale=sm_scale, sliding_window=window)
+            sm_scale=sm_scale, sliding_window=window, carry=carry)
 
     def attend_quant(qt, pages, scales, kv_lens, page_indices, cu_q_lens,
                      num_seqs):
@@ -468,10 +627,11 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
 
             return ragged_paged_attention_quant(
                 qt, pages, scales, kv_lens, page_indices, cu_q_lens,
-                num_seqs, sm_scale=sm_scale, sliding_window=window)
+                num_seqs, sm_scale=sm_scale, sliding_window=window,
+                carry=carry)
         return ref_paged_attention_quant(
             qt, pages, scales, kv_lens, page_indices, cu_q_lens, num_seqs,
-            sm_scale=sm_scale, sliding_window=window)
+            sm_scale=sm_scale, sliding_window=window, carry=carry)
 
     # TP serving (reference v2 sharding/attn.py: heads split over the TP
     # group): attention is embarrassingly parallel over heads, so under a
@@ -485,6 +645,9 @@ def paged_update_and_attend(mdl, q: jax.Array, k: jax.Array, v: jax.Array,
 
         from deepspeed_tpu.sequence.layer import resolve_mesh
 
+        assert carry is None, (
+            "chunked partial-residency scan requires tensor_parallel=1 "
+            "(the long-context driver gates admission on it)")
         assert H % tp == 0 and Hkv % tp == 0, (
             f"TP serving requires heads divisible by tp={tp} "
             f"(H={H}, Hkv={Hkv})")

@@ -191,6 +191,13 @@ class Request:
     pc_parent: int = ROOT_HASH
     pc_pages: int = 0
     pc_cached: int = 0
+    # partial residency (kv_tiering.long_context): the sequence's KV
+    # exceeds the HBM pool, so only sinks + the recent window stay
+    # resident and LongContextDriver ticks it outside the fused batch.
+    # lc_parked counts middle page GROUPS demoted to the tiers under
+    # "mid-<uid>-<g>" keys (always a contiguous prefix of the middle)
+    lc: bool = False
+    lc_parked: int = 0
 
     @property
     def ctx_len(self) -> int:
@@ -607,6 +614,12 @@ class RaggedInferenceEngineV2:
         self.tiering = None
         self._tier_gather = None       # jitted fixed-shape page gather
         self._tier_scatter = None      # jitted fixed-shape page scatter
+        self._lc = None                # lazy LongContextDriver
+        # queued spilled sequences prefetched ahead of a possible reap
+        # (config field, online-tunable via the kv.prefetch_lookahead
+        # knob — was a hardcoded islice(waiting, 8))
+        self.prefetch_lookahead = max(
+            int(getattr(kv_tiering, "prefetch_lookahead", 8)), 1)
         self._sched_seq = 0            # step counter for victim coldness
         self._last_sched = np.zeros((max_seqs,), np.int64)
         self.spills = 0                # sessions spilled to the tiers
@@ -817,18 +830,34 @@ class RaggedInferenceEngineV2:
                     "sequence it could never be scheduled; raise "
                     "num_pages")
         else:
-            # spill tiers hold overflow non-destructively: a request is
-            # schedulable as long as its worst-case footprint fits the
-            # COMBINED capacity (other sessions spill instead of dying;
-            # max_new_tokens is a budget, not a promise).  The rejection
-            # names the tier budget that ran out.
-            cap = self.num_pages - 1 + self.tiering.budget_pages
-            if self.allocator.pages_for(total) > cap:
+            # two separate bounds, named separately in the rejection:
+            # (1) with long_context, the RESIDENT-WINDOW need (sinks +
+            # recent window + staging slack) must fit HBM — without it
+            # the working-set bound stays an admission-time check, so
+            # tiering keeps accepting requests beyond HBM whose others
+            # spill (max_new_tokens is a budget, not a promise); (2)
+            # the COMBINED-TIER total must fit HBM + host + NVMe.
+            total_pages = self.allocator.pages_for(total)
+            usable = self.num_pages - 1
+            lc = bool(self._tier_cfg.long_context)
+            if lc:
+                resident_need = min(total_pages,
+                                    self._lc_resident_pages())
+                if resident_need > usable:
+                    raise ValueError(
+                        f"request needs {resident_need} HBM-resident KV "
+                        "pages (the partial-residency window: "
+                        "sink_pages + window_pages + chunk_pages + 1) "
+                        f"but the HBM tier owns {usable} usable pages — "
+                        "raise num_pages or shrink the kv_tiering "
+                        "sink_pages/window_pages/chunk_pages knobs")
+            cap = usable + self.tiering.budget_pages
+            if total_pages > cap:
                 raise ValueError(
-                    f"request needs {self.allocator.pages_for(total)} KV "
-                    f"pages but HBM ({self.num_pages - 1} usable) + host "
+                    f"request needs {total_pages} KV pages in total but "
+                    f"the combined tiers — HBM ({usable} usable) + host "
                     f"({self.tiering.host_budget}) + NVMe "
-                    f"({self.tiering.nvme_budget}) tiers hold only {cap} "
+                    f"({self.tiering.nvme_budget}) — hold only {cap} "
                     "— it could never be scheduled; raise num_pages or "
                     "the kv_tiering host_pages/nvme_pages budgets")
 
@@ -844,6 +873,12 @@ class RaggedInferenceEngineV2:
         max_new = int(kw.get("max_new_tokens", 64))
         self.validate_request(prompt, max_new)
         req = Request(uid=next(self._uid), prompt=prompt, **kw)
+        if (self.tiering is not None and self._tier_cfg.long_context
+                and self.allocator.pages_for(prompt.size + max_new)
+                > self.num_pages - 1):
+            # the full KV cannot be device-resident: decode under the
+            # windowed partial-residency policy (LongContextDriver)
+            req.lc = True
         self.waiting.append(req)
         self.request_latency.on_submit(req.uid)
         if trace.enabled:
@@ -1076,6 +1111,24 @@ class RaggedInferenceEngineV2:
                 "kv.read_depth", lambda: t._reads.depth, _set_rdepth,
                 lo=1, hi=8, step=1, kind="int",
                 doc="bounded restore read-ahead window depth"))
+
+            def _set_lookahead(v):
+                self.prefetch_lookahead = max(int(v), 1)
+
+            def _set_window(v):
+                self._tier_cfg.window_pages = max(int(v), 1)
+
+            reg.register(Knob(
+                "kv.prefetch_lookahead",
+                lambda: self.prefetch_lookahead, _set_lookahead,
+                lo=1, hi=64, step=1, kind="int",
+                doc="queued spilled sequences prefetched ahead of reap"))
+            reg.register(Knob(
+                "kv.window_pages",
+                lambda: int(self._tier_cfg.window_pages), _set_window,
+                lo=1, hi=64, step=1, kind="int",
+                doc="recent HBM-resident pages per partially-resident "
+                    "sequence (long-context residency window)"))
         return reg
 
     def serving_stages(self) -> Dict[str, Any]:
@@ -1784,12 +1837,25 @@ class RaggedInferenceEngineV2:
                 exclude={e.key for e in entries})
         return fresh <= avail
 
+    def _lc_resident_pages(self) -> int:
+        """HBM pages a partially-resident sequence needs at steady
+        state: sinks + the recent window + one not-yet-parked group in
+        flight + the growth frontier."""
+        t = self._tier_cfg
+        return (int(t.sink_pages) + int(t.window_pages)
+                + int(t.chunk_pages) + 1)
+
     def _admit_need(self, req: Request) -> int:
         """Token coverage ``_admit`` reserves for ``req`` — ONE formula
         shared with ``_admittable`` so the pipelined loop reconciles at
         precisely the steps where ``pipeline=False`` would admit."""
         ctx_len = req.ctx_len
         rem = max(req.max_new_tokens - len(req.generated), 1)
+        if req.lc:
+            # partial residency: reserve the resident WINDOW, not the
+            # context — the parked middle lives in the spill tiers
+            return min(ctx_len + rem,
+                       self._lc_resident_pages() * self.page_size)
         if self.kv_reserve == "worst_case":
             # worst case INCLUDING re-prefilled output for evicted
             # continuations (their ctx carries earlier tokens)
@@ -1811,6 +1877,10 @@ class RaggedInferenceEngineV2:
         ``touch=False`` for probes — LRU order must not move until the
         admission actually happens."""
         total = self.allocator.pages_for(need)
+        if req.lc:
+            # long-context admissions skip the prefix cache (parked
+            # columns would punch holes in a shared prefix run)
+            return total, []
         if req.spilled is not None:
             return total - len(req.spilled.get("shared_pages", ())), []
         if self._pfx is None:
@@ -2001,7 +2071,9 @@ class RaggedInferenceEngineV2:
                 # sequences the FIFO queue would re-admit first, under
                 # the decode block the device is still running
                 self.tiering.prefetch(
-                    [q.uid for q in itertools.islice(self.waiting, 8)
+                    [q.uid for q in
+                     itertools.islice(self.waiting,
+                                      self.prefetch_lookahead)
                      if q.spilled is not None])
         pending = dv["window"].in_flight + len(dv["ready"])
         if finish_possible or pending >= self.harvest_interval:
@@ -2082,9 +2154,12 @@ class RaggedInferenceEngineV2:
         st = self.host_stats
         with st.stage("plan"):
             self._admit()
-            live = [r for r in self.slots if r is not None and not r.done]
-            decoding_ready = bool(live) and all(
-                r.prefill_done >= r.ctx_len for r in live)
+            lc_live = [r for r in self.slots
+                       if r is not None and not r.done and r.lc]
+            live = [r for r in self.slots
+                    if r is not None and not r.done and not r.lc]
+            decoding_ready = (not lc_live and bool(live) and all(
+                r.prefill_done >= r.ctx_len for r in live))
             # speculation first: its block writes a k+1-wide span per
             # tick, so it needs more page coverage than a plain block —
             # when the pool can't back it, degrade to the plain decode
@@ -2116,6 +2191,18 @@ class RaggedInferenceEngineV2:
                 self._pipeline_start(live)
                 return self._pipeline_step()
             return self._step_decode_block(live)
+        # partially-resident sequences tick through the chunked-scan
+        # driver — never the fused batch, the decode block, or the
+        # pipeline (decoding_ready is gated off while any is live, so
+        # the pipeline cannot start and orphan them)
+        lc_produced = 0
+        if lc_live:
+            if self._lc is None:
+                from deepspeed_tpu.inference.v2.long_context import \
+                    LongContextDriver
+                self._lc = LongContextDriver(self)
+            for r in lc_live:
+                lc_produced += self._lc.tick(r)
         with st.stage("plan"):
             plan = self._plan_tick()
         if plan is None:
@@ -2146,7 +2233,7 @@ class RaggedInferenceEngineV2:
                         self._evict(victim)
                 else:
                     self._evict(max(stalled, key=lambda r: r.uid))
-            return 0
+            return lc_produced
         (token_ids, positions, kv_lens, page_indices, cu_q_lens, num_seqs,
          new_kv_dest, sample_rows, samplers) = plan
         args = [self._upload(a) for a in
@@ -2159,7 +2246,7 @@ class RaggedInferenceEngineV2:
         st.ticks += 1
         produced = self._sample(sel_logits, samplers)
         self._reap()
-        return produced
+        return produced + lc_produced
 
     def _admit(self) -> None:
         for i in range(self.max_seqs):
@@ -2250,7 +2337,7 @@ class RaggedInferenceEngineV2:
                 self.allocator.attach(i, shared)
                 self.page_table[i, :len(shared)] = shared
                 attached = len(shared)
-        elif self._pfx is not None:
+        elif self._pfx is not None and not req.lc:
             with st.stage("prefix"):
                 entries = self._pfx.match(req.ctx, touch=True)
                 pages_att: List[int] = []
@@ -2653,7 +2740,7 @@ class RaggedInferenceEngineV2:
         self._stalled = []
         decode_rs = []
         for r in self.slots:
-            if r is None or r.done or r.prefill_done < r.ctx_len:
+            if r is None or r.done or r.lc or r.prefill_done < r.ctx_len:
                 continue
             # the tick writes the last generated token at position
             # length-1, so pages must cover `length` tokens
@@ -2663,7 +2750,8 @@ class RaggedInferenceEngineV2:
                 self._stalled.append(r)    # out of pages: sit this tick out
         prefill_rs = sorted(
             (r for r in self.slots
-             if r is not None and r.prefill_done < r.ctx_len),
+             if r is not None and not r.lc
+             and r.prefill_done < r.ctx_len),
             key=lambda r: r.uid)
         if not decode_rs and not prefill_rs:
             return None
@@ -2709,7 +2797,7 @@ class RaggedInferenceEngineV2:
         t = 0
         j = 0
         for r in [s for s in self.slots if s is not None]:
-            if r.done or r.uid in stalled_uids:
+            if r.done or r.lc or r.uid in stalled_uids:
                 continue
             self._last_sched[r.slot] = self._sched_seq
             if r.prefill_done >= r.ctx_len:                 # decode: 1 tok
@@ -2820,7 +2908,11 @@ class RaggedInferenceEngineV2:
     def _reap(self) -> None:
         for i, r in enumerate(self.slots):
             if r is not None and r.done:
-                if (self._pfx is not None
+                if r.lc and self.tiering is not None:
+                    # drop the parked middle groups with the session
+                    for g in range(r.lc_parked):
+                        self.tiering.drop(f"mid-{r.uid}-{g}")
+                if (self._pfx is not None and not r.lc
                         and self._pfx_cfg.include_generated):
                     # opt-in: publish full pages of generated tokens
                     # before the refs drop.  Decode pages come from a

@@ -49,9 +49,24 @@ _MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 
 
 def _quant_kernel(kv_lens_ref, pi_ref, cu_ref, ns_ref,   # scalar prefetch
-                  q_ref, pages_ref, scales_ref, o_ref,
-                  acc_sc, m_sc, l_sc, *, page: int, groups: int,
-                  sliding_window: Optional[int]):
+                  *refs, page: int, groups: int,
+                  sliding_window: Optional[int], has_carry: bool,
+                  out_stats: bool):
+    # positional refs vary with the carry/stats variants: inputs are
+    # (q, pages, scales[, m_in, l_in, acc_in]), outputs are
+    # (o[, m_out, l_out, acc_out]), then the three VMEM scratch buffers
+    q_ref, pages_ref, scales_ref = refs[0], refs[1], refs[2]
+    n = 3
+    if has_carry:
+        mi_ref, li_ref, acci_ref = refs[n], refs[n + 1], refs[n + 2]
+        n += 3
+    o_ref = refs[n]
+    n += 1
+    if out_stats:
+        mo_ref, lo_ref, acco_ref = refs[n], refs[n + 1], refs[n + 2]
+        n += 3
+    acc_sc, m_sc, l_sc = refs[n], refs[n + 1], refs[n + 2]
+
     i = pl.program_id(0)                   # sequence slot
     j = pl.program_id(1)                   # page ordinal within the slot
     pp = pl.num_programs(1)
@@ -59,18 +74,34 @@ def _quant_kernel(kv_lens_ref, pi_ref, cu_ref, ns_ref,   # scalar prefetch
     @pl.when(jnp.logical_and(i == 0, j == 0))
     def _zero_out():
         o_ref[...] = jnp.zeros_like(o_ref)
+        if out_stats:
+            mo_ref[...] = jnp.full_like(mo_ref, _MASK_VALUE)
+            lo_ref[...] = jnp.zeros_like(lo_ref)
+            acco_ref[...] = jnp.zeros_like(acco_ref)
 
     @pl.when(j == 0)
     def _reset_seq():
-        acc_sc[...] = jnp.zeros_like(acc_sc)
-        m_sc[...] = jnp.full_like(m_sc, _MASK_VALUE)
-        l_sc[...] = jnp.zeros_like(l_sc)
+        # an incoming chunk-scan carry seeds the accumulators instead of
+        # the neutral element — the explicit carry INPUT
+        if has_carry:
+            acc_sc[...] = acci_ref[...]
+            m_sc[...] = mi_ref[...]
+            l_sc[...] = li_ref[...]
+        else:
+            acc_sc[...] = jnp.zeros_like(acc_sc)
+            m_sc[...] = jnp.full_like(m_sc, _MASK_VALUE)
+            l_sc[...] = jnp.zeros_like(l_sc)
 
     q0 = cu_ref[i]
     q1 = cu_ref[i + 1]
     kvl = kv_lens_ref[i]
     live = jnp.logical_and(i < ns_ref[0], q1 > q0)
-    in_range = j * page < kvl              # page j holds attended rows
+    # page j holds attended rows AND is resident: a -1 entry is padding
+    # or a parked partial-residency hole — its tile (the index map
+    # clamped it to the trash page) is skipped, and because kv
+    # positions derive from the column ordinal j the surviving columns
+    # keep their true absolute positions
+    in_range = jnp.logical_and(j * page < kvl, pi_ref[i, j] >= 0)
 
     @pl.when(jnp.logical_and(live, in_range))
     def _tile():
@@ -125,6 +156,13 @@ def _quant_kernel(kv_lens_ref, pi_ref, cu_ref, ns_ref,   # scalar prefetch
         mine = jnp.logical_and(rows >= q0, rows < q1)    # [T]
         o_ref[...] = jnp.where(mine[:, None, None],
                                val.astype(o_ref.dtype), o_ref[...])
+        if out_stats:
+            # the explicit carry OUTPUT: raw (un-normalized) stats, so a
+            # later dispatch can keep folding
+            mo_ref[...] = jnp.where(mine[:, None], m_sc[...], mo_ref[...])
+            lo_ref[...] = jnp.where(mine[:, None], l_sc[...], lo_ref[...])
+            acco_ref[...] = jnp.where(mine[:, None, None], acc_sc[...],
+                                      acco_ref[...])
 
 
 def ragged_paged_attention_quant(
@@ -132,15 +170,24 @@ def ragged_paged_attention_quant(
         kv_lens: jax.Array, page_indices: jax.Array,
         cu_q_lens: jax.Array, num_seqs: jax.Array, *, sm_scale: float,
         sliding_window: Optional[int] = None,
-        interpret: bool = False) -> jax.Array:
+        carry=None, return_stats: bool = False,
+        interpret: bool = False):
     """Ragged paged attention over a QUANTIZED page pool.
 
     q: ``[T, H, D]`` float; pages: ``[P, page, 2*Hkv, D]`` int8 or
     fp8_e4m3; scales: ``[P, page, 2*Hkv]`` fp32; metadata as the
-    full-width kernel (``page_indices`` may pad with -1).  Returns
+    full-width kernel (``page_indices`` may pad with -1 — trailing
+    padding OR interior partial-residency holes; hole tiles are skipped
+    and the surviving columns keep their true positions).  Returns
     ``[T, H, D]`` in ``q.dtype``.  D must be 128 — the kernel contract
     it shares with the full-width vLLM-TPU kernel; other head dims use
     :func:`~deepspeed_tpu.inference.paged.ref_paged_attention_quant`.
+
+    The flash carry is an explicit input/output for the chunked
+    partial-residency scan: ``carry=(m [T,H], l [T,H], acc [T,H,D])``
+    (fp32) seeds the streaming accumulators instead of the neutral
+    element, and ``return_stats=True`` returns
+    ``(out, (m, l, acc))`` so a later dispatch can keep folding.
     """
     T, H, D = q.shape
     P, page, combined, _ = pages.shape
@@ -160,33 +207,74 @@ def ragged_paged_attention_quant(
     if Tp != T:
         qf = jnp.pad(qf, ((0, Tp - T), (0, 0), (0, 0)))
 
-    # -1 page pads clamp to the trash page; their rows sit past kv_len
-    # and mask out in-kernel
-    safe_pi = jnp.maximum(page_indices, 0).astype(jnp.int32)
+    # raw page ids ride the scalar prefetch so the kernel can SKIP -1
+    # tiles; the BlockSpec index maps clamp to the trash page only to
+    # keep the DMA address legal for skipped tiles
+    pi = page_indices.astype(jnp.int32)
+
+    in_specs = [
+        pl.BlockSpec((Tp, H, D), lambda i, j, *refs: (0, 0, 0)),
+        pl.BlockSpec((1, page, combined, D),
+                     lambda i, j, kvl, pi, cu, ns: (
+                         jnp.maximum(pi[i, j], 0), 0, 0, 0)),
+        pl.BlockSpec((1, page, combined),
+                     lambda i, j, kvl, pi, cu, ns: (
+                         jnp.maximum(pi[i, j], 0), 0, 0)),
+    ]
+    operands = [qf, pages, scales]
+    if carry is not None:
+        m0, l0, acc0 = carry
+        if Tp != T:
+            # padded rows belong to no sequence; neutral-pad them so the
+            # seeded accumulators stay finite
+            m0 = jnp.pad(m0.astype(jnp.float32), ((0, Tp - T), (0, 0)),
+                         constant_values=_MASK_VALUE)
+            l0 = jnp.pad(l0.astype(jnp.float32), ((0, Tp - T), (0, 0)))
+            acc0 = jnp.pad(acc0.astype(jnp.float32),
+                           ((0, Tp - T), (0, 0), (0, 0)))
+        in_specs += [
+            pl.BlockSpec((Tp, H), lambda i, j, *refs: (0, 0)),
+            pl.BlockSpec((Tp, H), lambda i, j, *refs: (0, 0)),
+            pl.BlockSpec((Tp, H, D), lambda i, j, *refs: (0, 0, 0)),
+        ]
+        operands += [m0.astype(jnp.float32), l0.astype(jnp.float32),
+                     acc0.astype(jnp.float32)]
+
+    out_shape = jax.ShapeDtypeStruct((Tp, H, D), q.dtype)
+    out_spec = pl.BlockSpec((Tp, H, D), lambda i, j, *refs: (0, 0, 0))
+    if return_stats:
+        out_shape = (out_shape,
+                     jax.ShapeDtypeStruct((Tp, H), jnp.float32),
+                     jax.ShapeDtypeStruct((Tp, H), jnp.float32),
+                     jax.ShapeDtypeStruct((Tp, H, D), jnp.float32))
+        out_spec = (out_spec,
+                    pl.BlockSpec((Tp, H), lambda i, j, *refs: (0, 0)),
+                    pl.BlockSpec((Tp, H), lambda i, j, *refs: (0, 0)),
+                    pl.BlockSpec((Tp, H, D),
+                                 lambda i, j, *refs: (0, 0, 0)))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(S, pp),
-        in_specs=[
-            pl.BlockSpec((Tp, H, D), lambda i, j, *refs: (0, 0, 0)),
-            pl.BlockSpec((1, page, combined, D),
-                         lambda i, j, kvl, pi, cu, ns: (pi[i, j], 0, 0, 0)),
-            pl.BlockSpec((1, page, combined),
-                         lambda i, j, kvl, pi, cu, ns: (pi[i, j], 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((Tp, H, D), lambda i, j, *refs: (0, 0, 0)),
+        in_specs=in_specs,
+        out_specs=out_spec,
         scratch_shapes=[
             pltpu.VMEM((Tp, H, D), jnp.float32),
             pltpu.VMEM((Tp, H), jnp.float32),
             pltpu.VMEM((Tp, H), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
+    res = pl.pallas_call(
         functools.partial(_quant_kernel, page=page, groups=groups,
-                          sliding_window=sliding_window),
+                          sliding_window=sliding_window,
+                          has_carry=carry is not None,
+                          out_stats=return_stats),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((Tp, H, D), q.dtype),
+        out_shape=out_shape,
         interpret=interpret,
-    )(kv_lens.astype(jnp.int32), safe_pi, cu_q_lens.astype(jnp.int32),
-      num_seqs.astype(jnp.int32), qf, pages, scales)
-    return out[:T]
+    )(kv_lens.astype(jnp.int32), pi, cu_q_lens.astype(jnp.int32),
+      num_seqs.astype(jnp.int32), *operands)
+    if return_stats:
+        out, m, l, acc = res
+        return out[:T], (m[:T], l[:T], acc[:T])
+    return res[:T]
