@@ -1431,6 +1431,97 @@ def bench_ragged(args) -> None:
             "nothing overlaps; speedup is not meaningful here "
             "(conservation + greedy bit-parity asserted instead)")
 
+    # -- network front door: HTTP/SSE serving at the socket -------------
+    # The same 2-replica router behind the asyncio front door, measured
+    # where the client sits: socket-level TTFT/TPOT from the load
+    # generator at 8/64/200 simultaneous streams, against an in-process
+    # control (submit straight into the Router, first-token time from
+    # the event stream).  The delta IS the front door's overhead: HTTP
+    # parse, SSE framing, the asyncio<->pump-thread hop, and kernel
+    # socket buffers.
+    from deepspeed_tpu.serving import FrontDoorServer
+    from deepspeed_tpu.serving.client import LoadGenerator
+
+    fd_new = 8
+    fd_rng = np.random.default_rng(13)
+
+    def fd_prompt_set(n):
+        return [fd_rng.integers(0, cfg.vocab_size, int(l),
+                                dtype=np.int32)
+                for l in fd_rng.integers(4, max(chunk - 1, 5), size=n)]
+
+    def fd_inproc(prompt_list):
+        """In-process control: same workload straight into the Router,
+        TTFT from the router's harvest-granularity event stream."""
+        rs = ReplicaSet(so_engine, 2)
+        router = Router(rs, policy="least_tokens",
+                        queue_cap=len(prompt_list))
+        router.collect_events = True
+        sub, first = {}, {}
+        t0 = time.perf_counter()
+        for q in prompt_list:
+            rid = router.submit(q, max_new_tokens=fd_new)
+            sub[rid] = time.perf_counter()
+        outs = {}
+        while router.outstanding:
+            router.pump()
+            router.join()
+            for name, rid, payload in router.poll_events():
+                if name == "tokens" and rid not in first:
+                    first[rid] = time.perf_counter()
+            outs.update(router.get_outputs())
+        wall = time.perf_counter() - t0
+        rs.close()
+        assert len(outs) == len(prompt_list), (
+            f"in-process control lost requests: {len(outs)}/"
+            f"{len(prompt_list)}")
+        ttfts = sorted((first[r] - sub[r]) * 1e3 for r in first)
+        return {"requests_per_s": round(len(outs) / wall, 3),
+                "ttft_ms_p50": round(_pctl(ttfts, 50) or 0.0, 1),
+                "ttft_ms_p99": round(_pctl(ttfts, 99) or 0.0, 1)}
+
+    detail["frontdoor"] = {"replicas": 2, "max_new_tokens": fd_new,
+                           "streams": {}}
+    for fd_streams in (8, 64, 200):
+        fd_prompts = fd_prompt_set(fd_streams)
+        rs = ReplicaSet(so_engine, 2)
+        router = Router(rs, policy="least_tokens",
+                        queue_cap=fd_streams)
+        srv = FrontDoorServer(router, port=0).start()
+        gen = LoadGenerator(
+            srv.host, srv.port,
+            lambda i, P=fd_prompts: {"prompt": P[i].tolist(),
+                                     "max_new_tokens": fd_new},
+            requests=fd_streams, concurrency=fd_streams)
+        fd_sum = gen.run()
+        srv.close()
+        rs.close()
+        # conservation at the socket: every stream completes and every
+        # generated token arrives exactly once over SSE
+        assert fd_sum["completed"] == fd_streams, (
+            f"front door lost streams at {fd_streams}-way: "
+            f"{fd_sum['completed']}/{fd_streams} ({fd_sum['errors']})")
+        assert fd_sum["tokens_streamed"] == fd_streams * fd_new, (
+            f"front door dropped tokens at {fd_streams}-way: "
+            f"{fd_sum['tokens_streamed']}/{fd_streams * fd_new}")
+        detail["frontdoor"]["streams"][str(fd_streams)] = {
+            "requests_per_s": fd_sum["requests_per_s"],
+            "ttft_ms_p50": fd_sum["ttft_ms_p50"],
+            "ttft_ms_p99": fd_sum["ttft_ms_p99"],
+            "tpot_ms_p50": fd_sum["tpot_ms_p50"],
+            "tpot_ms_p99": fd_sum["tpot_ms_p99"],
+            "tokens_streamed": fd_sum["tokens_streamed"],
+        }
+    detail["frontdoor"]["inprocess_control_8"] = fd_inproc(
+        fd_prompt_set(8))
+    if not multi_device:
+        detail["frontdoor"]["caveat"] = (
+            "single-device host: replica threads and the asyncio loop "
+            "share one core, so requests/s does not scale with "
+            "streams; the row records socket-level latency overhead "
+            "vs the in-process control (conservation asserted at "
+            "every stream count)")
+
     # closed-loop autotune: the online controller walks a deliberately
     # mis-tuned engine (harvest=1, depth=1) back toward the hand-tuned
     # base config above; the row records all three throughputs plus the

@@ -602,6 +602,10 @@ class RaggedInferenceEngineV2:
         self._step_fn = None
         self._decode_block_cache: Dict[bool, Any] = {}
         self._last_tokens = np.zeros((max_seqs,), np.int32)
+        # streaming cursor: generated-token count already reported per
+        # uid (stream_deltas); cancels counts cancellations at any stage
+        self._stream_cursor: Dict[int, int] = {}
+        self.cancels = 0
 
         # -- tiered KV spill store (HBM -> host RAM -> NVMe) --
         from deepspeed_tpu.inference.config import KVTieringConfig
@@ -893,7 +897,123 @@ class RaggedInferenceEngineV2:
                                         np.asarray(r.generated, np.int32)]))
                 for r in self.finished]
         self.finished = []
+        for uid, _ in out:
+            self._stream_cursor.pop(uid, None)
         return out
+
+    def stream_deltas(self) -> List[Tuple[int, List[int], int, bool]]:
+        """Incremental token harvest for streaming front ends: one
+        ``(uid, new_tokens, total_generated, done)`` tuple per request
+        whose generated-token count grew since the last call, plus
+        every newly finished request (even with no fresh tokens).
+        Tokens appear here exactly when they fold into host request
+        state — HARVEST granularity, the honest streaming grain under
+        the deferred-harvest pipeline.  Cursors are engine-side;
+        callers that re-route across replicas de-duplicate with the
+        cumulative ``total_generated`` (a re-routed request replays
+        its tokens from zero on the new engine).
+
+        Call BEFORE :meth:`get_outputs` in the same tick — collecting
+        an output clears its cursor."""
+        out: List[Tuple[int, List[int], int, bool]] = []
+        cur = self._stream_cursor
+        live = [r for r in self.slots if r is not None]
+        for r in itertools.chain(live, self.waiting):
+            n = len(r.generated)
+            seen = cur.get(r.uid, 0)
+            if n > seen:
+                out.append((r.uid, [int(t) for t in r.generated[seen:]],
+                            n, False))
+                cur[r.uid] = n
+        for r in self.finished:
+            n = len(r.generated)
+            seen = cur.pop(r.uid, 0)
+            out.append((r.uid, [int(t) for t in r.generated[seen:]],
+                        n, True))
+        return out
+
+    def cancel(self, uid: int) -> Optional[str]:
+        """Cancel one request at ANY lifecycle stage, releasing every
+        resource it holds: slot + pool pages (mid-prefill or
+        mid-decode, including inside a pipelined decode carry), tiered
+        spill payloads and their shared-prefix spill-holds (parked
+        requests), LC middle-group parkings, and per-slot draft state.
+        ``audit_kv_sharing()`` stays clean across any interleaving —
+        the front door's client-disconnect path depends on it.
+
+        Returns the stage the request was cancelled at (``"queued"`` /
+        ``"spilled"`` / ``"prefill"`` / ``"decode"`` / ``"lc"`` /
+        ``"finished"``) or ``None`` for an unknown uid (never
+        submitted, or already collected)."""
+        stage: Optional[str] = None
+        # fold an active pipelined carry first: the target may be
+        # mid-decode inside it, and teardown re-anchors host state so
+        # the slot release below is authoritative (the target may
+        # FINISH during this harvest — then it lands in ``finished``)
+        dv = self._dev
+        if dv is not None and any(r.uid == uid for r in dv["reqs"]):
+            self._pipeline_harvest(teardown=True)
+        # parked in the waiting queue: never admitted, an evicted
+        # continuation, or spilled out to the tiers
+        for r in list(self.waiting):
+            if r.uid != uid:
+                continue
+            self.waiting.remove(r)
+            if r.spilled is not None:
+                # release the spill-holds pinning shared prefix pages
+                # resident, then the tiered payload itself (the same
+                # cleanup export_parked runs when folding a session)
+                for p in r.spilled.get("shared_pages", ()):
+                    self.allocator.decref(p)
+                if self.tiering is not None:
+                    self.tiering.drop(r.uid)
+                stage = "spilled"
+            else:
+                stage = "queued"
+            self._drop_lc_parked(r)
+            break
+        if stage is None:
+            # resident in a slot (prefill or decode phase; LC sequences
+            # tick outside the fused batch but park in slots the same)
+            for i, r in enumerate(self.slots):
+                if r is None or r.uid != uid:
+                    continue
+                stage = ("lc" if r.lc
+                         else "prefill" if r.prefill_done < r.ctx_len
+                         else "decode")
+                self._drop_lc_parked(r)
+                self.allocator.free(i)
+                self.page_table[i, :] = -1
+                self.slots[i] = None
+                self._draft_len[i] = 0
+                break
+        if stage is None:
+            # reaped but not yet collected: drop the pending output
+            for r in list(self.finished):
+                if r.uid == uid:
+                    self.finished.remove(r)
+                    stage = "finished"
+                    break
+        if stage is None and uid in self._unclaimed:
+            del self._unclaimed[uid]
+            stage = "finished"
+        if stage is None:
+            return None
+        self.cancels += 1
+        self._stream_cursor.pop(uid, None)
+        self.request_latency.on_cancel(uid)
+        if trace.enabled:
+            trace.event("request_cancel", cat="request", uid=int(uid),
+                        stage=stage)
+        return stage
+
+    def _drop_lc_parked(self, r: Request) -> None:
+        """Release a long-context request's parked middle page groups
+        (the tier keys ``_reap`` would drop at finish)."""
+        if self.tiering is not None:
+            for g in range(r.lc_parked):
+                self.tiering.drop(f"mid-{r.uid}-{g}")
+        r.lc_parked = 0
 
     def has_work(self) -> bool:
         return bool(self.waiting) or any(s is not None for s in self.slots)

@@ -19,15 +19,34 @@ threads interleave but results stay bit-identical).
   policies (``rr`` / ``least_tokens`` / ``pressure``), sticky routing
   for prefix-cache affinity (prompt-prefix chain hash), and an
   admission controller (priorities, per-replica queue caps, SLO
-  burn-rate shed/defer) with loud typed rejections.
+  burn-rate shed/defer, request deadlines) with loud typed rejections.
+- :class:`~.server.FrontDoorServer` — the network front door: a
+  stdlib-asyncio HTTP/1.1 + SSE endpoint over the router with token
+  streaming at harvest granularity, client-disconnect cancellation
+  that reclaims pool pages mid-decode, deadline admission, and
+  SIGTERM graceful drain with warm-state handoff.
+- :mod:`~.client` — asyncio SSE client + open-loop Poisson /
+  closed-loop load generator measuring TTFT/TPOT at the socket.
 """
 from deepspeed_tpu.serving.replica_set import (EngineReplicaHandle,
                                                ReplicaSet)
-from deepspeed_tpu.serving.router import (NeverSchedulableRejection,
+from deepspeed_tpu.serving.router import (DeadlineRejection,
+                                          DrainingRejection,
+                                          NeverSchedulableRejection,
                                           POLICIES, QueueFullRejection,
                                           Router, RouterRejection,
                                           ShedRejection)
 
 __all__ = ["ReplicaSet", "EngineReplicaHandle", "Router", "POLICIES",
            "RouterRejection", "QueueFullRejection", "ShedRejection",
-           "NeverSchedulableRejection"]
+           "NeverSchedulableRejection", "DeadlineRejection",
+           "DrainingRejection", "FrontDoorServer"]
+
+
+def __getattr__(name):
+    # server/client import asyncio machinery; keep the base package
+    # import light by resolving them lazily
+    if name == "FrontDoorServer":
+        from deepspeed_tpu.serving.server import FrontDoorServer
+        return FrontDoorServer
+    raise AttributeError(name)

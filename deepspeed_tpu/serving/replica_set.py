@@ -14,7 +14,11 @@ threads:
   the replica thread; ``on_done(uid)`` runs at join time on the
   ROUTER thread
 - ``step_async(on_done)`` — one engine iteration + output collection;
-  ``on_done((outputs, pool))`` at join time
+  ``on_done((outputs, pool, deltas))`` at join time (the router also
+  accepts the legacy ``(outputs, pool)`` shape from older fakes)
+- ``cancel_async(uid, on_done)`` — OPTIONAL: cancel a request at any
+  lifecycle stage on the replica thread (the front door's
+  client-disconnect path); routers probe with ``getattr``
 - ``join_all()`` — drain the feed window (folds every pending
   ``on_done``; re-raises the first replica fault after the sweep)
 - ``drain_async(on_done)`` / ``close()`` — shutdown halves
@@ -102,19 +106,33 @@ class EngineReplicaHandle:
 
     def step_async(self, on_done: Callable[[Any], Any]) -> None:
         """One engine iteration; the payload handed to ``on_done`` is
-        ``(outputs, pool)`` where ``outputs`` is the engine's
-        ``get_outputs()`` list and ``pool`` a lightweight pressure
+        ``(outputs, pool, deltas)`` where ``outputs`` is the engine's
+        ``get_outputs()`` list, ``pool`` a lightweight pressure
         snapshot taken ON the replica thread (the router never reads
-        engine state across threads)."""
+        engine state across threads), and ``deltas`` the engine's
+        ``stream_deltas()`` — fresh tokens at harvest granularity for
+        streaming front ends.  The router also accepts the legacy
+        2-tuple payload (test fakes)."""
         eng = self.engine
 
-        def op() -> Tuple[List[Tuple[int, Any]], Dict[str, Any]]:
+        def op() -> Tuple[List[Tuple[int, Any]], Dict[str, Any],
+                          List[Tuple[int, List[int], int, bool]]]:
             if eng.has_work():
                 eng.step()
-            outs = eng.get_outputs()
-            return outs, self._pool_snapshot(eng)
+            deltas = eng.stream_deltas()   # before get_outputs: a
+            outs = eng.get_outputs()       # collected uid drops its cursor
+            return outs, self._pool_snapshot(eng), deltas
 
         self._submit(op, on_done)
+
+    def cancel_async(self, uid: int,
+                     on_done: Optional[Callable[[Any], Any]] = None
+                     ) -> None:
+        """Cancel ``uid`` on the replica thread at whatever lifecycle
+        stage it is in (queued / spilled / mid-decode / LC-parked);
+        ``on_done(stage_or_None)`` at join time."""
+        eng = self.engine
+        self._submit(lambda: eng.cancel(uid), on_done)
 
     def drain_async(self, on_done: Callable[[Any], Any]) -> None:
         """Run the replica to completion (shutdown half)."""

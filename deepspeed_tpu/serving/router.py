@@ -26,6 +26,21 @@ Rejections are loud and typed (the ISSUE's "loud typed rejections"):
   tier.  Between ``burn_defer`` and ``burn_shed`` low-priority
   requests are accepted but HELD in the router queue (deferred) while
   high-priority traffic keeps dispatching.
+- :class:`DeadlineRejection` — the request carried ``deadline_ms`` and
+  the deadline had already burned at submit.  An ACCEPTED request
+  whose deadline burns while still queued expires lazily in the
+  priority heap (never occupies a slot) and surfaces as a
+  ``deadline_expired`` event.
+- :class:`DrainingRejection` — the router is in graceful drain
+  (``begin_drain``): in-flight work finishes, new work is refused
+  (the front door maps this to 503 + Retry-After).
+
+Streaming front ends set ``collect_events = True`` and drain
+``poll_events()`` after each ``pump``/``join`` round: ``("tokens",
+rid, fresh)`` at harvest granularity (de-duplicated across
+replica-death re-routes via cumulative totals), ``("finish", rid,
+tokens)``, ``("deadline_expired", rid, None)`` and ``("cancelled",
+rid, None)``.
 """
 from __future__ import annotations
 
@@ -39,7 +54,8 @@ from deepspeed_tpu.inference.prefix_cache import ROOT_HASH, _chunk_hash
 from deepspeed_tpu.telemetry import flight, trace
 
 __all__ = ["Router", "POLICIES", "RouterRejection", "QueueFullRejection",
-           "ShedRejection", "NeverSchedulableRejection"]
+           "ShedRejection", "NeverSchedulableRejection",
+           "DeadlineRejection", "DrainingRejection"]
 
 
 class RouterRejection(RuntimeError):
@@ -60,9 +76,21 @@ class NeverSchedulableRejection(RouterRejection):
     tier capacity) — the engine's ``ValueError`` with a router type."""
 
 
+class DeadlineRejection(RouterRejection):
+    """The request's ``deadline_ms`` had already burned at submit —
+    admitting it could only waste a slot on an answer nobody waits
+    for."""
+
+
+class DrainingRejection(RouterRejection):
+    """The router is in graceful drain (``begin_drain``): in-flight
+    requests finish, new ones are refused."""
+
+
 class _RouterReq:
     __slots__ = ("rid", "prompt", "kw", "priority", "accept_t",
-                 "affinity", "cost", "replica", "uid", "attempts")
+                 "affinity", "cost", "replica", "uid", "attempts",
+                 "deadline_t", "cancelled", "streamed")
 
     def __init__(self, rid: int, prompt: np.ndarray, kw: Dict[str, Any],
                  priority: int, accept_t: float, affinity: int,
@@ -77,6 +105,9 @@ class _RouterReq:
         self.replica: Optional[str] = None
         self.uid: Optional[int] = None
         self.attempts = 0
+        self.deadline_t: Optional[float] = None   # clock() expiry
+        self.cancelled = False    # lazy heap removal marker
+        self.streamed = 0         # generated tokens already emitted
 
 
 # -- load-balancing policies ---------------------------------------------
@@ -186,9 +217,16 @@ class Router:
         self._outputs: Dict[int, np.ndarray] = {}
         self._draining = False
         self._retiring: set = set()
+        self.accepting = True     # begin_drain() flips; submit refuses
+        # event stream for streaming front ends: opt-in (a pump-only
+        # caller would otherwise grow the list unboundedly)
+        self.collect_events = False
+        self._events: List[Tuple[str, int, Any]] = []
         self.stats_counters: Dict[str, int] = {
             "accepted": 0, "rejected_queue_full": 0, "rejected_shed": 0,
-            "rejected_never_schedulable": 0, "affinity_hits": 0,
+            "rejected_never_schedulable": 0, "rejected_deadline": 0,
+            "rejected_draining": 0, "expired_deadline": 0,
+            "cancelled": 0, "affinity_hits": 0,
             "rerouted": 0, "finished": 0, "replica_deaths": 0,
             "replicas_added": 0, "replicas_retired": 0,
             "sessions_handed_off": 0}
@@ -222,13 +260,27 @@ class Router:
                                      prompt[i:i + self._chunk]))
         return h
 
-    def submit(self, prompt: Any, priority: int = 0, **kw) -> int:
+    def submit(self, prompt: Any, priority: int = 0,
+               deadline_ms: Optional[float] = None, **kw) -> int:
         """Accept (or loudly reject) one request; returns the router
         request id.  ``kw`` passes through to the replica's
-        ``put_request`` (max_new_tokens, eos_token_id, sampling...)."""
+        ``put_request`` (max_new_tokens, eos_token_id, sampling...).
+        ``deadline_ms`` is an ADMISSION input: already burned at
+        submit raises :class:`DeadlineRejection`; burning while queued
+        expires the request in the heap before it ever costs a slot."""
+        if not self.accepting:
+            self.stats_counters["rejected_draining"] += 1
+            raise DrainingRejection(
+                "router is draining (graceful shutdown): in-flight "
+                "requests finish, new ones are refused")
         alive = self._alive()
         if not alive:
             raise RouterRejection("no live replicas")
+        if deadline_ms is not None and float(deadline_ms) <= 0.0:
+            self.stats_counters["rejected_deadline"] += 1
+            raise DeadlineRejection(
+                f"deadline_ms={float(deadline_ms):g} already burned "
+                f"at submit")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         max_new = int(kw.get("max_new_tokens", 64))
         try:
@@ -260,6 +312,8 @@ class Router:
                          self._prefix_hash(prompt) if self.sticky
                          else ROOT_HASH,
                          int(prompt.size) + max_new)
+        if deadline_ms is not None:
+            req.deadline_t = req.accept_t + float(deadline_ms) / 1e3
         self._live[rid] = req
         heapq.heappush(self._heap, (-req.priority, self._hseq, req))
         self._hseq += 1
@@ -301,17 +355,50 @@ class Router:
 
     def _on_admit(self, h: Any, req: _RouterReq, uid: int) -> None:
         req.uid = int(uid)
+        if req.cancelled:
+            # cancelled between dispatch and the admit fold: the uid
+            # only just became known — propagate the teardown now
+            self._cancel_on_replica(h, int(uid))
+            return
         self._uid_rid[(h.name, int(uid))] = req.rid
+
+    def _emit(self, kind: str, rid: int, payload: Any) -> None:
+        if self.collect_events:
+            self._events.append((kind, rid, payload))
+
+    def poll_events(self) -> List[Tuple[str, int, Any]]:
+        """Drain the event stream (``collect_events`` must be on):
+        ``("tokens", rid, np.ndarray)`` / ``("finish", rid, tokens)``
+        / ``("deadline_expired", rid, None)`` / ``("cancelled", rid,
+        None)``, in arrival order on the pump thread."""
+        out, self._events = self._events, []
+        return out
 
     def _dispatch_queued(self) -> int:
         """Send queued requests to replicas until the queue is empty,
         every replica is at cap, or SLO defer holds the remainder;
-        returns the number dispatched."""
+        returns the number dispatched.  Cancelled entries are skipped
+        (lazy heap removal) and burned deadlines expire here — a
+        request whose deadline passed while queued never costs a
+        dispatch."""
         sent = 0
         burn = self._max_burn() if (self.slo is not None
                                     and not self._draining) else 0.0
         while self._heap:
             req = self._heap[0][2]
+            if req.cancelled:
+                heapq.heappop(self._heap)
+                continue
+            if (req.deadline_t is not None
+                    and self.clock() >= req.deadline_t):
+                heapq.heappop(self._heap)
+                self._live.pop(req.rid, None)
+                self.stats_counters["expired_deadline"] += 1
+                self._emit("deadline_expired", req.rid, None)
+                trace.event("router_deadline_expired", cat="serving",
+                            rid=req.rid, queued_ms=round(
+                                (self.clock() - req.accept_t) * 1e3, 3))
+                continue
             if (burn >= self.burn_defer and not self._draining
                     and req.priority < self.protected_priority):
                 # deferred: held in the router queue (heap order puts
@@ -348,8 +435,20 @@ class Router:
                     self._on_replica_death(h, e)
 
     def _on_step_done(self, h: Any, payload: Any) -> None:
-        outs, pool = payload
+        # payload is (outs, pool, deltas); legacy fakes post (outs, pool)
+        outs, pool = payload[0], payload[1]
+        deltas = payload[2] if len(payload) > 2 else ()
         self._pressure[h.name] = float(pool.get("pressure", 0.0))
+        for uid, new_toks, total, _done in deltas:
+            rid = self._uid_rid.get((h.name, int(uid)))
+            if rid is None:
+                continue          # a re-routed request's stale copy
+            req = self._live.get(rid)
+            if req is None or int(total) <= req.streamed:
+                continue          # re-route replay: already emitted
+            fresh = new_toks[len(new_toks) - (int(total) - req.streamed):]
+            req.streamed = int(total)
+            self._emit("tokens", rid, np.asarray(fresh, np.int32))
         for uid, toks in outs:
             rid = self._uid_rid.pop((h.name, int(uid)), None)
             if rid is None:
@@ -360,6 +459,7 @@ class Router:
             self._assigned[h.name].discard(rid)
             self._tokens[h.name] -= req.cost
             self._outputs[rid] = np.asarray(toks)
+            self._emit("finish", rid, self._outputs[rid])
             self.stats_counters["finished"] += 1
             e2e_ms = (self.clock() - req.accept_t) * 1e3
             if self.slo is not None:
@@ -367,6 +467,61 @@ class Router:
             trace.event("router_finish", cat="serving", rid=rid,
                         replica=h.name, e2e_ms=round(e2e_ms, 3),
                         attempts=req.attempts)
+
+    # -- cancellation + graceful drain -----------------------------------
+
+    def _cancel_on_replica(self, h: Any, uid: int) -> None:
+        """Propagate an engine-level cancel (slot teardown, page +
+        tier release) to ``h``; best-effort on handles without the
+        optional ``cancel_async`` op (older fakes)."""
+        canceller = getattr(h, "cancel_async", None)
+        if canceller is None or not h.alive:
+            return
+        try:
+            canceller(uid, on_done=None)
+        except Exception as e:    # join of an older op faulted
+            self._on_replica_death(h, e)
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel one accepted request (the front door's
+        client-disconnect path): a queued request is lazily removed
+        from the heap; a dispatched one is torn down on its replica
+        (slot + pages + tiered spill state released mid-decode).
+        Returns False when ``rid`` is unknown or already finished."""
+        req = self._live.pop(rid, None)
+        if req is None:
+            return False
+        req.cancelled = True
+        self.stats_counters["cancelled"] += 1
+        if req.replica is not None:
+            self._assigned.get(req.replica, set()).discard(rid)
+            if req.replica in self._tokens:
+                self._tokens[req.replica] -= req.cost
+            h = next((x for x in self.handles
+                      if x.name == req.replica), None)
+            if req.uid is not None:
+                self._uid_rid.pop((req.replica, req.uid), None)
+                if h is not None:
+                    self._cancel_on_replica(h, req.uid)
+            # uid still None: the admit fold hasn't run — _on_admit
+            # sees req.cancelled and propagates then
+        self._emit("cancelled", rid, None)
+        trace.event("router_cancel", cat="serving", rid=rid,
+                    dispatched=req.replica is not None)
+        return True
+
+    def begin_drain(self) -> None:
+        """Graceful drain for rolling restarts: stop admitting (submit
+        raises :class:`DrainingRejection`); in-flight and queued work
+        keeps dispatching and finishing through ``pump``/``join``.
+        The front door maps the rejection to 503 + Retry-After and
+        hands prefix-cache-warm state over via ``retire_replica`` once
+        in-flight streams finish."""
+        if not self.accepting:
+            return
+        self.accepting = False
+        trace.event("router_drain_begin", cat="serving",
+                    outstanding=len(self._live), queued=len(self._heap))
 
     def _on_replica_death(self, h: Any, exc: BaseException) -> None:
         """Failure isolation: mark the replica dead, dump the flight

@@ -107,6 +107,7 @@ class RequestLatencyTracker:
         self._done: deque = deque(maxlen=max_completed)
         self.submitted = 0
         self.finished = 0
+        self.cancelled = 0
         # "auto": the process registry singleton (respects its enabled
         # flag); None/False: no metrics feed; else an injected registry.
         self._registry = registry
@@ -204,6 +205,14 @@ class RequestLatencyTracker:
         if r is not None:
             r.errors += 1
 
+    def on_cancel(self, uid: Any) -> None:
+        """Cancelled mid-flight (client disconnect, deadline): drop the
+        live record WITHOUT feeding the percentile series — a cancelled
+        request's truncated TTFT/TPOT would skew the tails.  Only the
+        count survives."""
+        if self._live.pop(uid, None) is not None:
+            self.cancelled += 1
+
     def on_finish(self, uid: Any) -> Optional[Dict[str, Any]]:
         """Completes ``uid`` and returns its summary record (the SLO /
         tail-sampling input) — None if the uid was never submitted."""
@@ -283,6 +292,7 @@ class RequestLatencyTracker:
         }
         out: Dict[str, Any] = {"completed": len(done),
                                "submitted": self.submitted,
+                               "cancelled": self.cancelled,
                                "in_flight": len(self._live),
                                "prefill_computed_tokens": sum(
                                    r.prefill_computed for r in done),

@@ -22,6 +22,16 @@ sweep and saves a per-host profile the controller seeds from.
 
     python examples/serve_llama.py --control
     python examples/serve_llama.py --autotune /tmp/dstpu_profiles
+
+Network serving (``--listen HOST:PORT``) puts the engine (or the
+routed replica set, with ``--replicas N``) behind the asyncio HTTP
+front door: ``POST /v1/generate`` streams tokens over SSE as the
+engine harvests them, ``GET /healthz`` and ``GET /metrics`` serve
+probes, SIGTERM drains gracefully (503 for new work, in-flight
+streams finish).  Port 0 picks a free port.
+
+    python examples/serve_llama.py --listen 127.0.0.1:8071
+    python -m deepspeed_tpu.serving.client --port 8071 --requests 32
 """
 import argparse
 
@@ -76,6 +86,11 @@ def main() -> None:
                         "requests: matched KV pages attach read-only "
                         "(copy-on-write on divergence) so repeated "
                         "system prompts skip their prefill")
+    p.add_argument("--listen", metavar="HOST:PORT", default=None,
+                   help="serve over HTTP/SSE instead of the in-process "
+                        "demo loop: POST /v1/generate streams tokens, "
+                        "GET /healthz + /metrics serve probes, SIGTERM "
+                        "drains gracefully (port 0 = pick a free port)")
     p.add_argument("--replicas", type=int, default=1,
                    help="data-parallel engine replicas behind the "
                         "SLO-aware router (1 = solo engine, no router)")
@@ -187,6 +202,36 @@ def main() -> None:
         [sys_prompt,
          rng.integers(1, cfg.vocab_size, size=(n,), dtype=np.int32)])
         for n in (5, 17, 9, 30, 12, 7)]
+
+    if args.listen is not None:
+        from deepspeed_tpu.serving import (FrontDoorServer, ReplicaSet,
+                                           Router)
+
+        host, _, port_s = args.listen.rpartition(":")
+        rs = ReplicaSet(build_engine, max(args.replicas, 1))
+        router = Router(rs, policy=args.router_policy)
+        srv = FrontDoorServer(router, host=host or "127.0.0.1",
+                              port=int(port_s or 0)).start()
+        srv.install_signal_handlers()   # SIGTERM -> graceful drain
+        print(f"front door listening on http://{srv.host}:{srv.port} "
+              f"({len(rs.handles)} replica(s), "
+              f"policy={args.router_policy})")
+        print('  POST /v1/generate  {"prompt": [ids...], '
+              '"max_new_tokens": N}  -> SSE token stream')
+        print("  GET  /healthz  |  GET /metrics")
+        print(f"  load test: python -m deepspeed_tpu.serving.client "
+              f"--port {srv.port} --requests 32 --concurrency 8")
+        try:
+            srv.serve_forever()         # returns once drained
+        except KeyboardInterrupt:
+            pass
+        srv.close()
+        s = router.stats()
+        print(f"drained: accepted={s['accepted']} "
+              f"finished={s['finished']} cancelled={s['cancelled']} "
+              f"expired_deadline={s['expired_deadline']}")
+        rs.close()
+        return
 
     if args.replicas > 1:
         from deepspeed_tpu.serving import ReplicaSet, Router

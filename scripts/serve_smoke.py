@@ -141,6 +141,14 @@ def main() -> int:
                         "outputs bit-identical to single-engine, both "
                         "replicas served traffic, admission sheds "
                         "loudly at the queue cap)")
+    p.add_argument("--frontdoor", action="store_true",
+                   help="also gate the network front door (SSE "
+                        "streaming over a real socket bit-identical "
+                        "to in-process serving, client disconnect "
+                        "reclaims pool pages audit-verified, burned "
+                        "deadline is a typed 429, SIGTERM drain "
+                        "finishes in-flight streams with zero dropped "
+                        "tokens)")
     p.add_argument("--elastic", action="store_true",
                    help="also gate elastic serving (grow 1->2 then "
                         "retire the original under open-loop traffic: "
@@ -868,6 +876,212 @@ def main() -> int:
               f"routed_r1={r_stats['routed_r1']} "
               f"affinity_hits={r_stats['affinity_hits']} "
               f"cap_shed={cap_hit}")
+    if args.frontdoor:
+        # ---- network front door: HTTP/SSE over a real socket ---------
+        # the server is a transport, not a model: everything that
+        # leaves over SSE must be bit-identical to in-process serving,
+        # and every way a stream can END early (disconnect, deadline,
+        # drain) must leave the engines clean
+        import asyncio
+        import json as _json
+        import signal as _signal
+        import time as _time
+
+        from deepspeed_tpu.serving import (FrontDoorServer, ReplicaSet,
+                                           Router)
+        from deepspeed_tpu.serving import protocol as fd_proto
+        from deepspeed_tpu.serving.client import (LoadGenerator,
+                                                  sse_generate)
+
+        f_prompts = [rng.integers(1, 64, size=(n,), dtype=np.int32)
+                     for n in (9, 14, 7, 11, 16, 8, 13, 10)]
+        f_new = min(args.tokens, 20)
+
+        def f_engine(i=0):
+            return RaggedInferenceEngineV2(
+                LlamaForCausalLM(cfg), params=params, max_seqs=2,
+                max_seq_len=max_len, prefill_chunk=16,
+                decode_block_size=4, harvest_interval=3,
+                rng=jax.random.PRNGKey(args.seed))
+
+        def f_reference(prompt_list, new):
+            eng = f_engine()
+            order = {eng.put_request(q, max_new_tokens=new): i
+                     for i, q in enumerate(prompt_list)}
+            outs = {}
+            while eng.has_work():
+                eng.step()
+                for uid, toks in eng.get_outputs():
+                    outs[order[uid]] = toks
+            eng.sync()
+            for uid, toks in eng.get_outputs():
+                outs[order[uid]] = toks
+            eng.close()
+            return outs
+
+        f_ref = f_reference(f_prompts, f_new)
+        rs = ReplicaSet(f_engine, 2)
+        router = Router(rs, policy="least_tokens")
+        srv = FrontDoorServer(router, port=0).start()
+
+        # gate 1: SSE streaming bit-parity with in-process serving
+        gen = LoadGenerator(
+            srv.host, srv.port,
+            lambda i: {"prompt": f_prompts[i].tolist(),
+                       "max_new_tokens": f_new},
+            requests=len(f_prompts), concurrency=4)
+        f_sum = gen.run()
+        parity_bad = []
+        if f_sum["completed"] != len(f_prompts):
+            print(f"FAIL [frontdoor]: only {f_sum['completed']} of "
+                  f"{len(f_prompts)} streams completed "
+                  f"({f_sum['errors']})")
+            failures += 1
+        else:
+            for r in gen.results:
+                i = r["i"]
+                if (not np.array_equal(r["final"], f_ref[i])
+                        or r["tokens"]
+                        != list(f_ref[i][len(f_prompts[i]):])):
+                    parity_bad.append(i)
+            if parity_bad:
+                print(f"FAIL [frontdoor]: SSE output diverged from "
+                      f"in-process serving for requests {parity_bad}")
+                failures += 1
+        print(f"[frontdoor] streams={f_sum['completed']} "
+              f"ttft_ms_p50={f_sum['ttft_ms_p50']} "
+              f"tpot_ms_p50={f_sum['tpot_ms_p50']} parity_ok="
+              f"{not parity_bad}")
+
+        def f_quiesce(timeout=20.0):
+            t0 = _time.monotonic()
+            while _time.monotonic() - t0 < timeout:
+                if router.outstanding == 0 and router.queued == 0:
+                    _time.sleep(0.1)
+                    if router.outstanding == 0:
+                        return True
+                _time.sleep(0.02)
+            return False
+
+        # gate 2: mid-stream client disconnect must cancel the request
+        # at the engine and return every pool page, audit-verified
+        f_quiesce()
+        free0 = [h.engine.allocator.free_pages for h in rs.handles]
+        res = asyncio.run(sse_generate(
+            srv.host, srv.port,
+            {"prompt": f_prompts[0].tolist(), "max_new_tokens": 64},
+            abort_after_events=1))
+        reclaimed = False
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < 20.0:
+            if (sum(h.engine.cancels for h in rs.handles) >= 1
+                    and router.outstanding == 0
+                    and [h.engine.allocator.free_pages
+                         for h in rs.handles] == free0):
+                reclaimed = True
+                break
+            _time.sleep(0.05)
+        if res["error"] != "client_abort" or not reclaimed:
+            print(f"FAIL [frontdoor]: disconnect did not reclaim pool "
+                  f"pages (err={res['error']}, free="
+                  f"{[h.engine.allocator.free_pages for h in rs.handles]}"
+                  f" vs {free0})")
+            failures += 1
+        f_quiesce()
+        try:
+            for h in rs.handles:
+                h.engine.audit_kv_sharing()
+        except AssertionError as e:
+            print(f"FAIL [frontdoor]: refcount audit broke after "
+                  f"disconnect cancel: {e}")
+            failures += 1
+        print(f"[frontdoor] disconnect cancel reclaimed={reclaimed} "
+              f"engine_cancels="
+              f"{sum(h.engine.cancels for h in rs.handles)}")
+
+        # gate 3: a burned deadline is a typed 429 at the front door
+        res = asyncio.run(sse_generate(
+            srv.host, srv.port,
+            {"prompt": f_prompts[0].tolist(), "max_new_tokens": 8,
+             "deadline_ms": 0.0}))
+        if res["status"] != 429 or res["error"] != "DeadlineRejection":
+            print(f"FAIL [frontdoor]: burned deadline returned "
+                  f"{res['status']}/{res['error']}, expected "
+                  f"429/DeadlineRejection")
+            failures += 1
+        print(f"[frontdoor] burned deadline -> {res['status']} "
+              f"{res['error']}")
+        srv.close()
+        rs.close()
+
+        # gate 4: SIGTERM drain — new requests 503, the in-flight
+        # stream finishes with ZERO dropped tokens (bit-parity incl.)
+        d_prompt = f_prompts[1]
+        d_ref = f_reference([d_prompt], 24)[0]
+        rs2 = ReplicaSet(f_engine, 1)
+        router2 = Router(rs2, policy="rr")
+        srv2 = FrontDoorServer(router2, port=0).start()
+        srv2.install_signal_handlers()
+
+        async def drain_scenario():
+            body = _json.dumps({"prompt": d_prompt.tolist(),
+                                "max_new_tokens": 24}).encode()
+            ra, wa = await asyncio.open_connection(srv2.host, srv2.port)
+            wa.write((f"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
+                      f"Content-Length: {len(body)}\r\n\r\n"
+                      ).encode() + body)
+            await wa.drain()
+            await ra.readuntil(b"\r\n\r\n")
+            parser = fd_proto.SSEParser()
+            events = []
+            while not any(e == "tokens" for e, _ in events):
+                events += parser.feed(await ra.read(4096))
+            os.kill(os.getpid(), _signal.SIGTERM)
+            t0 = _time.monotonic()
+            while not srv2.draining and _time.monotonic() - t0 < 5.0:
+                await asyncio.sleep(0.01)
+            rb, wb = await asyncio.open_connection(srv2.host, srv2.port)
+            wb.write((f"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
+                      f"Content-Length: {len(body)}\r\n\r\n"
+                      ).encode() + body)
+            await wb.drain()
+            rejected = await rb.read(-1)
+            wb.close()
+            truncated = False
+            while not any(e == "done" for e, _ in events):
+                chunk = await ra.read(4096)
+                if not chunk:
+                    truncated = True
+                    break
+                events += parser.feed(chunk)
+            wa.close()
+            return events, rejected, truncated
+
+        d_events, d_rejected, d_trunc = asyncio.run(drain_scenario())
+        if (not d_rejected.startswith(b"HTTP/1.1 503")
+                or b"Retry-After:" not in d_rejected):
+            print(f"FAIL [frontdoor]: draining server did not 503 new "
+                  f"work with Retry-After ({d_rejected[:80]!r})")
+            failures += 1
+        streamed = [t for e, d in d_events if e == "tokens"
+                    for t in _json.loads(d)["tokens"]]
+        done = next((_json.loads(d) for e, d in d_events if e == "done"),
+                    None)
+        drain_ok = (not d_trunc and done is not None
+                    and np.array_equal(done["tokens"], d_ref)
+                    and streamed == list(d_ref[len(d_prompt):]))
+        if not drain_ok:
+            print(f"FAIL [frontdoor]: drain dropped tokens (truncated="
+                  f"{d_trunc}, streamed {len(streamed)} of "
+                  f"{len(d_ref) - len(d_prompt)})")
+            failures += 1
+        if not srv2.wait_drained(30.0):
+            print("FAIL [frontdoor]: drain never completed")
+            failures += 1
+        srv2.close()
+        rs2.close()
+        print(f"[frontdoor] drain 503={d_rejected[:12]!r} "
+              f"inflight_tokens={len(streamed)} zero_dropped={drain_ok}")
     if args.elastic:
         # ---- elastic serving: grow 1->2, then retire the original ----
         # world-size change as a recoverable event: a replica joins a
@@ -1129,6 +1343,8 @@ def main() -> int:
            if args.metrics else "") +
           (", routed serving bit-identical across 2 replicas with "
            "loud queue-cap shedding" if args.router else "") +
+          (", front door SSE bit-exact with clean disconnect/deadline/"
+           "drain endings" if args.frontdoor else "") +
           (", elastic grow+shrink conserved every request bit-exactly "
            "with digest-verified handoff" if args.elastic else "") +
           (", control plane converged the mis-tuned engine with clean "

@@ -52,6 +52,14 @@ _KNOWN_PH = {"X", "i", "M"}
 _CONTROL_ACTIONS = {"probe", "accept", "revert", "settle", "rule",
                     "freeze", "unfreeze"}
 
+# the front door's span vocabulary (serving.server.FrontDoorServer):
+# connection-lifetime instants and per-request phase spans, every one
+# carrying the connection id so a conn's timeline reconstructs from
+# the trace alone
+_HTTP_INSTANTS = {"http_accept", "http_close", "http_cancel",
+                  "http_drained"}
+_HTTP_SPANS = {"http_parse", "http_admit", "http_stream", "http_flush"}
+
 
 def load_events(path: str) -> Tuple[List[Dict[str, Any]], str]:
     """Load events from either format; returns ``(events, kind)`` where
@@ -208,6 +216,27 @@ def validate_events(events: List[Dict[str, Any]]) -> List[str]:
             if "old" not in a or "new" not in a:
                 problems.append(f"event {i}: control_decision missing "
                                 "old/new values")
+        if ev.get("cat") == "http":
+            # front-door events reconstruct per-connection timelines:
+            # the name must be in the vocabulary, instants and spans
+            # must not swap ph, and (http_drained aside — it is
+            # server-scoped) every event names its connection
+            name = ev.get("name")
+            if name not in _HTTP_INSTANTS | _HTTP_SPANS:
+                problems.append(f"event {i}: unknown http event "
+                                f"{name!r}")
+            elif ph == "i" and name in _HTTP_SPANS:
+                problems.append(f"event {i}: http span {name!r} "
+                                f"emitted as instant")
+            elif ph == "X" and name in _HTTP_INSTANTS:
+                problems.append(f"event {i}: http instant {name!r} "
+                                f"emitted as span")
+            elif name != "http_drained":
+                a = ev.get("args", {})
+                conn = a.get("conn")
+                if not isinstance(conn, int) or isinstance(conn, bool):
+                    problems.append(f"event {i}: {name} missing int "
+                                    f"'conn' arg (got {conn!r})")
         if len(problems) >= 20:
             problems.append("... (stopping after 20 problems)")
             break

@@ -13,7 +13,8 @@ import itertools
 import numpy as np
 import pytest
 
-from deepspeed_tpu.serving import (NeverSchedulableRejection,
+from deepspeed_tpu.serving import (DeadlineRejection, DrainingRejection,
+                                   NeverSchedulableRejection,
                                    QueueFullRejection, Router,
                                    RouterRejection, ShedRejection)
 from deepspeed_tpu.telemetry import SLOSet, flight, read_flight_record
@@ -89,6 +90,48 @@ class FakeReplica:
     def close(self):
         self.alive = False
         self.closed = True
+
+
+class StreamingFakeReplica(FakeReplica):
+    """Delta-emitting fake: one generated token per step per admitted
+    request, posted through the 3-tuple ``(outs, pool, deltas)``
+    payload, plus the optional ``cancel_async`` op."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.generated = {}           # uid -> [tokens]
+        self.cancelled = []
+
+    def put_async(self, prompt, kw, accept_t, on_done):
+        super().put_async(prompt, kw, accept_t, on_done)
+        self.generated[self.admitted[-1][0]] = []
+
+    def cancel_async(self, uid, on_done=None):
+        before = len(self.admitted)
+        self.admitted = [e for e in self.admitted if e[0] != uid]
+        stage = "decode" if len(self.admitted) < before else None
+        if stage:
+            self.cancelled.append(uid)
+        if on_done is not None:
+            on_done(stage)
+
+    def step_async(self, on_done):
+        self.steps += 1
+        if self.die_at_step is not None and self.steps >= self.die_at_step:
+            raise RuntimeError(f"scripted death of {self.name}")
+        outs, deltas, keep = [], [], []
+        for ent in self.admitted:
+            ent[1] -= 1
+            gen = self.generated[ent[0]]
+            gen.append(100 + len(gen))
+            deltas.append((ent[0], [gen[-1]], len(gen), ent[1] <= 0))
+            if ent[1] <= 0:
+                outs.append((ent[0], np.concatenate(
+                    [ent[2], np.asarray(gen, np.int32)])))
+            else:
+                keep.append(ent)
+        self.admitted = keep
+        on_done((outs, {"pressure": float(len(self.admitted))}, deltas))
 
 
 def _prompt(n, base=1):
@@ -280,6 +323,163 @@ class TestReplicaDeath:
         router.submit(_prompt(3), max_new_tokens=4)
         with pytest.raises(RouterRejection, match="all replicas dead"):
             router.drain()
+
+
+class TestDeadlines:
+    def test_burned_deadline_rejected_at_submit(self):
+        router = Router([FakeReplica(0)], sticky=False)
+        with pytest.raises(DeadlineRejection, match="already burned"):
+            router.submit(_prompt(3), deadline_ms=0.0, max_new_tokens=4)
+        with pytest.raises(DeadlineRejection):
+            router.submit(_prompt(3), deadline_ms=-5, max_new_tokens=4)
+        assert router.stats()["rejected_deadline"] == 2
+        assert router.stats()["accepted"] == 0
+
+    def test_queued_request_expires_in_heap(self):
+        # SLO defer holds a low-priority request in the router queue;
+        # its deadline burns there and it must expire at the next
+        # dispatch sweep without ever costing a put
+        clock = FakeClock()
+        slo = SLOSet(["router_e2e_ms_p50 <= 10"], clock=clock)
+        fake = FakeReplica(0, max_seqs=8)
+        router = Router([fake], slo=slo, sticky=False, clock=clock)
+        router.collect_events = True
+        slo.record("router_e2e_ms", 100.0)       # burn 1.0: defer
+        slo.record("router_e2e_ms", 1.0)         # range, not shed
+        rid = router.submit(_prompt(3), deadline_ms=100.0, priority=0,
+                            max_new_tokens=4)
+        router.pump()
+        assert router.queued == 1                # held by defer
+        clock.advance(0.2)                       # 200 ms > 100 ms
+        router.pump()
+        assert router.queued == 0
+        assert router.stats()["expired_deadline"] == 1
+        assert ("deadline_expired", rid, None) in router.poll_events()
+        assert len(fake.puts) == 0               # never dispatched
+        # the expired request never finishes and never blocks drain
+        outs = _drain(router)
+        assert rid not in outs
+
+    def test_live_deadline_dispatches_normally(self):
+        clock = FakeClock()
+        router = Router([FakeReplica(0, max_seqs=8)], sticky=False,
+                        clock=clock)
+        rid = router.submit(_prompt(3), deadline_ms=10_000.0,
+                            max_new_tokens=4)
+        assert rid in _drain(router)
+        assert router.stats()["expired_deadline"] == 0
+
+
+class TestCancellation:
+    def test_cancel_queued_never_dispatches(self):
+        # SLO defer parks the low-priority request in the heap; a
+        # cancel there is lazy removal — it must never reach a replica
+        clock = FakeClock()
+        slo = SLOSet(["router_e2e_ms_p50 <= 10"], clock=clock)
+        fake = FakeReplica(0, latency=5, max_seqs=8)
+        router = Router([fake], slo=slo, sticky=False, clock=clock)
+        slo.record("router_e2e_ms", 100.0)       # burn 1.0: defer
+        slo.record("router_e2e_ms", 1.0)         # range, not shed
+        rid0 = router.submit(_prompt(3, base=1), priority=1,
+                             max_new_tokens=4)   # protected: dispatches
+        rid1 = router.submit(_prompt(3, base=10), priority=0,
+                             max_new_tokens=4)   # deferred: queued
+        router.pump()
+        assert router.queued == 1
+        assert router.cancel(rid1) is True
+        outs = _drain(router)
+        assert rid0 in outs and rid1 not in outs
+        assert len(fake.puts) == 1               # rid1 never reached it
+        assert router.stats()["cancelled"] == 1
+
+    def test_cancel_dispatched_propagates_to_replica(self):
+        fake = StreamingFakeReplica(0, latency=50, max_seqs=4)
+        router = Router([fake], sticky=False)
+        rid = router.submit(_prompt(3), max_new_tokens=4)
+        keep = router.submit(_prompt(3, base=10), max_new_tokens=2)
+        router.pump()
+        assert router.cancel(rid) is True
+        assert fake.cancelled == [fake.puts[0][0]]
+        # router-side accounting unwound: tokens budget back to the
+        # survivor's cost only
+        assert router.stats()["outstanding_tokens_f0"] == 5
+        outs = _drain(router)
+        assert keep in outs and rid not in outs
+
+    def test_cancel_unknown_or_finished_is_false(self):
+        router = Router([FakeReplica(0, max_seqs=8)], sticky=False)
+        rid = router.submit(_prompt(3), max_new_tokens=4)
+        _drain(router)
+        assert router.cancel(rid) is False       # already finished
+        assert router.cancel(999) is False
+        assert router.stats()["cancelled"] == 0
+
+
+class TestEventStream:
+    def test_tokens_stream_at_harvest_granularity(self):
+        fake = StreamingFakeReplica(0, latency=3, max_seqs=4)
+        router = Router([fake], sticky=False)
+        router.collect_events = True
+        rid = router.submit(_prompt(3), max_new_tokens=3)
+        streamed, finals = [], {}
+        while router.outstanding:
+            router.pump()
+            router.join()
+            for kind, r, payload in router.poll_events():
+                if kind == "tokens":
+                    streamed.extend(int(t) for t in payload)
+                elif kind == "finish":
+                    finals[r] = payload
+        assert streamed == [100, 101, 102]
+        assert rid in finals
+        # streamed tokens are exactly the generated suffix of the final
+        np.testing.assert_array_equal(finals[rid][-3:], streamed)
+
+    def test_rerouted_replay_is_deduplicated(self):
+        # a request re-routed after replica death replays its tokens
+        # from zero on the survivor; the cumulative-total cursor must
+        # suppress the replayed prefix (no token reaches the stream
+        # twice)
+        fake = StreamingFakeReplica(0, latency=5, max_seqs=4)
+        router = Router([fake], sticky=False)
+        router.collect_events = True
+        rid = router.submit(_prompt(3), max_new_tokens=5)
+        router.pump()
+        router.join()
+        uid = fake.puts[0][0]
+        # two harvests land: totals 1 then 2
+        router._on_step_done(fake, ([], {}, [(uid, [100], 1, False)]))
+        router._on_step_done(fake, ([], {}, [(uid, [101], 2, False)]))
+        # replica restarts the request: replays totals 1 and 2, then 3
+        router._on_step_done(fake, ([], {}, [(uid, [100], 1, False)]))
+        router._on_step_done(
+            fake, ([], {}, [(uid, [100, 101], 2, False)]))
+        router._on_step_done(fake, ([], {}, [(uid, [102], 3, False)]))
+        toks = [int(t) for k, r, p in router.poll_events()
+                if k == "tokens" for t in p]
+        assert toks == [100, 101, 102], toks
+        assert router._live[rid].streamed == 3
+
+    def test_events_not_collected_unless_opted_in(self):
+        fake = StreamingFakeReplica(0, latency=2, max_seqs=4)
+        router = Router([fake], sticky=False)
+        router.submit(_prompt(3), max_new_tokens=2)
+        _drain(router)
+        assert router.poll_events() == []
+
+
+class TestDraining:
+    def test_drain_refuses_new_finishes_inflight(self):
+        fake = FakeReplica(0, latency=3, max_seqs=8)
+        router = Router([fake], sticky=False)
+        rid = router.submit(_prompt(3), max_new_tokens=4)
+        router.begin_drain()
+        with pytest.raises(DrainingRejection, match="draining"):
+            router.submit(_prompt(3), max_new_tokens=4)
+        assert router.stats()["rejected_draining"] == 1
+        # in-flight work still dispatches and finishes
+        assert rid in _drain(router)
+        assert router.stats()["finished"] == 1
 
 
 # -- integration against REAL engines ------------------------------------
