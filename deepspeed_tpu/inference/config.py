@@ -100,6 +100,14 @@ class KVTieringConfig(ConfigModel):
     verify: bool = True
     checksum: str = "sum64"
     max_reread: int = 2
+    # -- degraded mode: nvme_fail_threshold hard NVMe failures since
+    # the last clean probe (EIO at write submit / cold read, or a
+    # quarantine of an NVMe-backed payload) trip the tier offline —
+    # spills fall back host-only, parked NVMe payloads fold to
+    # re-prefill.  While offline, every probe_every blocked spills run
+    # a write/read/verify revival probe; a clean probe re-arms the tier
+    nvme_fail_threshold: int = 3
+    probe_every: int = 8
     # -- partial residency (long context): a live sequence's page list
     # may split between HBM-resident pages and parked pages.  The first
     # ``sink_pages`` (attention sinks) and the most recent
@@ -131,6 +139,11 @@ class KVTieringConfig(ConfigModel):
                 "kv_tiering.nvme_pages > 0 requires kv_tiering.nvme_dir")
         if self.max_reread < 0:
             raise ValueError("kv_tiering.max_reread must be >= 0")
+        if self.nvme_fail_threshold < 1:
+            raise ValueError(
+                "kv_tiering.nvme_fail_threshold must be >= 1")
+        if self.probe_every < 1:
+            raise ValueError("kv_tiering.probe_every must be >= 1")
         if self.sink_pages < 1:
             raise ValueError("kv_tiering.sink_pages must be >= 1")
         if self.window_pages < 1:

@@ -29,6 +29,20 @@ the decode block) runs on the shared bounded-async-stage substrate
 (``utils/async_stage.py``): bounded in-flight windows, forced-drain
 points, per-stage timers in the existing telemetry schema.
 
+**Degraded mode** (serving fault tolerance): a failing NVMe device must
+not take serving down with it.  ``nvme_fail_threshold`` hard NVMe
+failures since the last clean probe (EIO/ENOSPC at write submit or
+cold read, or a quarantine of an NVMe-backed payload) trip the tier
+OFFLINE:
+``can_spill``/``_demote`` fall back host-only, every parked NVMe-backed
+payload is folded (its session re-prefills via
+:class:`KVRestoreError` on the next restore — loud, never silent), and
+a ``tier_degraded`` flight record + trace event + metric mark the
+trip.  While offline, blocked spills periodically run
+:meth:`probe_nvme` — a write/read/verify round-trip through the same
+``kv.write`` fault hook as the spill path — and a clean probe re-arms
+the tier (``tier_rearmed``).
+
 The store holds HOST STATE ONLY — device-side gather/scatter of pages
 stays in the engine (it owns the cache pytree and the jitted
 fixed-shape programs).  The unit of exchange is a list of per-leaf
@@ -41,12 +55,13 @@ import os
 import shutil
 import tempfile
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple, Union
 
 import numpy as np
 
 from deepspeed_tpu.resilience import faults
 from deepspeed_tpu.resilience.sdc import DigestPool, digest as sdc_digest
+from deepspeed_tpu.telemetry import trace
 from deepspeed_tpu.utils.async_stage import (BoundedAsyncStage,
                                              HostBufferPool, StageTimers)
 from deepspeed_tpu.utils.logging import logger
@@ -110,7 +125,9 @@ class TieredKVStore:
                  nvme_dir: Optional[str] = None, use_odirect: bool = False,
                  prefetch: bool = True, verify: bool = True,
                  checksum: str = "sum64", max_reread: int = 2,
-                 write_depth: int = 4, read_depth: int = 2) -> None:
+                 write_depth: int = 4, read_depth: int = 2,
+                 nvme_fail_threshold: int = 3,
+                 probe_every: int = 8) -> None:
         self.pages_per_seq = int(pages_per_seq)
         self.host_budget = int(host_pages)
         self.nvme_budget = int(nvme_pages)
@@ -119,6 +136,16 @@ class TieredKVStore:
         self.max_reread = max(0, int(max_reread))
         self.prefetch_enabled = bool(prefetch) and self.nvme_budget > 0
         self.use_odirect = bool(use_odirect)
+        # degraded mode: nvme_fail_threshold hard NVMe failures since
+        # the last clean probe trip the tier offline (host-only until a
+        # clean probe_nvme round-trip re-arms it, attempted every
+        # probe_every spills blocked on the missing tier)
+        self.nvme_fail_threshold = max(1, int(nvme_fail_threshold))
+        self.probe_every = max(1, int(probe_every))
+        self.nvme_offline = False
+        self._nvme_streak = 0        # hard failures since last clean probe
+        self._probe_backoff = 0      # blocked spills since last probe
+        self._lost: Set[Key] = set()  # folded at trip: restore re-prefills
 
         # packed page layout: each leaf's bytes at a fixed offset inside
         # the page's stride-aligned slice (padding zeroed at pack time
@@ -166,7 +193,10 @@ class TieredKVStore:
             # partial-residency page-in (peek): parked middles streamed
             # through staging without dropping the tier entry
             "pageins": 0, "pagein_pages": 0, "pagein_bytes": 0,
-            "pagein_prefetch_hits": 0, "pagein_prefetch_misses": 0}
+            "pagein_prefetch_hits": 0, "pagein_prefetch_misses": 0,
+            # degraded mode (persistent NVMe failure -> host-only)
+            "nvme_failures": 0, "tier_degraded": 0, "tier_rearmed": 0,
+            "degraded_folds": 0, "probes": 0, "probe_failures": 0}
         self._pagein_hist = None
 
         self.spill_dir: Optional[str] = None
@@ -208,13 +238,31 @@ class TieredKVStore:
         return self.host_budget + self.nvme_budget
 
     def free_pages(self) -> int:
+        nvme_budget = 0 if self.nvme_offline else self.nvme_budget
         return ((self.host_budget - self._host_used)
-                + (self.nvme_budget - self._nvme_used))
+                + max(0, nvme_budget - self._nvme_used))
 
     def can_spill(self, n_pages: int) -> bool:
         """Whether a ``n_pages`` spill can land somewhere (host, or
-        host-after-demotion, or straight to NVMe)."""
-        if n_pages > max(self.host_budget, self.nvme_budget):
+        host-after-demotion, or straight to NVMe).  With the NVMe tier
+        offline (degraded mode) only the host budget counts; a spill
+        blocked on the missing tier periodically triggers a
+        :meth:`probe_nvme` revival attempt."""
+        if self.nvme_offline and self.nvme_budget > 0:
+            host_free = self.host_budget - self._host_used
+            if n_pages <= self.host_budget and host_free >= n_pages:
+                return True
+            # the dead tier is the binding constraint: probe for revival
+            self._probe_backoff += 1
+            if self._probe_backoff >= self.probe_every:
+                self._probe_backoff = 0
+                if not self.probe_nvme():
+                    return False
+                # fall through re-armed
+            else:
+                return False
+        if n_pages > max(self.host_budget,
+                         0 if self.nvme_offline else self.nvme_budget):
             return False
         return self.free_pages() >= n_pages
 
@@ -301,13 +349,30 @@ class TieredKVStore:
     def _nvme_spill(self, ent: _Entry) -> None:
         """Queue ``ent``'s buffer for NVMe write-back on the bounded
         window (fallocate sizes the file up-front inside async_pwrite;
-        the buffer stays alive until the window joins the op)."""
+        the buffer stays alive until the window joins the op).  A hard
+        IO error at submit (or injected at the ``kv.write`` fault site)
+        feeds the degraded-mode failure streak and raises
+        ``RuntimeError`` so callers take their existing no-room
+        fallback paths."""
         assert self.spill_dir is not None
-        ent.path = self._fname(ent.uid)
+        if self.nvme_offline:
+            raise RuntimeError(
+                "kv tiering: NVMe tier offline (degraded mode)")
+        path = self._fname(ent.uid)
+        try:
+            d = faults.hook("kv.write", uid=ent.uid, path=path)
+            if d is not None and d[0] in ("hang", "slow"):
+                time.sleep(float(d[1]))
+            with self.timers.stage("spill_write_submit"):
+                op = self._handle().async_pwrite(ent.buf, path)
+        except OSError as e:
+            self._nvme_failure(e, f"write-back submit for uid {ent.uid}")
+            raise RuntimeError(
+                f"kv tiering: NVMe write-back failed for uid "
+                f"{ent.uid}: {e}") from e
+        ent.path = path
         ent.state = "writing"
         self._nvme_used += ent.n_pages
-        with self.timers.stage("spill_write_submit"):
-            op = self._handle().async_pwrite(ent.buf, ent.path)
         buf = ent.buf               # keep a ref until the join
 
         def _done(_st, ent=ent, buf=buf):
@@ -323,6 +388,10 @@ class TieredKVStore:
     def _demote(self, need_pages: int) -> None:
         """Move the oldest host-resident entries to NVMe until
         ``need_pages`` of host budget are free."""
+        if self.nvme_offline:
+            raise RuntimeError(
+                "kv tiering: cannot demote — NVMe tier offline "
+                "(degraded mode)")
         moved = 0
         for ent in sorted((e for e in self._entries.values()
                            if e.state == "host"), key=lambda e: e.seq):
@@ -372,6 +441,7 @@ class TieredKVStore:
         ``verify``).  Drops the entry on success — the pages are HBM's
         again.  Raises :class:`KVRestoreError` after quarantining on
         unrecoverable corruption (the caller re-prefills loudly)."""
+        self._check_lost(uid)
         ent = self._entries.get(uid)
         assert ent is not None, f"uid {uid} not spilled"
         with self.timers.stage("restore"):
@@ -398,6 +468,7 @@ class TieredKVStore:
         :class:`KVRestoreError`).  The blocking wait is observed as the
         ``pagein_wait`` stage (a ``cat="kv"`` trace span) and the
         ``dstpu_kv_pagein_stall_ms`` histogram."""
+        self._check_lost(uid)
         ent = self._entries.get(uid)
         assert ent is not None, f"uid {uid} not spilled"
         was = ent.state
@@ -468,7 +539,27 @@ class TieredKVStore:
 
         work = aligned_empty(n)
         with self.timers.stage("restore_read"):
-            self._handle().sync_pread(work, ent.path)
+            try:
+                self._handle().sync_pread(work, ent.path)
+            except OSError as e:
+                self._nvme_failure(e, f"cold read of spilled uid "
+                                      f"{ent.uid}")
+                # the trip may already have folded this entry; if not,
+                # fold it here — either way the session re-prefills
+                if ent.uid in self._entries:
+                    self._drop(ent)
+                self._lost.discard(ent.uid)
+                err = KVRestoreError(
+                    ent.uid, -1,
+                    f"kv tiering: NVMe read for spilled uid {ent.uid} "
+                    f"failed ({e}) — payload unreachable, the session "
+                    "must re-prefill")
+                from deepspeed_tpu.telemetry import flight
+
+                flight.dump_on_fault("kv_restore_error", err,
+                                     extra={"uid": str(ent.uid),
+                                            "page": -1})
+                raise err from e
         return work
 
     def _verify_pages(self, ent: _Entry, work: np.ndarray,
@@ -515,7 +606,7 @@ class TieredKVStore:
                 from deepspeed_tpu.telemetry import flight
 
                 flight.dump_on_fault("kv_restore_error", err,
-                                     extra={"uid": int(ent.uid),
+                                     extra={"uid": str(ent.uid),
                                             "page": int(i)})
                 raise err
             self.counters["pages_verified"] += 1
@@ -539,6 +630,12 @@ class TieredKVStore:
             f"kv tiering: QUARANTINED corrupt spilled page {page} of "
             f"uid {ent.uid} ({where}); session will re-prefill")
         self._drop(ent)
+        if ent.path is not None:
+            # a corrupt NVMe-backed payload counts toward the degraded-
+            # mode streak (a dying device shows up as repeated
+            # quarantines as readily as hard EIO)
+            self._nvme_failure(None, f"quarantine of uid {ent.uid} "
+                                     f"page {page}")
 
     def _drop(self, ent: _Entry) -> None:
         if self._entries.pop(ent.uid, None) is None:
@@ -566,9 +663,138 @@ class TieredKVStore:
 
     def drop(self, uid: Key) -> None:
         """Discard a spilled payload (session finished or re-prefills)."""
+        self._lost.discard(uid)
         ent = self._entries.get(uid)
         if ent is not None:
             self._drop(ent)
+
+    # -- degraded mode (NVMe tier offline) --------------------------------
+
+    def _check_lost(self, uid: Key) -> None:
+        """A payload folded at a degraded-mode trip is gone: raise the
+        same typed error as a quarantine so the caller's existing
+        re-prefill path takes over."""
+        if uid in self._lost:
+            self._lost.discard(uid)
+            raise KVRestoreError(
+                uid, -1,
+                f"kv tiering: spilled uid {uid} was folded when the "
+                "NVMe tier went offline (degraded mode) — the session "
+                "must re-prefill")
+
+    def _nvme_failure(self, exc: Optional[BaseException],
+                      why: str) -> None:
+        """Record one hard NVMe failure; trip the tier offline at
+        ``nvme_fail_threshold`` failures since the last clean probe.
+        Interleaved successful IO does NOT reset the streak — on a
+        dying device reads of old data often keep succeeding while new
+        writes fail, and only a full :meth:`probe_nvme` round-trip
+        vouches for health."""
+        self.counters["nvme_failures"] += 1
+        self._nvme_streak += 1
+        logger.error(
+            f"kv tiering: NVMe failure {self._nvme_streak}/"
+            f"{self.nvme_fail_threshold} ({why}): {exc}")
+        if (not self.nvme_offline
+                and self._nvme_streak >= self.nvme_fail_threshold):
+            self._trip_nvme(why, exc)
+
+    def _trip_nvme(self, why: str,
+                   exc: Optional[BaseException]) -> None:
+        """Take the NVMe tier offline: fold every parked NVMe-backed
+        payload (each session re-prefills loudly via
+        :class:`KVRestoreError`), stop demoting, and mark the trip in
+        counters/metrics/trace/flight.  Host-tier payloads are
+        untouched."""
+        self.nvme_offline = True
+        self._probe_backoff = 0
+        folded: List[Key] = []
+        for ent in list(self._entries.values()):
+            if ent.state == "host":
+                continue
+            folded.append(ent.uid)
+            if ent.state == "writing":
+                # the in-flight write op targets a dead device: abandon
+                # it un-joined (joining could wedge or re-raise EIO)
+                self._writes.discard(("w", ent.uid))
+            elif ent.state == "reading":
+                self._reads.discard(("r", ent.uid))
+                # deliberately LEAK the staging slot: the abandoned aio
+                # read may still scribble into it, so reissuing the
+                # buffer to a future read would race
+                ent.slot = None
+            self._nvme_used -= ent.n_pages
+            self._entries.pop(ent.uid, None)
+            self._digests.discard(ent.uid)
+            ent.buf = None
+            self._lost.add(ent.uid)
+        self.counters["tier_degraded"] += 1
+        self.counters["degraded_folds"] += len(folded)
+        logger.error(
+            f"kv tiering: NVMe tier OFFLINE after {self._nvme_streak} "
+            f"consecutive failures ({why}); folded {len(folded)} parked "
+            "payload(s) to re-prefill, demotions fall back host-only")
+        from deepspeed_tpu.telemetry import flight
+        from deepspeed_tpu.telemetry.metrics import metrics as _metrics
+
+        if _metrics.enabled:
+            _metrics.counter(
+                "dstpu_tier_degraded_total",
+                "KV spill tiers tripped offline (degraded mode)",
+                labels=("tier",)).labels(tier="nvme").inc()
+        if trace.enabled:
+            trace.event("tier_degraded", cat="resilience", tier="nvme",
+                        streak=int(self._nvme_streak),
+                        folded=len(folded))
+        flight.dump_on_fault(
+            "tier_degraded",
+            exc if exc is not None else RuntimeError(why),
+            extra={"tier": "nvme", "streak": int(self._nvme_streak),
+                   "folded_uids": [str(u) for u in folded],
+                   "why": why})
+
+    def probe_nvme(self) -> bool:
+        """Degraded-mode recovery probe: write, read back, and verify
+        one page-stride block through the same ``kv.write`` fault site
+        as the spill path.  A clean round-trip re-arms the NVMe tier;
+        a failed probe leaves it offline (and does NOT feed the
+        failure streak — the tier is already down)."""
+        if self.nvme_budget <= 0 or self.spill_dir is None:
+            return False
+        self.counters["probes"] += 1
+        path = os.path.join(self.spill_dir, "probe.bin")
+        from deepspeed_tpu.io.aio import aligned_empty
+
+        buf = aligned_empty(self.page_stride)
+        buf[:] = (np.arange(self.page_stride) % 251).astype(np.uint8)
+        back = aligned_empty(self.page_stride)
+        try:
+            d = faults.hook("kv.write", uid="probe", path=path)
+            if d is not None and d[0] in ("hang", "slow"):
+                time.sleep(float(d[1]))
+            self._handle().sync_pwrite(buf, path)
+            self._handle().sync_pread(back, path)
+            if not np.array_equal(buf, back):
+                raise OSError("probe read-back mismatch")
+        except OSError as e:
+            self.counters["probe_failures"] += 1
+            logger.warning(f"kv tiering: NVMe revival probe failed: {e}")
+            return False
+        finally:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        self._nvme_streak = 0       # a clean round-trip vouches for it
+        if self.nvme_offline:
+            self.nvme_offline = False
+            self.counters["tier_rearmed"] += 1
+            logger.info("kv tiering: NVMe tier re-armed after clean "
+                        "revival probe")
+            if trace.enabled:
+                trace.event("tier_rearmed", cat="resilience",
+                            tier="nvme")
+        return True
 
     # -- cross-replica handoff (elastic shrink) --------------------------
 
@@ -580,6 +806,7 @@ class TieredKVStore:
         computed at spill time: the handoff is integrity-checked
         end-to-end, not re-trusted at the import boundary.  Drops the
         entry (ownership moves with the bytes)."""
+        self._check_lost(uid)
         ent = self._entries.get(uid)
         assert ent is not None, f"uid {uid} not spilled"
         n = ent.n_pages
@@ -679,6 +906,7 @@ class TieredKVStore:
         out["resident_spilled_sessions"] = len(self._entries)
         out["host_pages_used"] = self._host_used
         out["nvme_pages_used"] = self._nvme_used
+        out["nvme_offline"] = int(self.nvme_offline)
         from deepspeed_tpu.telemetry.metrics import metrics as _metrics
         _metrics.sync_counters(
             "dstpu_kv_tiering_", self.counters,

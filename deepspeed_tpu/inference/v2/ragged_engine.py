@@ -652,7 +652,9 @@ class RaggedInferenceEngineV2:
                 prefetch=kv_tiering.prefetch,
                 verify=kv_tiering.verify,
                 checksum=kv_tiering.checksum,
-                max_reread=kv_tiering.max_reread)
+                max_reread=kv_tiering.max_reread,
+                nvme_fail_threshold=kv_tiering.nvme_fail_threshold,
+                probe_every=kv_tiering.probe_every)
         # -- cross-request prefix cache over the paged pool --
         from deepspeed_tpu.inference.config import PrefixCacheConfig
 

@@ -31,6 +31,26 @@ Instrumented sites (the stable surface; grep for ``faults.hook``):
                           digest check — fires again per re-read, so
                           ``count`` models transient (heals) vs
                           persistent (quarantine + re-prefill) flips
+``kv.write``              per tiered-KV NVMe write submit (spill
+                          write-back AND the degraded-mode recovery
+                          probe) — ``io_error`` here models a failing
+                          device; ``count`` spans the probe window so
+                          the tier stays offline until the device heals
+``router.dispatch``       once per router->replica dispatch
+                          (serving/router.py ``_send``) — ``io_error``
+                          kills the dispatch (replica-death path),
+                          ``slow`` delays it
+``replica.step``          once per engine step op ON the replica thread
+                          (serving/replica_set.py) — ``crash``/
+                          ``io_error`` is a replica dying mid-decode
+``replica.hang``          alongside ``replica.step`` — honors ``hang``
+                          /``slow`` directives by sleeping ``param``
+                          seconds on the replica thread (a wedged
+                          decode; the serving watchdog's quarry)
+``http.flush``            before each SSE token-event flush
+                          (serving/server.py) — ``io_error`` breaks the
+                          client socket mid-stream (cancel must
+                          propagate), ``slow`` delays the flush
 ``comm.all_reduce``       once per EAGER all_reduce call (comm/comm.py)
 ``comm.all_gather``       once per eager all_gather call
 ``comm.broadcast``        once per eager broadcast call
@@ -61,6 +81,15 @@ Fault kinds:
               corruption is transient (the re-read heals), a large
               ``count`` or :func:`flip_bit_in_file` models persistent
               on-media corruption
+``io_error``  raise ``OSError(EIO)`` — a HARD device error
+              (vs ``oserror``'s transient): the degraded-mode tiering
+              trip counter and the serving death paths key on it
+``hang``      serving sites: sleep ``param`` seconds at the site (a
+              wedged op — finite so tests terminate, but longer than
+              any watchdog deadline under test)
+``slow``      serving sites: sleep ``param`` seconds (a straggling
+              replica/socket — the hedging threshold's quarry, below
+              the watchdog deadline)
 
 A fault is scheduled with ``inject(site, kind, ...)`` (or the named
 helpers); ``after`` skips that many firings first and ``count`` bounds
@@ -115,7 +144,8 @@ class FaultInjector:
     # -- scheduling -------------------------------------------------------
 
     KINDS = ("oserror", "torn", "crash", "sigterm",
-             "corrupt", "straggle", "drop", "bitflip")
+             "corrupt", "straggle", "drop", "bitflip",
+             "io_error", "hang", "slow")
 
     def inject(self, site: str, kind: str, count: int = 1, after: int = 0,
                fraction: float = 0.5,
@@ -166,6 +196,28 @@ class FaultInjector:
         watchdog deadline fires."""
         return self.inject(site, "drop", count=count, after=after)
 
+    def io_error(self, site: str, after: int = 0,
+                 count: int = 1) -> "FaultInjector":
+        """Raise a hard ``OSError(EIO)`` at ``site`` — a failing device
+        (vs :meth:`transient_oserror`): repeated firings trip the
+        tiered-KV degraded mode / the serving replica-death path."""
+        return self.inject(site, "io_error", count=count, after=after)
+
+    def hang(self, site: str, seconds: float = 2.0, after: int = 0,
+             count: int = 1) -> "FaultInjector":
+        """Wedge ``site`` for ``seconds`` (sleep on the site's thread) —
+        long enough to blow any watchdog deadline under test, finite so
+        the abandoned thread eventually exits."""
+        return self.inject(site, "hang", count=count, after=after,
+                           param=seconds)
+
+    def slow(self, site: str, seconds: float = 0.1, after: int = 0,
+             count: int = 1) -> "FaultInjector":
+        """Delay ``site`` by ``seconds`` — a straggler (below the
+        watchdog deadline; the hedging threshold's quarry)."""
+        return self.inject(site, "slow", count=count, after=after,
+                           param=seconds)
+
     def bitflip(self, site: str, bits: int = 1, after: int = 0,
                 count: int = 1) -> "FaultInjector":
         """Flip ``bits`` random bit(s) of the buffer a swap read site
@@ -181,9 +233,13 @@ class FaultInjector:
         """Parse the subprocess wire format: ``;``-separated faults,
         each a whitespace/comma-separated list of ``key=value`` tokens —
         ``site=`` and ``kind=`` required; ``after=``, ``count=``,
-        ``param=`` optional.  Example::
+        ``param=`` optional.  For ``hang``/``slow`` faults ``param`` is
+        the wedge/delay duration in SECONDS (defaulted to 2.0 when
+        omitted — hang specs without a duration must still outlast any
+        reasonable watchdog deadline).  Examples::
 
             site=comm.all_reduce kind=corrupt after=1 param=0.5
+            site=replica.hang kind=hang after=3 param=2.5
 
         (``resilience/distributed.py install_injector_from_env`` plumbs
         this through ``DSTPU_FAULT_SPEC`` into worker processes.)"""
@@ -199,10 +255,13 @@ class FaultInjector:
                 kv[k] = v
             assert "site" in kv and "kind" in kv, \
                 f"fault spec needs site= and kind=: {part!r}"
+            param = float(kv["param"]) if "param" in kv else None
+            if param is None and kv["kind"] in ("hang", "slow"):
+                param = 2.0       # seconds — the serving-site default
             inj.inject(kv["site"], kv["kind"],
                        count=int(kv.get("count", 1)),
                        after=int(kv.get("after", 0)),
-                       param=(float(kv["param"]) if "param" in kv else None))
+                       param=param)
         return inj
 
     # -- firing -----------------------------------------------------------
@@ -220,6 +279,10 @@ class FaultInjector:
             if f.kind == "oserror":
                 raise OSError(f"[fault-injection] transient I/O error at "
                               f"{site} (call {n})")
+            if f.kind == "io_error":
+                import errno as _errno
+                raise OSError(_errno.EIO, f"[fault-injection] hard I/O "
+                              f"error at {site} (call {n})")
             if f.kind == "crash":
                 raise SimulatedCrash(f"[fault-injection] crash at {site} "
                                      f"(call {n})")
@@ -260,7 +323,9 @@ def hook(site: str, **ctx: Any) -> Optional[Tuple[str, float]]:
     fraction)`` for write sites; ``("corrupt", fraction)``,
     ``("straggle", delay_s)`` or ``("drop", 0)`` for comm sites;
     ``("bitflip", bits)`` for swap read sites (honored via
-    :func:`apply_bitflip`)."""
+    :func:`apply_bitflip`); ``("hang", seconds)`` / ``("slow",
+    seconds)`` for serving sites (honored by sleeping on the site's
+    thread)."""
     if _ACTIVE is None:
         return None
     return _ACTIVE.fire(site, **ctx)

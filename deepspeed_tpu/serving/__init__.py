@@ -20,6 +20,13 @@ threads interleave but results stay bit-identical).
   for prefix-cache affinity (prompt-prefix chain hash), and an
   admission controller (priorities, per-replica queue caps, SLO
   burn-rate shed/defer, request deadlines) with loud typed rejections.
+- Fault tolerance: a per-replica liveness watchdog
+  (``ReplicaSet(watchdog_s=...)`` bounds every feed/step join;
+  :class:`~.replica_set.ReplicaHangError` on a wedge) under a typed
+  health breaker (:class:`~.router.BreakerConfig` —
+  healthy/suspect/dead/probation, hedged re-dispatch of unadmitted
+  requests off suspects, revival probes through the ReplicaSet
+  factory, flap freeze).
 - :class:`~.server.FrontDoorServer` — the network front door: a
   stdlib-asyncio HTTP/1.1 + SSE endpoint over the router with token
   streaming at harvest granularity, client-disconnect cancellation
@@ -29,18 +36,21 @@ threads interleave but results stay bit-identical).
   closed-loop load generator measuring TTFT/TPOT at the socket.
 """
 from deepspeed_tpu.serving.replica_set import (EngineReplicaHandle,
+                                               ReplicaHangError,
                                                ReplicaSet)
-from deepspeed_tpu.serving.router import (DeadlineRejection,
+from deepspeed_tpu.serving.router import (BreakerConfig,
+                                          DeadlineRejection,
                                           DrainingRejection,
                                           NeverSchedulableRejection,
                                           POLICIES, QueueFullRejection,
-                                          Router, RouterRejection,
-                                          ShedRejection)
+                                          REPLICA_STATES, Router,
+                                          RouterRejection, ShedRejection)
 
 __all__ = ["ReplicaSet", "EngineReplicaHandle", "Router", "POLICIES",
            "RouterRejection", "QueueFullRejection", "ShedRejection",
            "NeverSchedulableRejection", "DeadlineRejection",
-           "DrainingRejection", "FrontDoorServer"]
+           "DrainingRejection", "FrontDoorServer", "BreakerConfig",
+           "ReplicaHangError", "REPLICA_STATES"]
 
 
 def __getattr__(name):

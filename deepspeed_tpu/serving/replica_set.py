@@ -29,15 +29,43 @@ after the engine's pipelined decode carry and the NVMe moment stream):
 the window bounds router run-ahead per replica and serializes
 ``on_done`` folds onto whichever thread joins (the router's), so
 router state never needs a lock.
+
+**Liveness watchdog** (``watchdog_s > 0``): the production replica
+failure is not an exception but a WEDGE — a stuck decode, a deadlocked
+AIO wait — which today's exception-driven death path never sees (a
+hung op's future simply never resolves, so ``join_all`` blocks
+forever).  Armed, every window join waits at most ``watchdog_s`` for
+the op to make progress (the ``comm/watchdog.py`` heartbeat pattern
+applied per replica): on expiry the wedged worker thread is abandoned
+(``shutdown(wait=False)`` — a blocked engine step cannot be
+interrupted from Python), the window's unresolved ops are written off,
+and the join raises :class:`ReplicaHangError`, which the router's
+existing death path turns into a breaker trip + re-dispatch.
+``last_progress`` is stamped at every successful join — the router's
+suspect detection (soft deadline, hedging) reads it without touching
+the replica thread.  Disarmed (the default) the waiter is a plain
+``Future.result`` — zero overhead on the fault-free path.
 """
 from __future__ import annotations
 
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from deepspeed_tpu.resilience import faults
+from deepspeed_tpu.telemetry import trace
+from deepspeed_tpu.telemetry.metrics import metrics as _metrics
 from deepspeed_tpu.utils.async_stage import BoundedAsyncStage, StageTimers
 
-__all__ = ["EngineReplicaHandle", "ReplicaSet"]
+__all__ = ["EngineReplicaHandle", "ReplicaHangError", "ReplicaSet"]
+
+
+class ReplicaHangError(RuntimeError):
+    """A replica op blew the liveness watchdog deadline: the worker
+    thread is wedged (stuck decode / AIO / feed deadlock) and has been
+    abandoned.  The router treats this exactly like a replica death —
+    flight dump, breaker trip, outstanding work re-dispatched."""
 
 
 def _future_result(fut: Future) -> Any:
@@ -57,7 +85,8 @@ class EngineReplicaHandle:
     """
 
     def __init__(self, idx: int, engine: Any, feed_depth: int = 2,
-                 name: Optional[str] = None) -> None:
+                 name: Optional[str] = None, watchdog_s: float = 0.0,
+                 clock: Callable[[], float] = time.perf_counter) -> None:
         self.idx = int(idx)
         self.name = name if name is not None else f"r{idx}"
         self.engine = engine
@@ -66,13 +95,61 @@ class EngineReplicaHandle:
         # replica label so export_text() distinguishes replicas)
         engine.set_replica(self.name)
         self.alive = True
+        self.watchdog_s = float(watchdog_s)
+        self.hung = False
+        self._clock = clock
+        self.last_progress = clock()
         self._timers = StageTimers(cat="serving")
         self._window = BoundedAsyncStage(
-            waiter=_future_result, depth=feed_depth,
+            waiter=self._wd_result, depth=feed_depth,
             timers=self._timers, name=f"replica_feed_{self.name}")
         self._pool: Optional[ThreadPoolExecutor] = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"dstpu-replica-{self.name}")
         self._seq = 0
+
+    def _wd_result(self, fut: Future) -> Any:
+        """Window waiter: joins one replica op, stamping
+        ``last_progress`` (the router's suspect detector reads it).
+        With the watchdog armed the join waits at most ``watchdog_s``;
+        expiry abandons the wedged worker and raises
+        :class:`ReplicaHangError` on the caller's thread — the
+        router's — so the breaker trips synchronously."""
+        if self.watchdog_s <= 0:
+            res = fut.result()
+        else:
+            try:
+                res = fut.result(timeout=self.watchdog_s)
+            except _FutureTimeout:
+                self._abandon_wedged()
+                raise ReplicaHangError(
+                    f"replica {self.name} made no feed/step progress "
+                    f"within the {self.watchdog_s:.1f}s watchdog deadline "
+                    f"(wedged decode/AIO/feed thread) — worker abandoned, "
+                    f"replica tripped dead") from None
+        self.last_progress = self._clock()
+        return res
+
+    def _abandon_wedged(self) -> None:
+        """The worker thread is wedged inside an op and cannot be
+        interrupted from Python: abandon the pool, write off every
+        unresolved window op (their futures may never complete), and
+        mark the handle dead so no further submits land."""
+        self.hung = True
+        self.alive = False
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+        dropped = self._window.abandon()
+        if trace.enabled:
+            trace.event("replica_hang", cat="resilience",
+                        replica=self.name, deadline_s=self.watchdog_s,
+                        abandoned_ops=int(dropped))
+        if _metrics.enabled:
+            _metrics.counter(
+                "dstpu_watchdog_timeouts_total",
+                "Watchdog deadline fires (collective + replica feed)",
+                labels=("what",)).labels(
+                    what=f"replica_{self.name}").inc()
 
     # -- protocol surface (what fakes implement) -------------------------
 
@@ -114,9 +191,19 @@ class EngineReplicaHandle:
         streaming front ends.  The router also accepts the legacy
         2-tuple payload (test fakes)."""
         eng = self.engine
+        name = self.name
 
         def op() -> Tuple[List[Tuple[int, Any]], Dict[str, Any],
                           List[Tuple[int, List[int], int, bool]]]:
+            # chaos sites, ON the replica thread: replica.step raises
+            # (crash/io_error -> the exception death path), replica.hang
+            # honors hang/slow directives by wedging right here — the
+            # future never resolves until the sleep ends, which is
+            # exactly the failure the watchdog exists to bound
+            faults.hook("replica.step", replica=name)
+            d = faults.hook("replica.hang", replica=name)
+            if d is not None and d[0] in ("hang", "slow"):
+                time.sleep(float(d[1]))
             if eng.has_work():
                 eng.step()
             deltas = eng.stream_deltas()   # before get_outputs: a
@@ -176,12 +263,17 @@ class EngineReplicaHandle:
     def close(self) -> None:
         """Idempotent teardown: abandon the window (faults already
         handled or about to be surfaced elsewhere), stop the worker,
-        release engine resources."""
+        release engine resources.  A HUNG handle's window is written
+        off instead of drained — its futures may never resolve and
+        joining them would wedge the caller too."""
         self.alive = False
-        try:
-            self._window.drain()
-        except Exception:
-            pass                  # a dead replica's pending ops may raise
+        if self.hung:
+            self._window.abandon()
+        else:
+            try:
+                self._window.drain()
+            except Exception:
+                pass              # a dead replica's pending ops may raise
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
@@ -228,12 +320,13 @@ class ReplicaSet:
     """
 
     def __init__(self, factory: Callable[[int], Any], n: int,
-                 feed_depth: int = 2) -> None:
+                 feed_depth: int = 2, watchdog_s: float = 0.0) -> None:
         if n < 1:
             raise ValueError("ReplicaSet needs n >= 1 replicas")
         # retained: grow() builds new replicas from the same factory
         self._factory = factory
         self._feed_depth = int(feed_depth)
+        self._watchdog_s = float(watchdog_s)
         self._next_idx = 0
         self.handles: List[EngineReplicaHandle] = []
         try:
@@ -247,7 +340,8 @@ class ReplicaSet:
         i = self._next_idx
         self._next_idx += 1       # indices (and names) are never reused
         h = EngineReplicaHandle(i, self._factory(i),
-                                feed_depth=self._feed_depth)
+                                feed_depth=self._feed_depth,
+                                watchdog_s=self._watchdog_s)
         self.handles.append(h)
         return h
 

@@ -39,8 +39,28 @@ Streaming front ends set ``collect_events = True`` and drain
 ``poll_events()`` after each ``pump``/``join`` round: ``("tokens",
 rid, fresh)`` at harvest granularity (de-duplicated across
 replica-death re-routes via cumulative totals), ``("finish", rid,
-tokens)``, ``("deadline_expired", rid, None)`` and ``("cancelled",
-rid, None)``.
+tokens)``, ``("deadline_expired", rid, None)``, ``("cancelled", rid,
+None)`` and ``("replica_death", rid, None)`` — the last for a SAMPLED
+request that lost its replica mid-stream: replaying it elsewhere would
+contradict tokens the client already holds, so it fails loudly with a
+typed error instead.
+
+**Health breaker** (:class:`BreakerConfig`): a typed per-replica state
+machine ``healthy -> suspect -> dead -> probation`` layered over the
+exception/hang death path.  ``suspect`` is the soft deadline — no
+feed/step progress for ``suspect_after_s`` while holding work: the
+replica takes no new assignments and its not-yet-admitted requests are
+HEDGED onto a healthy peer (first admit wins, the loser is cancelled —
+safe exactly because an unadmitted request has emitted nothing).
+``dead`` is the breaker trip (exception or watchdog hang): flight
+dump, outstanding work re-dispatched.  With ``revive=True`` the router
+then probes for revival through the ReplicaSet's retained factory
+(``grow``): the replacement enters ``probation`` — throttled to
+``probation_inflight`` requests until ``probation_successes`` finish
+clean, only then re-admitted to the full policy set.  A flapping
+lineage (replacements dying in probation ``max_trips`` times in a row)
+FREEZES revival: serving continues on the survivors, a human looks at
+the flight records.
 """
 from __future__ import annotations
 
@@ -51,11 +71,52 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from deepspeed_tpu.inference.prefix_cache import ROOT_HASH, _chunk_hash
+from deepspeed_tpu.resilience import faults
 from deepspeed_tpu.telemetry import flight, trace
+from deepspeed_tpu.telemetry.metrics import metrics as _metrics
 
-__all__ = ["Router", "POLICIES", "RouterRejection", "QueueFullRejection",
-           "ShedRejection", "NeverSchedulableRejection",
-           "DeadlineRejection", "DrainingRejection"]
+__all__ = ["Router", "POLICIES", "BreakerConfig", "RouterRejection",
+           "QueueFullRejection", "ShedRejection",
+           "NeverSchedulableRejection", "DeadlineRejection",
+           "DrainingRejection", "REPLICA_STATES"]
+
+REPLICA_STATES = ("healthy", "suspect", "dead", "probation")
+
+
+class BreakerConfig:
+    """Knobs for the replica health breaker (all optional).
+
+    ``suspect_after_s``
+        soft liveness deadline: a replica holding work whose
+        ``last_progress`` is older than this turns ``suspect`` (no new
+        assignments; unadmitted requests hedge to a peer).  0 disables
+        suspect detection — the breaker then only reacts to hard
+        trips.
+    ``hedge``
+        hedge a suspect's not-yet-admitted requests onto a healthy
+        peer (exactly-once by construction: first admit wins, the
+        loser is cancelled before it can emit).
+    ``revive``
+        after a trip, probe for revival by growing a replacement from
+        the ReplicaSet's retained factory.  Off by default: spinning
+        up an engine is expensive and only correct when the underlying
+        fault is transient.
+    ``probation_successes`` / ``probation_inflight``
+        a revived replica must finish this many requests clean before
+        re-admission, carrying at most ``probation_inflight`` at once.
+    ``max_trips``
+        consecutive probation deaths before revival FREEZES.
+    """
+
+    def __init__(self, suspect_after_s: float = 0.0, hedge: bool = True,
+                 revive: bool = False, probation_successes: int = 2,
+                 probation_inflight: int = 1, max_trips: int = 3) -> None:
+        self.suspect_after_s = float(suspect_after_s)
+        self.hedge = bool(hedge)
+        self.revive = bool(revive)
+        self.probation_successes = max(1, int(probation_successes))
+        self.probation_inflight = max(1, int(probation_inflight))
+        self.max_trips = max(1, int(max_trips))
 
 
 class RouterRejection(RuntimeError):
@@ -179,8 +240,12 @@ class Router:
                  slo: Any = None, queue_cap: Optional[int] = None,
                  burn_defer: float = 1.0, burn_shed: float = 2.0,
                  protected_priority: int = 1, sticky: bool = True,
-                 clock: Callable[[], float] = time.perf_counter) -> None:
+                 clock: Callable[[], float] = time.perf_counter,
+                 breaker: Optional[BreakerConfig] = None) -> None:
         self.handles: List[Any] = list(replicas)
+        # retained when replicas is a ReplicaSet: the revival probe
+        # grows replacements from its factory
+        self._replica_set = replicas if hasattr(replicas, "grow") else None
         if not self.handles:
             raise ValueError("Router needs at least one replica")
         if callable(policy):
@@ -229,8 +294,21 @@ class Router:
             "cancelled": 0, "affinity_hits": 0,
             "rerouted": 0, "finished": 0, "replica_deaths": 0,
             "replicas_added": 0, "replicas_retired": 0,
-            "sessions_handed_off": 0}
+            "sessions_handed_off": 0, "hedges": 0, "hedge_won": 0,
+            "hedge_lost": 0, "failed_replica_death": 0, "revived": 0}
         self._routed: Dict[str, int] = {h.name: 0 for h in self.handles}
+        # -- health breaker state -----------------------------------------
+        self.breaker = breaker
+        self._health: Dict[str, str] = {}
+        for h in self.handles:
+            self._set_state(h.name, "healthy", announce=False)
+        # rid -> {"orig", "target", "pending": {names with a put in
+        # flight}} while a hedge is unresolved (first admit wins)
+        self._hedges: Dict[int, Dict[str, Any]] = {}
+        self._probation_left: Dict[str, int] = {}
+        self._revive_pending = 0      # tripped replicas awaiting a probe
+        self._revive_failures = 0     # consecutive probation deaths
+        self.frozen = False           # revival frozen after max_trips
 
     # -- admission -------------------------------------------------------
 
@@ -238,10 +316,136 @@ class Router:
         return [h for h in self.handles if h.alive]
 
     def _dispatchable(self) -> List[Any]:
-        """Alive AND not mid-retire: a retiring replica finishes its
-        in-flight work but takes no new assignments."""
+        """Alive, not mid-retire, not suspect: a retiring replica
+        finishes its in-flight work but takes no new assignments; a
+        suspect one proves liveness before getting more."""
         return [h for h in self.handles
-                if h.alive and h.name not in self._retiring]
+                if h.alive and h.name not in self._retiring
+                and self._health.get(h.name) != "suspect"]
+
+    # -- health breaker ---------------------------------------------------
+
+    def _by_name(self, name: str) -> Optional[Any]:
+        return next((h for h in self.handles if h.name == name), None)
+
+    def _set_state(self, name: str, state: str, why: str = "",
+                   announce: bool = True) -> None:
+        """One typed transition of the replica state machine: updates
+        the ``dstpu_replica_state`` gauge (one-hot over states) and
+        lands a ``cat="resilience"`` trace instant per decision."""
+        prev = self._health.get(name)
+        self._health[name] = state
+        if _metrics.enabled:
+            g = _metrics.gauge("dstpu_replica_state",
+                               "Replica breaker state (one-hot)",
+                               labels=("replica", "state"))
+            for s in REPLICA_STATES:
+                g.labels(replica=name, state=s).set(
+                    1.0 if s == state else 0.0)
+        if announce and trace.enabled and state != prev:
+            event = {"healthy": "breaker_readmit",
+                     "suspect": "breaker_suspect",
+                     "dead": "breaker_trip",
+                     "probation": "breaker_probation"}[state]
+            trace.event(event, cat="resilience", replica=name,
+                        prev=prev or "", why=why)
+
+    def _check_health(self) -> None:
+        """Soft-deadline sweep (runs each ``pump``): a replica holding
+        work with stale ``last_progress`` turns suspect — excluded
+        from dispatch, its unadmitted requests hedged; progress seen
+        again re-admits it (the hedges resolve by admit race)."""
+        cfg = self.breaker
+        if cfg is None or cfg.suspect_after_s <= 0:
+            return
+        now = self.clock()
+        for h in list(self.handles):
+            if not h.alive:
+                continue
+            last = getattr(h, "last_progress", None)
+            if last is None:
+                continue          # handle without progress stamps
+            state = self._health.get(h.name)
+            stale = (self._assigned.get(h.name)
+                     and now - last >= cfg.suspect_after_s)
+            if stale and state == "healthy":
+                self._set_state(h.name, "suspect",
+                                why=f"no progress for "
+                                    f"{now - last:.3f}s")
+                if cfg.hedge:
+                    self._hedge_from(h)
+            elif not stale and state == "suspect":
+                self._set_state(h.name, "healthy", why="progress resumed")
+
+    def _hedge_from(self, h: Any) -> None:
+        """Re-dispatch the suspect's not-yet-admitted requests on a
+        healthy peer.  Exactly-once by construction: an unadmitted
+        request has emitted nothing, and of the two in-flight puts the
+        FIRST admit fold wins — the loser is cancelled at its own fold
+        before the engine ever streams from it."""
+        for rid in sorted(self._assigned.get(h.name, ())):
+            req = self._live.get(rid)
+            if (req is None or req.uid is not None or req.cancelled
+                    or rid in self._hedges):
+                continue
+            cands = [x for x in self._dispatchable()
+                     if x.name != h.name
+                     and self._health.get(x.name) == "healthy"
+                     and len(self._assigned[x.name]) < self.queue_cap]
+            if not cands:
+                return
+            # policy directly — the affinity pin points at the suspect
+            target = self._policy(self, cands, req)
+            self._hedges[rid] = {"orig": h.name, "target": target.name,
+                                 "pending": {h.name, target.name}}
+            self.stats_counters["hedges"] += 1
+            if _metrics.enabled:
+                _metrics.counter("dstpu_hedge_total",
+                                 "Hedged dispatches by outcome",
+                                 labels=("outcome",)).labels(
+                                     outcome="fired").inc()
+            trace.event("hedge_fired", cat="resilience", replica=h.name,
+                        target=target.name, rid=rid)
+            self._send(req, target)
+
+    def _maybe_revive(self) -> None:
+        """Revival probe: grow one replacement per tripped replica from
+        the ReplicaSet's retained factory and admit it ON PROBATION.
+        Frozen (flapping lineage) or factory failure stops probing —
+        survivors keep serving."""
+        cfg = self.breaker
+        if (cfg is None or not cfg.revive or self.frozen
+                or self._revive_pending <= 0 or self._replica_set is None):
+            return
+        while self._revive_pending > 0 and not self.frozen:
+            self._revive_pending -= 1
+            trace.event("breaker_probe", cat="resilience",
+                        replica="(new)", why="revival probe")
+            try:
+                (nh,) = self._replica_set.grow(1)
+            except Exception as e:
+                self._revive_failures += 1
+                trace.event("breaker_probe_failed", cat="resilience",
+                            replica="(new)", why=str(e)[:200])
+                if self._revive_failures >= cfg.max_trips:
+                    self._freeze("factory failed "
+                                 f"{self._revive_failures}x")
+                return
+            self.add_replica(nh)
+            self._set_state(nh.name, "probation", why="revival probe")
+            self._probation_left[nh.name] = cfg.probation_successes
+            self.stats_counters["revived"] += 1
+
+    def _freeze(self, why: str) -> None:
+        if self.frozen:
+            return
+        self.frozen = True
+        trace.event("breaker_freeze", cat="resilience", replica="(all)",
+                    why=why)
+        flight.dump_on_fault(
+            "breaker_freeze",
+            RuntimeError(f"replica revival frozen: {why}"),
+            extra={"revive_failures": self._revive_failures})
 
     def _max_burn(self) -> float:
         if self.slo is None:
@@ -347,6 +551,10 @@ class Router:
         with trace.span("router_dispatch", cat="serving", rid=req.rid,
                         replica=name):
             try:
+                d = faults.hook("router.dispatch", replica=name,
+                                rid=req.rid)
+                if d is not None and d[0] in ("hang", "slow"):
+                    time.sleep(float(d[1]))
                 h.put_async(req.prompt, req.kw, req.accept_t,
                             on_done=lambda uid, r=req, hh=h:
                             self._on_admit(hh, r, uid))
@@ -354,13 +562,51 @@ class Router:
                 self._on_replica_death(h, e)
 
     def _on_admit(self, h: Any, req: _RouterReq, uid: int) -> None:
-        req.uid = int(uid)
+        uid = int(uid)
+        hedge = self._hedges.get(req.rid)
+        if hedge is not None:
+            # one of (up to) two racing puts for this rid just admitted;
+            # the FIRST live fold wins, every other fold cancels its
+            # copy and strips its claim — the engine that lost never
+            # streams a token, so exactly-once holds by construction
+            hedge["pending"].discard(h.name)
+            if not hedge["pending"]:
+                self._hedges.pop(req.rid, None)
+            claimed = req.rid in self._assigned.get(h.name, set())
+            won = (h.alive and claimed and req.uid is None
+                   and req.rid in self._live and not req.cancelled)
+            if not won:
+                if h.alive:
+                    self._cancel_on_replica(h, uid)
+                if claimed:
+                    self._assigned[h.name].discard(req.rid)
+                    self._tokens[h.name] -= req.cost
+                return
+            req.uid = uid
+            req.replica = h.name
+            self._uid_rid[(h.name, uid)] = req.rid
+            outcome = ("won" if h.name == hedge["target"] else "lost")
+            self.stats_counters[f"hedge_{outcome}"] += 1
+            if _metrics.enabled:
+                _metrics.counter("dstpu_hedge_total",
+                                 "Hedged dispatches by outcome",
+                                 labels=("outcome",)).labels(
+                                     outcome=outcome).inc()
+            trace.event(f"hedge_{outcome}", cat="resilience",
+                        replica=h.name, rid=req.rid)
+            return
+        if not h.alive:
+            # a dead replica's feed window folding during close: the
+            # request was already requeued — registering the stale uid
+            # would resurrect a mapping the death path just severed
+            return
+        req.uid = uid
         if req.cancelled:
             # cancelled between dispatch and the admit fold: the uid
             # only just became known — propagate the teardown now
-            self._cancel_on_replica(h, int(uid))
+            self._cancel_on_replica(h, uid)
             return
-        self._uid_rid[(h.name, int(uid))] = req.rid
+        self._uid_rid[(h.name, uid)] = req.rid
 
     def _emit(self, kind: str, rid: int, payload: Any) -> None:
         if self.collect_events:
@@ -370,7 +616,9 @@ class Router:
         """Drain the event stream (``collect_events`` must be on):
         ``("tokens", rid, np.ndarray)`` / ``("finish", rid, tokens)``
         / ``("deadline_expired", rid, None)`` / ``("cancelled", rid,
-        None)``, in arrival order on the pump thread."""
+        None)`` / ``("replica_death", rid, None)`` (a sampled request
+        that lost its replica mid-stream — not replayable), in arrival
+        order on the pump thread."""
         out, self._events = self._events, []
         return out
 
@@ -406,7 +654,7 @@ class Router:
                 # waiting behind it)
                 break
             cands = [h for h in self._dispatchable()
-                     if len(self._assigned[h.name]) < self.queue_cap]
+                     if len(self._assigned[h.name]) < self._cap(h.name)]
             if not cands:
                 break
             heapq.heappop(self._heap)
@@ -414,14 +662,24 @@ class Router:
             sent += 1
         return sent
 
+    def _cap(self, name: str) -> int:
+        """Per-replica assignment cap: the queue cap, throttled to
+        ``probation_inflight`` while the replica proves itself."""
+        if (self.breaker is not None
+                and self._health.get(name) == "probation"):
+            return min(self.queue_cap, self.breaker.probation_inflight)
+        return self.queue_cap
+
     # -- the serving loop ------------------------------------------------
 
     def pump(self) -> None:
-        """One router round: dispatch what admission allows, then
-        submit one step op per busy replica.  Results fold back on
-        THIS thread at window joins (back-pressure, ``join_all`` or
-        ``drain``)."""
+        """One router round: health sweep + revival probe, dispatch
+        what admission allows, then submit one step op per busy
+        replica.  Results fold back on THIS thread at window joins
+        (back-pressure, ``join_all`` or ``drain``)."""
         with trace.span("router_pump", cat="serving"):
+            self._check_health()
+            self._maybe_revive()
             self._dispatch_queued()
             for h in list(self.handles):
                 if not h.alive:
@@ -467,6 +725,14 @@ class Router:
             trace.event("router_finish", cat="serving", rid=rid,
                         replica=h.name, e2e_ms=round(e2e_ms, 3),
                         attempts=req.attempts)
+            if self._health.get(h.name) == "probation":
+                left = self._probation_left.get(h.name, 1) - 1
+                self._probation_left[h.name] = left
+                if left <= 0:
+                    self._probation_left.pop(h.name, None)
+                    self._revive_failures = 0
+                    self._set_state(h.name, "healthy",
+                                    why="probation complete")
 
     # -- cancellation + graceful drain -----------------------------------
 
@@ -493,7 +759,13 @@ class Router:
             return False
         req.cancelled = True
         self.stats_counters["cancelled"] += 1
-        if req.replica is not None:
+        if rid in self._hedges and req.uid is None:
+            # two puts still race for this rid and neither has
+            # admitted: each admit fold sees req.cancelled (or the
+            # popped _live entry), cancels its copy and strips its own
+            # claim — stripping here too would double-count
+            pass
+        elif req.replica is not None:
             self._assigned.get(req.replica, set()).discard(rid)
             if req.replica in self._tokens:
                 self._tokens[req.replica] -= req.cost
@@ -524,13 +796,26 @@ class Router:
                     outstanding=len(self._live), queued=len(self._heap))
 
     def _on_replica_death(self, h: Any, exc: BaseException) -> None:
-        """Failure isolation: mark the replica dead, dump the flight
-        ring (the postmortem rides the span schema), and re-route its
-        whole queue — full-prompt resubmission preserves greedy
-        bit-parity on the surviving replicas."""
-        if not h.alive:
+        """Failure isolation — the breaker trip: mark the replica
+        dead, dump the flight ring (the postmortem rides the span
+        schema), and re-route its whole queue.  Full-prompt
+        resubmission preserves greedy bit-parity on the survivors (the
+        per-request ``streamed`` watermark suppresses the replayed
+        prefix); a SAMPLED request that already streamed cannot be
+        replayed without contradicting tokens the client holds, so it
+        fails loudly as a ``replica_death`` event instead.  With
+        revival enabled the trip also schedules a probe; a probation
+        replica dying counts toward the flap freeze."""
+        # dedup on the ROUTER's state machine, not the handle flag: a
+        # hung handle marks itself dead (`_abandon_wedged`) before the
+        # ReplicaHangError ever reaches us, and its orphans still need
+        # requeueing exactly once
+        if self._health.get(h.name) == "dead":
             return
         h.alive = False
+        was_probation = self._health.get(h.name) == "probation"
+        self._set_state(h.name, "dead", why=type(exc).__name__)
+        self._probation_left.pop(h.name, None)
         self.stats_counters["replica_deaths"] += 1
         orphans = sorted(self._assigned[h.name])
         flight.dump_on_fault(
@@ -545,8 +830,33 @@ class Router:
             if req.uid is not None:
                 self._uid_rid.pop((h.name, req.uid), None)
             self._tokens[h.name] -= req.cost
+            if req.replica is not None and req.replica != h.name:
+                continue          # the hedge's other copy owns it
+            hedge = self._hedges.get(rid)
+            if hedge is not None:
+                other = (hedge["target"] if h.name == hedge["orig"]
+                         else hedge["orig"])
+                oh = self._by_name(other)
+                if (oh is not None and oh.alive
+                        and other in hedge["pending"]):
+                    # the surviving copy's admit fold will claim it
+                    hedge["pending"].discard(h.name)
+                    req.uid = None
+                    req.replica = None
+                    continue
+                self._hedges.pop(rid, None)
             req.uid = None
             req.replica = None
+            if req.streamed > 0 and req.kw.get("do_sample"):
+                # replaying a sampled request elsewhere would emit a
+                # DIFFERENT continuation after tokens the client
+                # already consumed — fail it loudly and exactly once
+                self._live.pop(rid, None)
+                self.stats_counters["failed_replica_death"] += 1
+                self._emit("replica_death", rid, None)
+                trace.event("router_replica_death_fail", cat="serving",
+                            rid=rid, streamed=int(req.streamed))
+                continue
             self.stats_counters["rerouted"] += 1
             heapq.heappush(self._heap, (-req.priority, self._hseq, req))
             self._hseq += 1
@@ -558,7 +868,17 @@ class Router:
             h.close()
         except Exception:
             pass
-        if not self._alive() and (self._heap or self._live):
+        cfg = self.breaker
+        if cfg is not None and cfg.revive:
+            if was_probation:
+                self._revive_failures += 1
+                if self._revive_failures >= cfg.max_trips:
+                    self._freeze(f"replacement died in probation "
+                                 f"{self._revive_failures}x in a row")
+            if not self.frozen:
+                self._revive_pending += 1
+        if (not self._alive() and (self._heap or self._live)
+                and self._revive_pending <= 0):
             raise RouterRejection(
                 "all replicas dead with requests outstanding") from exc
 
@@ -585,6 +905,7 @@ class Router:
         self._assigned[handle.name] = set()
         self._tokens[handle.name] = 0
         self._routed[handle.name] = 0
+        self._set_state(handle.name, "healthy", announce=False)
         self.stats_counters["replicas_added"] += 1
         trace.event("router_grow", cat="control", replica=handle.name,
                     warmed_chains=warmed, replicas=len(self.handles))
@@ -714,6 +1035,8 @@ class Router:
         self._assigned.pop(name, None)
         self._tokens.pop(name, None)
         self._pressure.pop(name, None)
+        self._health.pop(name, None)
+        self._probation_left.pop(name, None)
         self.stats_counters["replicas_retired"] += 1
         self.stats_counters["sessions_handed_off"] += handed_off
         trace.event("router_shrink", cat="control", replica=name,
@@ -776,9 +1099,12 @@ class Router:
                                "queued": len(self._heap),
                                "in_flight": len(self._live)}
         out.update(self.stats_counters)
+        if self.breaker is not None:
+            out["frozen"] = self.frozen
         for h in self.handles:
             out[f"routed_{h.name}"] = self._routed[h.name]
             out[f"outstanding_tokens_{h.name}"] = self._tokens[h.name]
+            out[f"state_{h.name}"] = self._health.get(h.name, "healthy")
             if h.name in self._pressure:
                 out[f"pressure_{h.name}"] = self._pressure[h.name]
         if self.slo is not None:

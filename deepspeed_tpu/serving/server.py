@@ -53,6 +53,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.resilience import faults
 from deepspeed_tpu.serving import protocol as proto
 from deepspeed_tpu.telemetry import flight, trace
 from deepspeed_tpu.telemetry import metrics as _metrics_mod
@@ -360,6 +361,12 @@ class FrontDoorServer:
         elif kind == "deadline_expired":
             del self._streams[rid]
             self._post(st, ("expired", None))
+        elif kind == "replica_death":
+            # sampled request whose replica died mid-stream: replaying
+            # on a survivor would contradict already-emitted tokens, so
+            # the router failed it — surface a typed SSE error
+            del self._streams[rid]
+            self._post(st, ("replica_death", None))
         elif kind == "cancelled":
             # cancels originate from the handler; it stopped reading
             self._streams.pop(rid, None)
@@ -599,6 +606,9 @@ class FrontDoorServer:
                     ntok += len(toks)
                     conn["tokens_streamed"] = ntok
                     try:
+                        d = faults.hook("http.flush", conn=cid, rid=rid)
+                        if d is not None and d[0] in ("hang", "slow"):
+                            await asyncio.sleep(float(d[1]))
                         writer.write(proto.sse_event(
                             "tokens", {"tokens": toks}))
                         await writer.drain()
@@ -611,6 +621,9 @@ class FrontDoorServer:
                     break
                 elif kind == "expired":
                     abort = "deadline_expired"
+                    break
+                elif kind == "replica_death":
+                    abort = "replica_death"
                     break
                 else:             # ("error", reason) — pump failure
                     abort = str(payload)
@@ -690,6 +703,9 @@ class FrontDoorServer:
                 elif kind == "expired":
                     abort = "deadline_expired"
                     break
+                elif kind == "replica_death":
+                    abort = "replica_death"
+                    break
                 else:
                     abort = str(payload)
                     break
@@ -702,8 +718,9 @@ class FrontDoorServer:
                 return 0
             code = 429 if abort == "deadline_expired" else 500
             writer.write(proto.json_response(
-                code, {"error": ("DeadlineRejection"
-                                 if code == 429 else "internal_error"),
+                code, {"error": ("DeadlineRejection" if code == 429
+                                 else abort if abort == "replica_death"
+                                 else "internal_error"),
                        "detail": abort}))
             await writer.drain()
             return code

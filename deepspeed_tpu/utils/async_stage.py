@@ -224,6 +224,25 @@ class BoundedAsyncStage:
             raise first_err
         return results
 
+    def discard(self, key: Any) -> bool:
+        """Drop exactly one keyed op without joining (no waiter, no
+        ``on_done``) — the per-key form of :meth:`abandon`, for folding
+        a single entry whose backing device just failed (degraded-mode
+        tiering) while the rest of the window stays live.  Returns
+        whether the key was in flight."""
+        return self._inflight.pop(key, None) is not None
+
+    def abandon(self) -> int:
+        """Discard every in-flight op WITHOUT joining (no waiter, no
+        ``on_done``) — the hung-replica escape hatch: after a watchdog
+        abandons a wedged worker thread its futures may never resolve,
+        so joining them would re-wedge the caller.  Returns the number
+        of ops dropped.  Only correct when the ops' side effects are
+        already written off (the replica is dead)."""
+        n = len(self._inflight)
+        self._inflight.clear()
+        return n
+
     # -- internals -------------------------------------------------------
 
     def _join_oldest(self) -> None:

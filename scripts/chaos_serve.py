@@ -1,0 +1,455 @@
+#!/usr/bin/env python
+"""Chaos serve: seeded fault campaign against the live serving stack.
+
+The serving analogue of ``scripts/chaos_train.py``: a real
+:class:`FrontDoorServer` over real engines is driven through the HTTP
+client (``deepspeed_tpu/serving/client.py``) while seeded faults fire
+at the serving chaos sites (``deepspeed_tpu/resilience/faults.py``):
+
+``replica.hang``
+    a wedged replica thread (finite sleep past the watchdog deadline)
+    — the liveness watchdog must abandon it, the breaker must trip,
+    and every orphaned stream must finish on the survivor;
+``replica.step``
+    a hard ``OSError(EIO)`` mid-decode — the exception death path:
+    greedy streams replay with watermark dedup (exactly-once tokens on
+    the wire);
+``router.dispatch``
+    the same hard error at the dispatch site (a put into a dying
+    feed window);
+``kv.read_page`` / ``kv.write``
+    NVMe bit rot and a failing NVMe device under the tiered KV store —
+    quarantine + re-prefill, then degraded-mode host-only tiering,
+    with greedy outputs bit-identical to an unfaulted run;
+``http.flush``
+    a broken client socket mid-stream — cancel propagation must return
+    every pool page.
+
+Every pass asserts REQUEST CONSERVATION (nothing lost, nothing
+duplicated), SURVIVOR BIT-PARITY (greedy outputs identical to an
+in-process unfaulted reference), CLEAN AUDITS (page refcounts, tier
+accounting), and — for every fault class that kills something — a
+PARSEABLE flight-recorder dump.  Exits nonzero on any violation.
+
+Deterministic: the fault schedule is a pure function of ``--seed``.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/chaos_serve.py
+    JAX_PLATFORMS=cpu python scripts/chaos_serve.py --seed 3
+"""
+import argparse
+import asyncio
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def check_flight(prefix: str, since: float = 0.0) -> int:
+    """Assert the newest flight dump whose reason starts with
+    ``prefix`` exists, parses, and was written after ``since`` (so one
+    pass cannot ride an earlier pass's dump); returns the number of
+    failures."""
+    from deepspeed_tpu.telemetry import flight
+
+    d = flight.flight_dir()
+    cands = sorted((f for f in os.listdir(d)
+                    if f.startswith(f"flight_{prefix}")
+                    and f.endswith(".jsonl")
+                    and os.path.getmtime(os.path.join(d, f)) >= since),
+                   key=lambda f: os.path.getmtime(os.path.join(d, f)))
+    if not cands:
+        print(f"FAIL: no flight dump with reason prefix {prefix!r} "
+              f"in {d}")
+        return 1
+    path = os.path.join(d, cands[-1])
+    try:
+        header, events = flight.read_flight_record(path)
+    except (ValueError, OSError) as e:
+        print(f"FAIL: flight dump {path} unreadable/truncated: {e}")
+        return 1
+    if not str(header.get("reason", "")).startswith(prefix):
+        print(f"FAIL: flight dump reason {header.get('reason')!r} "
+              f"does not start with {prefix!r}")
+        return 1
+    print(f"  flight: {header['reason']} dump parseable "
+          f"({len(events)} events, {os.path.basename(path)})")
+    return 0
+
+
+def quiesce(router, timeout: float = 30.0) -> bool:
+    """Wait for the router to go idle (no queued or in-flight work)."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if router.outstanding == 0 and router.queued == 0:
+            time.sleep(0.1)
+            if router.outstanding == 0:
+                return True
+        time.sleep(0.02)
+    return False
+
+
+def reference(make_engine, prompts, max_new):
+    """In-process unfaulted greedy run: ``{i: prompt+generated}`` —
+    the bit-parity bar every chaos pass must clear."""
+    eng = make_engine()
+    order = {eng.put_request(q, max_new_tokens=max_new): i
+             for i, q in enumerate(prompts)}
+    outs = {}
+    while eng.has_work():
+        eng.step()
+        for uid, toks in eng.get_outputs():
+            outs[order[uid]] = toks
+    eng.sync()
+    for uid, toks in eng.get_outputs():
+        outs[order[uid]] = toks
+    eng.close()
+    return outs
+
+
+def parity_failures(label, gen, prompts, ref) -> int:
+    """Exactly-once conservation: every stream completed, the final
+    tokens match the reference bit-for-bit, and the STREAMED tokens are
+    exactly the generated suffix — a replayed or dropped token after a
+    mid-stream re-dispatch shows up here."""
+    bad = []
+    for r in gen.results:
+        i = r["i"]
+        if r["error"] or r["final"] is None:
+            bad.append((i, r["error"]))
+        elif (not np.array_equal(r["final"], ref[i])
+              or r["tokens"] != list(ref[i][len(prompts[i]):])):
+            bad.append((i, "parity"))
+    if bad:
+        print(f"FAIL [{label}]: streams lost/duplicated/diverged: {bad}")
+        return 1
+    return 0
+
+
+def serve_pass(label, make_engine, prompts, max_new, ref, inject,
+               seed, n_replicas=2, watchdog_s=0.0,
+               expect_deaths=1, flight_prefix="replica_death_"):
+    """One campaign pass: start a live front door over ``n_replicas``
+    fresh engines, fire ``inject`` while the load generator drives all
+    prompts, and assert conservation + parity + the death accounting +
+    a parseable flight dump."""
+    from deepspeed_tpu.resilience.faults import FaultInjector
+    from deepspeed_tpu.serving import (BreakerConfig, FrontDoorServer,
+                                       ReplicaSet, Router)
+    from deepspeed_tpu.serving.client import LoadGenerator
+
+    failures = 0
+    t_pass0 = time.time()
+    rs = ReplicaSet(make_engine, n_replicas, watchdog_s=watchdog_s)
+    router = Router(rs, policy="least_tokens", breaker=BreakerConfig())
+    srv = FrontDoorServer(router, port=0).start()
+    try:
+        with FaultInjector(seed=seed) as inj:
+            inject(inj)
+            gen = LoadGenerator(
+                srv.host, srv.port,
+                lambda i: {"prompt": prompts[i].tolist(),
+                           "max_new_tokens": max_new},
+                requests=len(prompts), concurrency=len(prompts))
+            summary = gen.run()
+            if not inj.fired:
+                print(f"FAIL [{label}]: fault never fired — the pass "
+                      "ran vacuously")
+                failures += 1
+        if summary["completed"] != len(prompts):
+            print(f"FAIL [{label}]: only {summary['completed']} of "
+                  f"{len(prompts)} streams completed "
+                  f"({summary['errors']})")
+            failures += 1
+        failures += parity_failures(label, gen, prompts, ref)
+        quiesce(router)
+        st = router.stats()
+        if st["replica_deaths"] != expect_deaths:
+            print(f"FAIL [{label}]: expected {expect_deaths} replica "
+                  f"death(s), saw {st['replica_deaths']}")
+            failures += 1
+        if st["replicas_alive"] != n_replicas - expect_deaths:
+            print(f"FAIL [{label}]: {st['replicas_alive']} replicas "
+                  f"alive, expected {n_replicas - expect_deaths}")
+            failures += 1
+        try:
+            for h in rs.handles:
+                if h.alive:
+                    h.engine.audit_kv_sharing()
+        except AssertionError as e:
+            print(f"FAIL [{label}]: refcount audit broke after the "
+                  f"fault: {e}")
+            failures += 1
+        failures += check_flight(flight_prefix, since=t_pass0)
+        if not failures:
+            print(f"  {label}: {summary['completed']} streams exact, "
+                  f"deaths={st['replica_deaths']} "
+                  f"rerouted={st['rerouted']} "
+                  f"survivors={st['replicas_alive']}")
+        return failures, rs, router
+    finally:
+        srv.close()
+        rs.close()
+
+
+def hang_pass(make_engine, prompts, max_new, ref, seed,
+              watchdog_s) -> int:
+    """A replica wedges mid-step: the watchdog must abandon it within
+    its deadline and the breaker death path must finish every stream
+    on the survivor."""
+    failures, rs, router = serve_pass(
+        "hang", make_engine, prompts, max_new, ref,
+        lambda inj: inj.hang("replica.hang", seconds=watchdog_s + 6.0,
+                             after=6, count=1),
+        seed, watchdog_s=watchdog_s)
+    if not any(h.hung for h in rs.handles):
+        print("FAIL [hang]: no handle was abandoned by the watchdog "
+              "(the death came from somewhere else)")
+        failures += 1
+    return failures
+
+
+def tier_pass(make_tiered, make_plain, prompts, max_new, seed,
+              only=None) -> int:
+    """NVMe bit rot (``kv.read_page``) then a failing device
+    (``kv.write``): quarantine + re-prefill, then a degraded-mode trip
+    to host-only tiering — all behind a live socket, all bit-exact."""
+    from deepspeed_tpu.resilience.faults import FaultInjector
+    from deepspeed_tpu.serving import FrontDoorServer, ReplicaSet, Router
+    from deepspeed_tpu.serving.client import LoadGenerator
+
+    failures = 0
+    ref = reference(make_plain, prompts, max_new)
+
+    scenarios = [
+        ("kv-bitrot",
+         lambda inj: inj.bitflip("kv.read_page", bits=1, after=2,
+                                 count=10_000),
+         "kv_restore_error",
+         lambda st: (st["quarantined"] >= 1, "no payload was ever "
+                     f"quarantined ({st})")),
+        ("kv-degraded",
+         lambda inj: inj.io_error("kv.write", after=1, count=10_000),
+         "tier_degraded",
+         lambda st: (st["tier_degraded"] >= 1 and st["nvme_offline"],
+                     f"the tier never tripped offline ({st})")),
+    ]
+    for label, inject, flight_prefix, tier_check in scenarios:
+        if only is not None and label not in only:
+            continue
+        t_pass0 = time.time()
+        rs = ReplicaSet(make_tiered, 1)
+        router = Router(rs, policy="least_tokens")
+        srv = FrontDoorServer(router, port=0).start()
+        try:
+            with FaultInjector(seed=seed) as inj:
+                inject(inj)
+                gen = LoadGenerator(
+                    srv.host, srv.port,
+                    lambda i: {"prompt": prompts[i].tolist(),
+                               "max_new_tokens": max_new},
+                    requests=len(prompts), concurrency=len(prompts))
+                summary = gen.run()
+                if not inj.fired:
+                    print(f"FAIL [{label}]: fault never fired — the "
+                          "pass ran vacuously")
+                    failures += 1
+            if summary["completed"] != len(prompts):
+                print(f"FAIL [{label}]: only {summary['completed']} of "
+                      f"{len(prompts)} streams completed "
+                      f"({summary['errors']})")
+                failures += 1
+            failures += parity_failures(label, gen, prompts, ref)
+            quiesce(router)
+            eng = rs.handles[0].engine
+            st = eng.tiering.stats()
+            ok, why = tier_check(st)
+            if not ok:
+                print(f"FAIL [{label}]: {why}")
+                failures += 1
+            try:
+                eng.audit_kv_sharing()
+                eng.tiering.audit()
+            except AssertionError as e:
+                print(f"FAIL [{label}]: audit broke after the fault: "
+                      f"{e}")
+                failures += 1
+            failures += check_flight(flight_prefix, since=t_pass0)
+            if not (failures):
+                print(f"  {label}: {summary['completed']} streams "
+                      f"exact, quarantined={st['quarantined']} "
+                      f"degraded={st['tier_degraded']} "
+                      f"spills={st['spills']}")
+        finally:
+            srv.close()
+            rs.close()
+    return failures
+
+
+def flush_pass(make_engine, prompt, seed) -> int:
+    """A broken client socket mid-stream (``http.flush`` raises on the
+    write): the server must treat it as a disconnect — cancel at the
+    engine, return every pool page, keep the refcount audit clean."""
+    from deepspeed_tpu.resilience.faults import FaultInjector
+    from deepspeed_tpu.serving import FrontDoorServer, ReplicaSet, Router
+    from deepspeed_tpu.serving.client import sse_generate
+
+    failures = 0
+    rs = ReplicaSet(make_engine, 1)
+    router = Router(rs, policy="rr")
+    srv = FrontDoorServer(router, port=0).start()
+    try:
+        free0 = rs.handles[0].engine.allocator.free_pages
+        with FaultInjector(seed=seed) as inj:
+            inj.io_error("http.flush", after=1, count=1)
+            res = asyncio.run(sse_generate(
+                srv.host, srv.port,
+                {"prompt": prompt.tolist(), "max_new_tokens": 64}))
+            if not inj.fired:
+                print("FAIL [flush]: fault never fired — the pass ran "
+                      "vacuously")
+                failures += 1
+        if res["final"] is not None:
+            print(f"FAIL [flush]: the broken stream still delivered a "
+                  f"final payload ({res['error']})")
+            failures += 1
+        reclaimed = False
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30.0:
+            if (rs.handles[0].engine.cancels >= 1
+                    and router.outstanding == 0
+                    and rs.handles[0].engine.allocator.free_pages
+                    == free0):
+                reclaimed = True
+                break
+            time.sleep(0.05)
+        if not reclaimed:
+            print(f"FAIL [flush]: write fault did not reclaim the pool "
+                  f"(cancels={rs.handles[0].engine.cancels}, free="
+                  f"{rs.handles[0].engine.allocator.free_pages} vs "
+                  f"{free0})")
+            failures += 1
+        try:
+            rs.handles[0].engine.audit_kv_sharing()
+        except AssertionError as e:
+            print(f"FAIL [flush]: refcount audit broke after the "
+                  f"write-fault cancel: {e}")
+            failures += 1
+        if not failures:
+            print(f"  flush: write fault after {res['events']} events "
+                  f"-> cancel propagated, {free0} pool pages back")
+    finally:
+        srv.close()
+        rs.close()
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--tokens", type=int, default=16,
+                    help="max_new_tokens for the replica-fault passes")
+    ap.add_argument("--watchdog", type=float, default=8.0,
+                    help="liveness deadline for the hang pass (must "
+                         "comfortably exceed one cold-compile step)")
+    args = ap.parse_args(argv)
+
+    # isolate this campaign's flight dumps so the parseability
+    # assertions cannot be satisfied by stale files from an earlier run
+    os.environ["DSTPU_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="chaos_serve_flight_")
+    from deepspeed_tpu import telemetry
+    telemetry.configure(enabled=True)
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import RaggedInferenceEngineV2
+    from deepspeed_tpu.models.llama import LlamaForCausalLM, get_config
+    from deepspeed_tpu.resilience import faults as faults_mod
+
+    cfg = get_config("tinyllama", vocab_size=64, hidden_size=32,
+                     intermediate_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, num_key_value_heads=2,
+                     max_position_embeddings=128, dtype=jnp.float32,
+                     param_dtype=jnp.float32, scan_layers=True,
+                     remat=False, use_flash_attention=False)
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(args.seed),
+                                 np.zeros((1, 8), np.int32))
+    rng = np.random.default_rng(args.seed)
+    prompts = [rng.integers(1, 64, size=(n,), dtype=np.int32)
+               for n in (9, 14, 7, 11)]
+    tier_prompts = [rng.integers(1, 64, size=(n,), dtype=np.int32)
+                    for n in (12, 20, 9, 16, 14, 18)]
+    nvme_dir = tempfile.mkdtemp(prefix="chaos_serve_nvme_")
+
+    def make_engine(i=0):
+        return RaggedInferenceEngineV2(
+            LlamaForCausalLM(cfg), params=params, max_seqs=2,
+            max_seq_len=128, prefill_chunk=16, decode_block_size=4,
+            harvest_interval=3, rng=jax.random.PRNGKey(args.seed))
+
+    def make_tiered(i=0):
+        return RaggedInferenceEngineV2(
+            LlamaForCausalLM(cfg), params=params, max_seqs=4,
+            max_seq_len=128, prefill_chunk=16, page_size=16,
+            num_pages=9, decode_block_size=4, kv_reserve="on_demand",
+            kv_tiering={"host_pages": 2, "nvme_pages": 16,
+                        "nvme_dir": nvme_dir, "nvme_fail_threshold": 2},
+            rng=jax.random.PRNGKey(args.seed))
+
+    def make_plain(i=0):
+        return RaggedInferenceEngineV2(
+            LlamaForCausalLM(cfg), params=params, max_seqs=4,
+            max_seq_len=128, prefill_chunk=16, page_size=16,
+            num_pages=9, decode_block_size=4, kv_reserve="on_demand",
+            rng=jax.random.PRNGKey(args.seed))
+
+    ref = reference(make_engine, prompts, args.tokens)
+    failures = 0
+
+    print("replica hang pass (watchdog + breaker):")
+    failures += hang_pass(make_engine, prompts, args.tokens, ref,
+                          args.seed, args.watchdog)
+
+    print("mid-decode death pass (replica.step EIO):")
+    failures += serve_pass(
+        "step-eio", make_engine, prompts, args.tokens, ref,
+        lambda inj: inj.io_error("replica.step", after=6, count=1),
+        args.seed + 1)[0]
+
+    print("dispatch death pass (router.dispatch EIO):")
+    failures += serve_pass(
+        "dispatch-eio", make_engine, prompts, args.tokens, ref,
+        lambda inj: inj.io_error("router.dispatch", after=1, count=1),
+        args.seed + 2)[0]
+
+    print("tiered KV fault pass (kv.read_page bit rot, kv.write EIO):")
+    failures += tier_pass(make_tiered, make_plain, tier_prompts, 40,
+                          args.seed + 3)
+
+    print("client write fault pass (http.flush EIO):")
+    failures += flush_pass(make_engine, prompts[1], args.seed + 4)
+
+    if faults_mod.active() is not None:
+        print("FAIL: a FaultInjector leaked past its context")
+        failures += 1
+    if failures:
+        print(f"FAIL: {failures} chaos-serve check(s) failed")
+        return 1
+    print("OK: hang, step-EIO, dispatch-EIO, kv bit rot, degraded "
+          "tier, and write-fault passes all conserved requests with "
+          "bit-exact survivors, clean audits, parseable flight dumps")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

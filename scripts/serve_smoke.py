@@ -74,6 +74,17 @@ greedy output diverges from a static single engine, if the shrink
 handed off nothing (vacuous), or if any restored page on the survivor
 skipped digest verification.
 
+With ``--chaos`` it additionally gates serving fault tolerance: a
+compact seeded campaign over a live socket — one wedged replica (the
+liveness watchdog abandons it and the breaker re-dispatches its
+streams), one mid-decode replica death (greedy streams replay with
+exactly-once tokens on the wire), one failing NVMe device (the KV tier
+trips offline and serving degrades host-only) — exiting NONZERO if any
+request is lost or duplicated, any survivor output diverges from an
+unfaulted reference, any page/tier audit breaks, any fault class
+leaves no parseable flight dump, or the watchdog-armed no-fault wall
+clock regresses more than 5% over disarmed (min of 3 runs each).
+
 With ``--autotune`` it additionally gates the closed-loop control
 plane: a deliberately mis-tuned engine (harvest_interval=1,
 async_depth=1) served by the online controller must converge back to
@@ -91,6 +102,7 @@ already-tuned config (min of 3 runs each).
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --trace
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --metrics
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --elastic
+    JAX_PLATFORMS=cpu python scripts/serve_smoke.py --chaos
     JAX_PLATFORMS=cpu python scripts/serve_smoke.py --autotune
 """
 import argparse
@@ -156,6 +168,13 @@ def main() -> int:
                         "static single engine, parked sessions handed "
                         "off in spill format and restored "
                         "digest-verified on the survivor)")
+    p.add_argument("--chaos", action="store_true",
+                   help="also gate serving fault tolerance (one "
+                        "replica hang, one mid-stream death, one NVMe "
+                        "fault over a live socket: request "
+                        "conservation, greedy bit-parity on the "
+                        "survivor, clean audits, parseable flight "
+                        "dumps, <=5%% watchdog-armed wall overhead)")
     p.add_argument("--autotune", action="store_true",
                    help="also gate the closed-loop control plane "
                         "(mis-tuned engine converges to hand-tuned "
@@ -1323,6 +1342,96 @@ def main() -> int:
               f"freezes={ctl.counts['freezes']} "
               f"tok/s={conv_tps:.1f} vs hand {hand_tps:.1f} "
               f"overhead={a_ovh * 100:+.1f}%")
+    if args.chaos:
+        # ---- serving fault tolerance: chaos over a live socket -------
+        # the compact campaign: one replica hang (watchdog + breaker),
+        # one mid-stream death (exception path), one NVMe device
+        # failure (degraded tiering) — each over a real socket through
+        # the chaos harness's pass assertions (conservation, survivor
+        # bit-parity, clean audits, parseable flight dumps) — plus the
+        # watchdog-armed no-fault overhead bound
+        import tempfile as _tempfile
+        import time as _time
+
+        import chaos_serve
+        from deepspeed_tpu.serving import ReplicaSet as CReplicaSet
+        from deepspeed_tpu.serving import Router as CRouter
+
+        os.environ["DSTPU_FLIGHT_DIR"] = _tempfile.mkdtemp(
+            prefix="smoke_chaos_flight_")
+        c_prompts = [rng.integers(1, 64, size=(n,), dtype=np.int32)
+                     for n in (9, 14, 7, 11)]
+        c_new = min(args.tokens, 16)
+        c_wd = 8.0
+
+        def c_engine(i=0):
+            return RaggedInferenceEngineV2(
+                LlamaForCausalLM(cfg), params=params, max_seqs=2,
+                max_seq_len=max(max_len, 128), prefill_chunk=16,
+                decode_block_size=4, harvest_interval=3,
+                rng=jax.random.PRNGKey(args.seed))
+
+        c_nvme = _tempfile.mkdtemp(prefix="smoke_chaos_nvme_")
+        c_tier_kw = dict(max_seqs=4, max_seq_len=max(max_len, 128),
+                         prefill_chunk=16, page_size=16, num_pages=9,
+                         decode_block_size=4, kv_reserve="on_demand")
+
+        def c_tiered(i=0):
+            return RaggedInferenceEngineV2(
+                LlamaForCausalLM(cfg), params=params,
+                kv_tiering={"host_pages": 2, "nvme_pages": 16,
+                            "nvme_dir": c_nvme,
+                            "nvme_fail_threshold": 2},
+                rng=jax.random.PRNGKey(args.seed), **c_tier_kw)
+
+        def c_plain(i=0):
+            return RaggedInferenceEngineV2(
+                LlamaForCausalLM(cfg), params=params,
+                rng=jax.random.PRNGKey(args.seed), **c_tier_kw)
+
+        c_tier_prompts = [rng.integers(1, 64, size=(n,), dtype=np.int32)
+                          for n in (12, 20, 9, 16, 14, 18)]
+        c_ref = chaos_serve.reference(c_engine, c_prompts, c_new)
+        c_fail = chaos_serve.hang_pass(c_engine, c_prompts, c_new,
+                                       c_ref, args.seed, c_wd)
+        c_fail += chaos_serve.serve_pass(
+            "step-eio", c_engine, c_prompts, c_new, c_ref,
+            lambda inj: inj.io_error("replica.step", after=6, count=1),
+            args.seed + 1)[0]
+        c_fail += chaos_serve.tier_pass(c_tiered, c_plain,
+                                        c_tier_prompts, 40,
+                                        args.seed + 3,
+                                        only={"kv-degraded"})
+        failures += c_fail
+
+        # ---- overhead: watchdog armed vs disarmed, no faults ---------
+        # warm drain first so the timed drain measures serving, not
+        # compile; off/on samples interleave against machine noise
+        def c_timed(wd):
+            crs = CReplicaSet(c_engine, 1, watchdog_s=wd)
+            crouter = CRouter(crs, policy="rr")
+            crouter.submit(c_prompts[0], max_new_tokens=4)
+            crouter.drain()
+            t0 = _time.perf_counter()
+            for q in c_prompts:
+                crouter.submit(q, max_new_tokens=c_new)
+            crouter.drain()
+            w = _time.perf_counter() - t0
+            crs.close()
+            return w
+
+        c_off, c_on = float("inf"), float("inf")
+        for _ in range(3):
+            c_off = min(c_off, c_timed(0.0))
+            c_on = min(c_on, c_timed(c_wd))
+        c_ovh = (c_on - c_off) / c_off
+        if c_ovh > 0.05:
+            print(f"FAIL [chaos]: watchdog-armed wall regressed "
+                  f"{c_ovh * 100:.1f}% (off={c_off:.3f}s "
+                  f"on={c_on:.3f}s)")
+            failures += 1
+        print(f"[chaos] passes_failed={c_fail} watchdog_overhead="
+              f"{c_ovh * 100:+.1f}%")
     if failures:
         print(f"serve_smoke: {failures} failure(s)")
         return 1
@@ -1347,6 +1456,9 @@ def main() -> int:
            "drain endings" if args.frontdoor else "") +
           (", elastic grow+shrink conserved every request bit-exactly "
            "with digest-verified handoff" if args.elastic else "") +
+          (", chaos campaign conserved every request through hang/"
+           "death/NVMe faults within watchdog overhead budget"
+           if args.chaos else "") +
           (", control plane converged the mis-tuned engine with clean "
            "guard and attributable decisions" if args.autotune else ""))
     return 0

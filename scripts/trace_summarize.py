@@ -60,6 +60,17 @@ _HTTP_INSTANTS = {"http_accept", "http_close", "http_cancel",
                   "http_drained"}
 _HTTP_SPANS = {"http_parse", "http_admit", "http_stream", "http_flush"}
 
+# the serving fault-tolerance vocabulary (router health breaker,
+# replica watchdog, degraded-mode tiering) — instants only; breaker /
+# watchdog events name their replica, tier events name their tier, so
+# a failure's timeline reconstructs from the trace alone
+_RESILIENCE_REPLICA = {"breaker_trip", "breaker_suspect",
+                       "breaker_probation", "breaker_readmit",
+                       "breaker_freeze", "breaker_probe",
+                       "breaker_probe_failed", "hedge_fired",
+                       "hedge_won", "hedge_lost", "replica_hang"}
+_RESILIENCE_TIER = {"tier_degraded", "tier_rearmed"}
+
 
 def load_events(path: str) -> Tuple[List[Dict[str, Any]], str]:
     """Load events from either format; returns ``(events, kind)`` where
@@ -237,6 +248,25 @@ def validate_events(events: List[Dict[str, Any]]) -> List[str]:
                 if not isinstance(conn, int) or isinstance(conn, bool):
                     problems.append(f"event {i}: {name} missing int "
                                     f"'conn' arg (got {conn!r})")
+        if ev.get("cat") == "resilience":
+            # fault-tolerance events are a postmortem contract: every
+            # breaker transition / hedge / hang names its replica and
+            # every tier trip names its tier
+            name = ev.get("name")
+            if name not in _RESILIENCE_REPLICA | _RESILIENCE_TIER:
+                problems.append(f"event {i}: unknown resilience event "
+                                f"{name!r}")
+            elif ph != "i":
+                problems.append(f"event {i}: resilience event {name!r} "
+                                f"must be an instant")
+            else:
+                a = ev.get("args", {})
+                key = ("tier" if name in _RESILIENCE_TIER
+                       else "replica")
+                val = a.get(key)
+                if not isinstance(val, str) or not val:
+                    problems.append(f"event {i}: {name} missing str "
+                                    f"'{key}' arg (got {val!r})")
         if len(problems) >= 20:
             problems.append("... (stopping after 20 problems)")
             break

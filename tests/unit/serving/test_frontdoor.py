@@ -34,10 +34,13 @@ import jax.numpy as jnp                                     # noqa: E402
 from deepspeed_tpu.inference.v2 import RaggedInferenceEngineV2  # noqa: E402
 from deepspeed_tpu.models.llama import (LlamaForCausalLM,       # noqa: E402
                                         get_config)
+from deepspeed_tpu.resilience import faults                      # noqa: E402
 from deepspeed_tpu.serving import (FrontDoorServer, ReplicaSet,  # noqa: E402
                                    Router)
 from deepspeed_tpu.serving.client import LoadGenerator, sse_generate  # noqa: E402
-from deepspeed_tpu.telemetry import tracer as tracer_mod         # noqa: E402
+from deepspeed_tpu.telemetry import (flight,                     # noqa: E402
+                                     read_flight_record,
+                                     tracer as tracer_mod)
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..",
                                 ".."))
@@ -280,6 +283,99 @@ class TestStreaming:
             assert summary["completed"] == 64, summary
             assert summary["tokens_streamed"] == 64 * 6
             assert router.outstanding == 0
+        finally:
+            srv.close()
+            rs.close()
+
+
+class TestReplicaDeathMidStream:
+    def test_greedy_streams_survive_death_no_duplicates(
+            self, params, tmp_path, monkeypatch):
+        # a replica dies mid-serve: greedy requests re-dispatch on the
+        # survivor and replay behind the stream watermark — every
+        # client sees the exact generated suffix once, bit-identical
+        # to the no-fault reference
+        monkeypatch.setenv("DSTPU_FLIGHT_DIR", str(tmp_path))
+        prompts = _prompts((7, 9, 6, 8), seed=41)
+        ref = _reference(params, prompts, max_new=16)
+        rs = ReplicaSet(lambda i: _engine(params), 2)
+        router = Router(rs, policy="least_tokens")
+        srv = FrontDoorServer(router, port=0).start()
+        try:
+            with faults.FaultInjector(seed=11) as inj:
+                inj.io_error("replica.step", after=6, count=1)
+                gen = LoadGenerator(
+                    srv.host, srv.port,
+                    lambda i: {"prompt": prompts[i].tolist(),
+                               "max_new_tokens": 16},
+                    requests=4, concurrency=4)
+                summary = gen.run()
+            assert [f[0] for f in inj.fired] == ["replica.step"]
+            assert summary["completed"] == 4, summary
+            for r in gen.results:
+                i = r["i"]
+                np.testing.assert_array_equal(
+                    r["final"], ref[i],
+                    err_msg=f"request {i} diverged across the death")
+                assert r["tokens"] == list(ref[i][len(prompts[i]):]), (
+                    f"request {i}: mid-stream re-dispatch replayed or "
+                    f"dropped streamed tokens")
+            s = router.stats()
+            assert s["replica_deaths"] == 1 and s["replicas_alive"] == 1
+            header, _events = read_flight_record(flight.last_dump_path())
+            assert header["reason"].startswith("replica_death_")
+        finally:
+            srv.close()
+            rs.close()
+
+    def test_sampled_stream_gets_typed_replica_death_error(
+            self, params, tmp_path, monkeypatch):
+        # a SAMPLED stream cannot be replayed after tokens are on the
+        # wire (a survivor would sample a different continuation): the
+        # death must surface as a typed SSE error, never a silent
+        # truncation or a contradictory resumption
+        monkeypatch.setenv("DSTPU_FLIGHT_DIR", str(tmp_path))
+        rs = ReplicaSet(lambda i: _engine(params), 2)
+        router = Router(rs, policy="least_tokens")
+        srv = FrontDoorServer(router, port=0).start()
+        try:
+            async def scenario():
+                from deepspeed_tpu.serving import protocol as proto
+                body = json.dumps({"prompt": [1, 2, 3, 4, 5, 6, 7],
+                                   "max_new_tokens": 64,
+                                   "do_sample": True,
+                                   "temperature": 0.9}).encode()
+                ra, wa = await asyncio.open_connection(srv.host,
+                                                       srv.port)
+                wa.write((f"POST /v1/generate HTTP/1.1\r\nHost: x\r\n"
+                          f"Content-Length: {len(body)}\r\n\r\n"
+                          ).encode() + body)
+                await wa.drain()
+                head = await ra.readuntil(b"\r\n\r\n")
+                assert b"200" in head.split(b"\r\n")[0]
+                parser = proto.SSEParser()
+                events = []
+                while not any(e == "tokens" for e, _ in events):
+                    events += parser.feed(await ra.read(4096))
+                # tokens are on the wire: NOW the replica dies
+                with faults.FaultInjector(seed=13) as inj:
+                    inj.io_error("replica.step", count=1)
+                    while not any(e == "error" for e, _ in events):
+                        chunk = await ra.read(4096)
+                        assert chunk, ("stream closed without the "
+                                       "typed error event")
+                        events += parser.feed(chunk)
+                    assert inj.fired, "fault never fired"
+                wa.close()
+                return events
+
+            events = asyncio.run(scenario())
+            err = next(json.loads(d) for e, d in events if e == "error")
+            assert err["error"] == "replica_death"
+            assert not any(e == "done" for e, _ in events)
+            s = router.stats()
+            assert s["failed_replica_death"] == 1, s
+            assert s["replica_deaths"] == 1
         finally:
             srv.close()
             rs.close()

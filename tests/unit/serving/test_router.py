@@ -9,14 +9,16 @@ against a single engine, and the zero-new-compilations guard with two
 live replicas.
 """
 import itertools
+import time
 
 import numpy as np
 import pytest
 
-from deepspeed_tpu.serving import (DeadlineRejection, DrainingRejection,
+from deepspeed_tpu.serving import (BreakerConfig, DeadlineRejection,
+                                   DrainingRejection, EngineReplicaHandle,
                                    NeverSchedulableRejection,
-                                   QueueFullRejection, Router,
-                                   RouterRejection, ShedRejection)
+                                   QueueFullRejection, ReplicaHangError,
+                                   Router, RouterRejection, ShedRejection)
 from deepspeed_tpu.telemetry import SLOSet, flight, read_flight_record
 
 
@@ -480,6 +482,262 @@ class TestDraining:
         # in-flight work still dispatches and finishes
         assert rid in _drain(router)
         assert router.stats()["finished"] == 1
+
+
+class LaggyFakeReplica(StreamingFakeReplica):
+    """Admit folds deferred to ``join_all`` — the real handle's
+    window-join timing — plus the ``last_progress`` stamp the breaker's
+    suspect detector reads.  Progress advances only at joins, so a
+    replica that is never joined goes stale on the fake clock while its
+    puts sit unadmitted (exactly the state hedging targets)."""
+
+    def __init__(self, *a, clock=None, **kw):
+        super().__init__(*a, **kw)
+        self._clock = clock if clock is not None else (lambda: 0.0)
+        self.last_progress = self._clock()
+        self._pending = []
+
+    def put_async(self, prompt, kw, accept_t, on_done):
+        uid = next(self._uid)
+        self._pending.append((uid, np.asarray(prompt, np.int32), on_done))
+
+    def join_all(self):
+        pending, self._pending = self._pending, []
+        for uid, p, on_done in pending:
+            self.puts.append((uid, p.tolist()))
+            self.admitted.append([uid, self.latency, p])
+            self.generated[uid] = []
+            if on_done is not None:
+                on_done(uid)
+        self.last_progress = self._clock()
+
+
+class FakeSet(list):
+    """ReplicaSet-protocol wrapper over fakes: the router retains any
+    ``replicas`` object carrying a ``grow`` op and probes it for
+    revival replacements after a breaker trip."""
+
+    def __init__(self, fakes, factory=None):
+        super().__init__(fakes)
+        self._factory = factory
+        self._next = len(fakes)
+
+    def grow(self, n=1):
+        made = []
+        for _ in range(int(n)):
+            if self._factory is None:
+                raise RuntimeError("replica factory unavailable")
+            h = self._factory(self._next)
+            self._next += 1
+            self.append(h)
+            made.append(h)
+        return made
+
+
+class _WedgeEngine:
+    """Minimal engine-protocol stub whose step WEDGES (finite sleep —
+    executor threads are non-daemon) far past the watchdog deadline:
+    the future never resolves in time, which is the hang failure mode
+    the exception death path cannot see."""
+
+    max_seqs = 2
+    page_size = 4
+    num_pages = 8
+
+    def __init__(self, wedge_s=0.8):
+        self.wedge_s = float(wedge_s)
+        self.waiting = []
+        self.allocator = type("A", (), {"free_pages": 7})()
+        self.request_latency = type(
+            "L", (), {"note_router_accept":
+                      staticmethod(lambda uid, t: None)})()
+        self._uid = 0
+
+    def set_replica(self, name):
+        pass
+
+    def validate_request(self, prompt, max_new):
+        pass
+
+    def put_request(self, prompt, **kw):
+        self._uid += 1
+        return self._uid
+
+    def has_work(self):
+        return True
+
+    def step(self):
+        time.sleep(self.wedge_s)
+
+    def stream_deltas(self):
+        return []
+
+    def get_outputs(self):
+        return []
+
+    def close(self):
+        pass
+
+
+class TestWatchdogBreaker:
+    def test_watchdog_abandons_wedged_replica(self):
+        h = EngineReplicaHandle(0, _WedgeEngine(0.8), watchdog_s=0.2)
+        h.step_async(on_done=lambda payload: None)
+        with pytest.raises(ReplicaHangError, match="watchdog"):
+            h.join_all()
+        # the worker is written off, not joined: the handle is dead,
+        # hung, and holds no live window ops the caller could re-wedge on
+        assert h.hung and not h.alive and h.in_flight == 0
+        h.close()                    # idempotent on a hung handle
+
+    def test_hang_trips_breaker_and_redispatches(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv("DSTPU_FLIGHT_DIR", str(tmp_path))
+        wedged = EngineReplicaHandle(0, _WedgeEngine(0.8), watchdog_s=0.2)
+        healthy = FakeReplica(1, latency=1)
+        router = Router([wedged, healthy], policy="rr", sticky=False,
+                        breaker=BreakerConfig())
+        rid = router.submit(_prompt(3), max_new_tokens=4)
+        outs = _drain(router)
+        # the hang became a breaker trip and the request finished on
+        # the survivor — request conservation across a wedge
+        assert rid in outs
+        s = router.stats()
+        assert s["replica_deaths"] == 1 and s["rerouted"] == 1, s
+        assert s["state_r0"] == "dead" and s["state_f1"] == "healthy", s
+        assert wedged.hung
+        header, _events = read_flight_record(flight.last_dump_path())
+        assert header["reason"] == "replica_death_r0"
+
+    def test_suspect_hedges_and_target_wins(self):
+        clock = FakeClock()
+        f0 = LaggyFakeReplica(0, latency=2, clock=clock)
+        f1 = LaggyFakeReplica(1, latency=2, clock=clock)
+        router = Router([f0, f1], policy="rr", sticky=False, clock=clock,
+                        breaker=BreakerConfig(suspect_after_s=5.0))
+        router.collect_events = True
+        rid = router.submit(_prompt(3), max_new_tokens=4)
+        router.pump()                # dispatched to f0, admit pending
+        assert router.stats()["state_f0"] == "healthy"
+        clock.advance(6.0)
+        router.pump()                # stale progress: suspect + hedge
+        s = router.stats()
+        assert s["state_f0"] == "suspect" and s["hedges"] == 1, s
+        # resolve the race target-first: f1's admit fold claims the
+        # request, f0's later fold must cancel its own copy
+        f1.join_all()
+        f0.join_all()
+        assert router.stats()["hedge_won"] == 1
+        assert f0.cancelled == [f0.puts[0][0]]
+        router.pump()                # queue empty again: suspect clears
+        assert router.stats()["state_f0"] == "healthy"
+        streamed, finals = [], {}
+        while router.outstanding:
+            router.pump()
+            router.join()
+            for kind, r, payload in router.poll_events():
+                if kind == "tokens":
+                    streamed.extend(int(t) for t in payload)
+                elif kind == "finish":
+                    finals[r] = payload
+        # exactly-once: only the winner's tokens reached the stream
+        assert streamed == [100, 101]
+        assert rid in finals and len(f1.generated) == 1
+
+    def test_suspect_hedge_original_wins(self):
+        # the slow-but-alive replica's admit folds FIRST: the original
+        # keeps the request (hedge_lost) and the hedge copy is
+        # cancelled before it can emit
+        clock = FakeClock()
+        f0 = LaggyFakeReplica(0, latency=2, clock=clock)
+        f1 = LaggyFakeReplica(1, latency=2, clock=clock)
+        router = Router([f0, f1], policy="rr", sticky=False, clock=clock,
+                        breaker=BreakerConfig(suspect_after_s=5.0))
+        router.collect_events = True
+        rid = router.submit(_prompt(3), max_new_tokens=4)
+        router.pump()
+        clock.advance(6.0)
+        router.pump()
+        assert router.stats()["hedges"] == 1
+        f0.join_all()                # original admits first: it wins
+        f1.join_all()
+        s = router.stats()
+        assert s["hedge_lost"] == 1 and s["hedge_won"] == 0, s
+        assert f1.cancelled == [f1.puts[0][0]]
+        streamed = []
+        while router.outstanding:
+            router.pump()
+            router.join()
+            streamed.extend(int(t) for k, r, p in router.poll_events()
+                            if k == "tokens" for t in p)
+        assert streamed == [100, 101]
+        assert rid in router.get_outputs()
+
+    def test_probation_readmits_after_clean_finishes(self):
+        made = []
+
+        def factory(i):
+            h = FakeReplica(i, latency=1, max_seqs=3)
+            made.append(h)
+            return h
+
+        rs = FakeSet([FakeReplica(0, latency=3, die_at_step=1)], factory)
+        router = Router(rs, policy="rr", sticky=False,
+                        breaker=BreakerConfig(revive=True,
+                                              probation_successes=2))
+        rids = [router.submit(_prompt(3, base=10 * i), max_new_tokens=4)
+                for i in range(3)]
+        router.pump()                # f0 dies on its first step
+        assert router.stats()["replica_deaths"] == 1
+        router.pump()                # revival probe grows f1 on probation
+        s = router.stats()
+        assert s["revived"] == 1 and s["state_f1"] == "probation", s
+        # probation throttle: one request at a time until proven
+        assert len(made[0].puts) == 1
+        router.pump()                # second clean finish: re-admitted
+        assert router.stats()["state_f1"] == "healthy"
+        outs = _drain(router)
+        assert set(outs) == set(rids)
+        assert router.stats()["rerouted"] == 3
+
+    def test_flapping_revival_freezes(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DSTPU_FLIGHT_DIR", str(tmp_path))
+
+        def flappy(i):               # every replacement dies on step 1
+            return FakeReplica(i, latency=5, die_at_step=1)
+
+        rs = FakeSet([FakeReplica(0, latency=5, die_at_step=1),
+                      FakeReplica(1, latency=30)], flappy)
+        router = Router(rs, policy="rr", sticky=False, queue_cap=2,
+                        breaker=BreakerConfig(revive=True, max_trips=2,
+                                              probation_successes=1))
+        rids = [router.submit(_prompt(3, base=10 * i), max_new_tokens=4)
+                for i in range(4)]
+        outs = _drain(router)
+        # the flapping lineage froze revival; the survivor finished
+        # every request anyway — freeze degrades, never drops
+        assert set(outs) == set(rids)
+        s = router.stats()
+        assert s["frozen"] is True, s
+        assert s["revived"] == 2 and s["replica_deaths"] == 3, s
+        assert s["state_f1"] == "healthy", s
+        assert s["state_f2"] == "dead" and s["state_f3"] == "dead", s
+        header, _events = read_flight_record(flight.last_dump_path())
+        assert header["reason"] == "breaker_freeze"
+        assert header["extra"]["revive_failures"] == 2
+
+    def test_factory_failure_freezes_revival(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DSTPU_FLIGHT_DIR", str(tmp_path))
+        rs = FakeSet([FakeReplica(0, latency=5, die_at_step=1),
+                      FakeReplica(1, latency=1)], factory=None)
+        router = Router(rs, policy="rr", sticky=False,
+                        breaker=BreakerConfig(revive=True, max_trips=1))
+        rids = [router.submit(_prompt(3, base=10 * i), max_new_tokens=4)
+                for i in range(2)]
+        outs = _drain(router)
+        assert set(outs) == set(rids)
+        s = router.stats()
+        assert s["frozen"] is True and s["revived"] == 0, s
 
 
 # -- integration against REAL engines ------------------------------------
