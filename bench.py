@@ -1431,6 +1431,153 @@ def bench_ragged(args) -> None:
             "nothing overlaps; speedup is not meaningful here "
             "(conservation + greedy bit-parity asserted instead)")
 
+    # -- disaggregated serving: prefill split from decode ---------------
+    # Bimodal mix (1-in-4 long prefills among short chats) against a
+    # 1-prefill + 1-decode role split vs one fused replica.  The split
+    # keeps long prefills off the decode replica's step loop, so decode
+    # TPOT stops inheriting prefill-induced stalls; finished KV crosses
+    # replicas in spill format and every restored page is digest-
+    # verified on the receiver.  Conservation, greedy bit-parity and
+    # the digest accounting are hard gates on every host; the both-
+    # beat-fused tail floors only bind where replicas own real devices.
+    dgb_n = so_n
+    dgb_rng = np.random.default_rng(13)
+    dgb_long_hi = max(chunk + 1, min(2 * chunk, max_len - new - 1))
+    dgb_lens = [int(dgb_rng.integers(chunk, dgb_long_hi + 1))
+                if i % 4 == 0
+                else int(dgb_rng.integers(4, max(chunk - 1, 5)))
+                for i in range(dgb_n)]
+    dgb_prompts = [dgb_rng.integers(0, cfg.vocab_size, l,
+                                    dtype=np.int32) for l in dgb_lens]
+    dgb_long = sum(1 for l in dgb_lens if l >= chunk)
+
+    def dgb_engine(i=0):
+        # page_size pinned to one prefill chunk: the router's long-
+        # prefill threshold (handoff_min_prompt) seeds from the
+        # replica page size, and the bimodal mix above straddles chunk
+        from deepspeed_tpu.inference.v2.ragged_engine import (
+            RaggedInferenceEngineV2)
+        return RaggedInferenceEngineV2(
+            model, {"params": params}, max_seqs=max_seqs,
+            max_seq_len=max_len, prefill_chunk=chunk,
+            page_size=chunk,
+            num_pages=max_seqs * (max_len // chunk + 1) + 4,
+            decode_block_size=decode_block,
+            kv_tiering={"host_pages": 16 * max_seqs})
+
+    def dgb_run(n_rep, roles=None):
+        rs = ReplicaSet(dgb_engine, n_rep)
+        router = Router(rs, policy="least_tokens", queue_cap=dgb_n)
+        if roles:
+            router.set_roles(roles)
+        t0 = time.perf_counter()
+        rid2i = {router.submit(p, max_new_tokens=new): i
+                 for i, p in enumerate(dgb_prompts)}
+        outs = router.drain()
+        wall = time.perf_counter() - t0
+        res = {
+            "outs": {rid2i[r]: t for r, t in outs.items()},
+            "wall": wall,
+            "stats": router.stats(),
+            "recs": [h.engine.request_latency.completed()
+                     for h in rs.handles],
+            "summ": [h.engine.request_latency.summary()
+                     for h in rs.handles],
+            "tiering": [dict(h.engine.tiering.counters)
+                        for h in rs.handles],
+        }
+        for h in rs.handles:
+            h.engine.audit_kv_sharing()
+        rs.close()
+        return res
+
+    dgb_fused = dgb_run(1)
+    _telemetry.trace.configure(enabled=True)
+    _telemetry.trace.clear()
+    dgb_split = dgb_run(2, roles={"r0": "prefill", "r1": "decode"})
+    dgb_bytes = sum(
+        int(ev.get("args", {}).get("bytes", 0))
+        for ev in _telemetry.trace.snapshot()
+        if ev.get("ph") == "X" and ev.get("name") == "handoff_transfer")
+    _telemetry.trace.configure(enabled=False)
+    _telemetry.trace.clear()
+
+    assert sorted(dgb_split["outs"]) == sorted(dgb_fused["outs"]), (
+        "disagg run lost requests: "
+        f"{len(dgb_split['outs'])}/{len(dgb_fused['outs'])} finished")
+    assert all(np.array_equal(dgb_split["outs"][i],
+                              dgb_fused["outs"][i])
+               for i in dgb_fused["outs"]), (
+        "disaggregated greedy outputs diverged from fused serving")
+    dgb_st = dgb_split["stats"]
+    assert (dgb_st["handoff_kv"] == dgb_long
+            and dgb_st["handoff_reprefill"] == 0), (
+        f"vacuous split: expected {dgb_long} KV handoffs, got "
+        f"kv={dgb_st['handoff_kv']} "
+        f"reprefill={dgb_st['handoff_reprefill']}")
+    dgb_tc = dgb_split["tiering"][1]
+    assert (dgb_tc["imports"] == dgb_st["handoff_kv"]
+            and dgb_tc["pages_verified"] == dgb_tc["pages_restored"] > 0
+            and dgb_tc["quarantined"] == 0), (
+        "handoff digest accounting broke: "
+        f"imports={dgb_tc['imports']} "
+        f"verified={dgb_tc['pages_verified']} "
+        f"restored={dgb_tc['pages_restored']} "
+        f"quarantined={dgb_tc['quarantined']}")
+
+    # client-meaningful tails: TTFT from whichever replica produced the
+    # first token (donor for longs — handoffs==0 on donor records);
+    # TPOT from wherever decode steps ran (receiver continuations plus
+    # short chats, never donor records, which end at one token)
+    dgb_ttft = sorted(r["ttft_ms"] for rr in dgb_split["recs"]
+                      for r in rr
+                      if r["ttft_ms"] is not None and r["handoffs"] == 0)
+    dgb_tpot = sorted(r["tpot_ms"] for rr in dgb_split["recs"]
+                      for r in rr if r["tpot_ms"] is not None)
+    dgb_f = dgb_fused["summ"][0]
+    detail["disagg"] = {
+        "replicas": "1 prefill + 1 decode",
+        "requests": dgb_n,
+        "long_prefills": dgb_long,
+        "handoff_kv": dgb_st["handoff_kv"],
+        "handoff_reprefill": dgb_st["handoff_reprefill"],
+        "handoff_bytes": dgb_bytes,
+        "pages_digest_verified": dgb_tc["pages_verified"],
+        "fused_wall_s": round(dgb_fused["wall"], 3),
+        "split_wall_s": round(dgb_split["wall"], 3),
+        "fused_ttft_ms_p50": dgb_f["ttft_ms_p50"],
+        "fused_ttft_ms_p99": dgb_f["ttft_ms_p99"],
+        "fused_tpot_ms_p99": dgb_f["tpot_ms_p99"],
+        "split_ttft_ms_p50": round(_pctl(dgb_ttft, 50) or 0.0, 2),
+        "split_ttft_ms_p99": round(_pctl(dgb_ttft, 99) or 0.0, 2),
+        "split_tpot_ms_p99": round(_pctl(dgb_tpot, 99) or 0.0, 2),
+        "handoff_stall_ms_p50":
+            dgb_split["summ"][1]["handoff_stall_ms_p50"],
+        "handoff_stall_ms_p99":
+            dgb_split["summ"][1]["handoff_stall_ms_p99"],
+        "bit_identical_to_fused": True,       # asserted above
+    }
+    if multi_device:
+        # real devices behind each role: the split must beat fused on
+        # BOTH tails — TTFT (prefills no longer queue behind decode
+        # blocks) and TPOT (decode steps no longer stall on prefills)
+        assert (detail["disagg"]["split_ttft_ms_p99"]
+                < dgb_f["ttft_ms_p99"]), (
+            "disagg TTFT p99 "
+            f"{detail['disagg']['split_ttft_ms_p99']}ms did not beat "
+            f"fused {dgb_f['ttft_ms_p99']}ms")
+        assert (detail["disagg"]["split_tpot_ms_p99"]
+                < dgb_f["tpot_ms_p99"]), (
+            "disagg TPOT p99 "
+            f"{detail['disagg']['split_tpot_ms_p99']}ms did not beat "
+            f"fused {dgb_f['tpot_ms_p99']}ms")
+    else:
+        detail["disagg"]["caveat"] = (
+            "single-device host: both roles share one device, prefill "
+            "and decode cannot overlap; tail floors not enforced "
+            "(conservation, bit-parity and digest accounting asserted "
+            "instead)")
+
     # -- network front door: HTTP/SSE serving at the socket -------------
     # The same 2-replica router behind the asyncio front door, measured
     # where the client sits: socket-level TTFT/TPOT from the load

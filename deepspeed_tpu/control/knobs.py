@@ -166,6 +166,25 @@ def router_knobs(router, prefix: str = "router.") -> KnobRegistry:
         lambda v: setattr(router, "queue_cap", max(int(v), 1)),
         lo=1, hi=max(4 * int(router.queue_cap), 8), step=1, kind="int",
         doc="per-replica admission queue cap"))
+    if hasattr(router, "set_prefill_fraction"):
+        # disaggregated serving: the controller adapts the
+        # prefill:decode replica ratio to the live prompt-length mix
+        # (set_prefill_fraction re-derives the role map, keeping >= 1
+        # replica per role; a no-op in fused mode) and bounds how many
+        # handoff export rounds may be in flight per prefill replica
+        reg.register(Knob(
+            f"{prefix}prefill_fraction",
+            lambda: router.prefill_fraction,
+            router.set_prefill_fraction,
+            lo=0.1, hi=0.9, step=0.1, kind="float",
+            doc="share of role-split replicas carrying the prefill "
+                "role"))
+        reg.register(Knob(
+            f"{prefix}handoff_depth", lambda: router.handoff_depth,
+            lambda v: setattr(router, "handoff_depth", max(int(v), 1)),
+            lo=1, hi=8, step=1, kind="int",
+            doc="in-flight prefill->decode handoff export rounds per "
+                "prefill replica"))
     return reg
 
 

@@ -119,6 +119,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.inference.common import HostStageStats
+from deepspeed_tpu.resilience import faults
 from deepspeed_tpu.telemetry import RequestLatencyTracker, trace
 from deepspeed_tpu.utils.async_stage import BoundedAsyncStage, StageTimers
 from deepspeed_tpu.inference.paged import (PageAllocator,
@@ -198,6 +199,12 @@ class Request:
     # "mid-<uid>-<g>" keys (always a contiguous prefix of the middle)
     lc: bool = False
     lc_parked: int = 0
+    # disaggregated serving: the router marked this request for a
+    # prefill->decode handoff — the engine runs its prefill, lets the
+    # first token land (it is sampled in the same fused tick the last
+    # prompt chunk runs in), then parks the session for
+    # ``export_handoff`` instead of decoding it locally
+    handoff: bool = False
 
     @property
     def ctx_len(self) -> int:
@@ -606,6 +613,12 @@ class RaggedInferenceEngineV2:
         # uid (stream_deltas); cancels counts cancellations at any stage
         self._stream_cursor: Dict[int, int] = {}
         self.cancels = 0
+        # disaggregated serving: sessions whose prefill (+ first token)
+        # finished and which now wait for the router to pull them via
+        # export_handoff — out of slots and out of the waiting queue
+        self._handoff_ready: List[Request] = []
+        self.handoffs = 0              # sessions exported to a decoder
+        self.handoff_folds = 0         # handoffs degraded to re-prefill
 
         # -- tiered KV spill store (HBM -> host RAM -> NVMe) --
         from deepspeed_tpu.inference.config import KVTieringConfig
@@ -920,7 +933,7 @@ class RaggedInferenceEngineV2:
         out: List[Tuple[int, List[int], int, bool]] = []
         cur = self._stream_cursor
         live = [r for r in self.slots if r is not None]
-        for r in itertools.chain(live, self.waiting):
+        for r in itertools.chain(live, self.waiting, self._handoff_ready):
             n = len(r.generated)
             seen = cur.get(r.uid, 0)
             if n > seen:
@@ -974,6 +987,20 @@ class RaggedInferenceEngineV2:
                 stage = "queued"
             self._drop_lc_parked(r)
             break
+        if stage is None:
+            # parked for a prefill->decode handoff the router never
+            # collected: release like a waiting spilled session
+            for r in list(self._handoff_ready):
+                if r.uid != uid:
+                    continue
+                self._handoff_ready.remove(r)
+                if r.spilled is not None:
+                    for p in r.spilled.get("shared_pages", ()):
+                        self.allocator.decref(p)
+                    if self.tiering is not None:
+                        self.tiering.drop(r.uid)
+                stage = "handoff"
+                break
         if stage is None:
             # resident in a slot (prefill or decode phase; LC sequences
             # tick outside the fused batch but park in slots the same)
@@ -1037,6 +1064,13 @@ class RaggedInferenceEngineV2:
         replica-shutdown half of the engine handle — ``close()``
         releases resources after)."""
         outs: Dict[int, np.ndarray] = {}
+        # nobody is coming to collect a pending handoff during a drain:
+        # finish those sessions locally through the normal spilled /
+        # continuation re-admission path
+        for r in self._handoff_ready:
+            r.handoff = False
+            self.waiting.append(r)
+        self._handoff_ready = []
         while self.has_work():
             self.step()
             outs.update(self.get_outputs())
@@ -1061,47 +1095,66 @@ class RaggedInferenceEngineV2:
         sessions: List[Dict[str, Any]] = []
         while self.waiting:
             r = self.waiting.popleft()
-            blob: Dict[str, Any] = {
-                "uid": int(r.uid),
-                "prompt": np.asarray(r.prompt, np.int32),
-                "max_new_tokens": int(r.max_new_tokens),
-                "eos_token_id": r.eos_token_id,
-                "do_sample": bool(r.do_sample),
-                "temperature": float(r.temperature),
-                "top_k": int(r.top_k),
-                "top_p": float(r.top_p),
-                "generated": [int(t) for t in r.generated],
-                "ctx": (None if r.ctx is None
-                        else np.asarray(r.ctx, np.int32)),
-                "prefill_done": int(r.prefill_done),
-                "spill": None}
-            if r.spilled is not None:
-                shared = [int(p) for p in r.spilled.get("shared_pages",
-                                                        ())]
-                n_priv = int(r.spilled.get("n_pages", 0))
-                holds = (self.tiering is not None
-                         and self.tiering.holds(r.uid))
-                if shared or (n_priv > 0 and not holds):
-                    # fold to a re-prefill continuation; release the
-                    # spill-holds and the orphaned payload
-                    for p in shared:
-                        self.allocator.decref(p)
-                    if self.tiering is not None:
-                        self.tiering.drop(r.uid)
-                    blob["ctx"] = np.concatenate(
-                        [r.prompt, np.asarray(r.generated, np.int32)])
-                    blob["prefill_done"] = 0
-                else:
-                    blob["spill"] = {
-                        "last_tok": int(r.spilled["last_tok"]),
-                        "live_tokens": int(r.spilled["live_tokens"]),
-                        "payload": (self.tiering.export_spilled(r.uid)
-                                    if n_priv > 0 else None)}
+            blob = self._session_blob(r)
             if trace.enabled:
                 trace.event("request_export", cat="request", uid=r.uid,
                             spilled=blob["spill"] is not None)
             sessions.append(blob)
+        # pending prefill->decode handoffs the router never pulled ride
+        # the same retirement: they are parked sessions like any other
+        for r in self._handoff_ready:
+            blob = self._session_blob(r)
+            if trace.enabled:
+                trace.event("request_export", cat="request", uid=r.uid,
+                            spilled=blob["spill"] is not None)
+            sessions.append(blob)
+        self._handoff_ready = []
         return sessions
+
+    def _session_blob(self, r: Request) -> Dict[str, Any]:
+        """Portable session blob for ``import_parked`` on another
+        replica (shared by :meth:`export_parked` and
+        :meth:`export_handoff`).  A spilled session's private pages
+        travel in spill format via ``TieredKVStore.export_spilled``;
+        one pinning shared-prefix pages (rows in THIS engine's HBM —
+        they cannot travel) folds to a re-prefill continuation."""
+        blob: Dict[str, Any] = {
+            "uid": int(r.uid),
+            "prompt": np.asarray(r.prompt, np.int32),
+            "max_new_tokens": int(r.max_new_tokens),
+            "eos_token_id": r.eos_token_id,
+            "do_sample": bool(r.do_sample),
+            "temperature": float(r.temperature),
+            "top_k": int(r.top_k),
+            "top_p": float(r.top_p),
+            "generated": [int(t) for t in r.generated],
+            "ctx": (None if r.ctx is None
+                    else np.asarray(r.ctx, np.int32)),
+            "prefill_done": int(r.prefill_done),
+            "spill": None}
+        if r.spilled is not None:
+            shared = [int(p) for p in r.spilled.get("shared_pages",
+                                                    ())]
+            n_priv = int(r.spilled.get("n_pages", 0))
+            holds = (self.tiering is not None
+                     and self.tiering.holds(r.uid))
+            if shared or (n_priv > 0 and not holds):
+                # fold to a re-prefill continuation; release the
+                # spill-holds and the orphaned payload
+                for p in shared:
+                    self.allocator.decref(p)
+                if self.tiering is not None:
+                    self.tiering.drop(r.uid)
+                blob["ctx"] = np.concatenate(
+                    [r.prompt, np.asarray(r.generated, np.int32)])
+                blob["prefill_done"] = 0
+            else:
+                blob["spill"] = {
+                    "last_tok": int(r.spilled["last_tok"]),
+                    "live_tokens": int(r.spilled["live_tokens"]),
+                    "payload": (self.tiering.export_spilled(r.uid)
+                                if n_priv > 0 else None)}
+        return blob
 
     def import_parked(self, sessions: List[Dict[str, Any]]) -> List[int]:
         """Receiving half of the handoff: install each exported session
@@ -1154,6 +1207,57 @@ class RaggedInferenceEngineV2:
                             donor_uid=int(s.get("uid", -1)),
                             spilled=req.spilled is not None)
             new_uids.append(req.uid)
+        return new_uids
+
+    # -- disaggregated serving: prefill -> decode handoff ----------------
+
+    def export_handoff(self) -> List[Dict[str, Any]]:
+        """Pop every session parked by :meth:`_handoff_sweep` (prefill
+        + first token done, KV spilled to the tiers or folded to a
+        re-prefill continuation) as portable blobs for
+        :meth:`import_handoff` on a decode-role replica.  The wire
+        format is exactly :meth:`export_parked`'s — the receiver admits
+        the session through the normal spilled-request re-admission
+        path, so greedy outputs stay bit-identical to a fused tick."""
+        sessions: List[Dict[str, Any]] = []
+        for r in self._handoff_ready:
+            blob = self._session_blob(r)
+            self.handoffs += 1
+            self._stream_cursor.pop(r.uid, None)
+            self.request_latency.on_handoff_out(r.uid)
+            if trace.enabled:
+                trace.event("request_handoff", cat="request", uid=r.uid,
+                            spilled=blob["spill"] is not None,
+                            generated=len(blob["generated"]))
+            sessions.append(blob)
+        self._handoff_ready = []
+        return sessions
+
+    def import_handoff(self, sessions: List[Dict[str, Any]],
+                       export_t: Optional[float] = None) -> List[int]:
+        """Decode-role half of the handoff: install the exported
+        sessions via :meth:`import_parked` (fresh uids, payloads parked
+        in the local tiers with the DONOR's digests — the restore
+        verifies end-to-end) and stamp the export->import stall onto
+        each request's latency record.  The ``handoff.import`` fault
+        site fires per session before installation; a ``bitflip``
+        directive corrupts the wire payload, which the digest-verified
+        restore must catch (re-read, then quarantine + re-prefill)."""
+        for s in sessions:
+            sp = s.get("spill")
+            payload = None if sp is None else sp.get("payload")
+            d = faults.hook("handoff.import", uid=int(s.get("uid", -1)))
+            if (d is not None and d[0] == "bitflip"
+                    and payload is not None):
+                buf = np.frombuffer(bytearray(payload["payload"]),
+                                    np.uint8)
+                faults.apply_bitflip(buf, d[1])
+                payload["payload"] = buf.tobytes()
+        new_uids = self.import_parked(sessions)
+        if export_t is not None:
+            stall = max(time.perf_counter() - float(export_t), 0.0)
+            for uid in new_uids:
+                self.request_latency.on_handoff_stall(uid, stall)
         return new_uids
 
     def knob_registry(self):
@@ -1289,6 +1393,10 @@ class RaggedInferenceEngineV2:
             "waiting_requests": len(self.waiting),
             "pressure": round(in_use / usable
                               + len(self.waiting), 4)}
+        if self.handoffs or self.handoff_folds or self._handoff_ready:
+            out["handoff"] = {"exported": int(self.handoffs),
+                              "folds": int(self.handoff_folds),
+                              "pending": len(self._handoff_ready)}
         if self._pipe_timers.seconds or self._pipe_timers.counters:
             # the pipelined decode window's substrate counters
             # (submitted/completed blocks, submit_wait back-pressure)
@@ -1311,7 +1419,7 @@ class RaggedInferenceEngineV2:
         """Release tier-store resources (AIO handle, staging buffers,
         digest pool, spill files) and prefix-cache holds.  Idempotent;
         a no-op with tiering and the prefix cache off."""
-        for r in self.waiting:
+        for r in itertools.chain(self.waiting, self._handoff_ready):
             if r.spilled is not None:
                 for p in r.spilled.get("shared_pages", ()):
                     self.allocator.decref(int(p))
@@ -2275,6 +2383,11 @@ class RaggedInferenceEngineV2:
             return self._pipeline_step()
         st = self.host_stats
         with st.stage("plan"):
+            # park finished handoff prefills BEFORE admission: a
+            # handoff request never reaches the decode block / spec /
+            # pipeline paths — it leaves its slot the step after its
+            # first token lands
+            self._handoff_sweep()
             self._admit()
             lc_live = [r for r in self.slots
                        if r is not None and not r.done and r.lc]
@@ -2368,6 +2481,10 @@ class RaggedInferenceEngineV2:
         st.ticks += 1
         produced = self._sample(sel_logits, samplers)
         self._reap()
+        # a handoff prefill that just sampled its first token parks NOW
+        # — same step — so the router's next export pulls it without an
+        # extra tick of decode-side latency
+        self._handoff_sweep()
         return produced + lc_produced
 
     def _admit(self) -> None:
@@ -2533,6 +2650,38 @@ class RaggedInferenceEngineV2:
         short = n_pages - self.allocator.free_pages
         if short > 0:
             self._pfx.reclaim(short)
+
+    def _handoff_sweep(self) -> None:
+        """Park every handoff-marked sequence whose prefill AND first
+        token are done: spill its KV to the tiers (the decode replica's
+        restore is then bit-identical to never having left) or — when
+        the pages can't travel (tiering off, tiers full) — fold it to a
+        re-prefill continuation, the degraded leg.  Either way the
+        sequence leaves its slot and waits in ``_handoff_ready`` for
+        the router's ``export_handoff`` pull."""
+        for r in list(self.slots):
+            if (r is None or not r.handoff or r.done or r.lc
+                    or r.prefill_done < r.ctx_len or not r.generated):
+                continue
+            if self._spill(r):
+                self.waiting.remove(r)     # _spill parked it there
+            else:
+                # planned handoff, not pool exhaustion — fold inline
+                # instead of via _evict (no eviction counter/log)
+                self.allocator.free(r.slot)
+                self.page_table[r.slot, :] = -1
+                self.slots[r.slot] = None
+                self._draft_len[r.slot] = 0
+                r.ctx = np.concatenate(
+                    [r.prompt, np.asarray(r.generated, np.int32)])
+                r.prefill_done = 0
+                r.pc_parent, r.pc_pages, r.pc_cached = ROOT_HASH, 0, 0
+                r.slot = -1
+                self.handoff_folds += 1
+            self._handoff_ready.append(r)
+            if trace.enabled:
+                trace.event("request_handoff_ready", cat="request",
+                            uid=r.uid, spilled=r.spilled is not None)
 
     def _evict(self, r) -> None:
         """Requeue ``r`` as a CONTINUATION: its pages return to the
@@ -2830,7 +2979,7 @@ class RaggedInferenceEngineV2:
             for e in self._pfx._entries.values():
                 if e.state == "resident":
                     external[e.page] = external.get(e.page, 0) + 1
-        for r in self.waiting:
+        for r in itertools.chain(self.waiting, self._handoff_ready):
             if r.spilled is not None:
                 for p in r.spilled.get("shared_pages", ()):
                     external[p] = external.get(p, 0) + 1

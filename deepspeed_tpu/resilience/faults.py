@@ -36,6 +36,15 @@ Instrumented sites (the stable surface; grep for ``faults.hook``):
                           probe) — ``io_error`` here models a failing
                           device; ``count`` spans the probe window so
                           the tier stays offline until the device heals
+``handoff.import``        once per session at the decode-role
+                          replica's handoff import
+                          (inference/v2/ragged_engine.py
+                          ``import_handoff``), before the payload is
+                          installed — ``bitflip`` corrupts the wire
+                          payload (the donor's digests then fail the
+                          restore: re-read, quarantine, fold to
+                          re-prefill), ``io_error``/``crash`` kill the
+                          import op (replica-death path)
 ``router.dispatch``       once per router->replica dispatch
                           (serving/router.py ``_send``) — ``io_error``
                           kills the dispatch (replica-death path),

@@ -32,7 +32,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from deepspeed_tpu.serving import protocol as proto
 
-__all__ = ["sse_generate", "LoadGenerator", "percentile"]
+__all__ = ["sse_generate", "LoadGenerator", "bimodal_payload_fn",
+           "percentile"]
 
 
 def percentile(xs: List[float], q: float) -> float:
@@ -42,6 +43,42 @@ def percentile(xs: List[float], q: float) -> float:
     s = sorted(xs)
     i = min(int(q / 100.0 * len(s)), len(s) - 1)
     return s[i]
+
+
+def bimodal_payload_fn(requests: int, *, short_len: int = 8,
+                       long_len: int = 64, long_frac: float = 0.25,
+                       max_new_tokens: int = 16, vocab: int = 64,
+                       seed: int = 0,
+                       deadline_ms: Optional[float] = None):
+    """Seeded bimodal long-prefill / short-chat workload mix.
+
+    Each request is independently a **long** prefill with probability
+    ``long_frac`` (prompt length ``long_len``) or a **short** chat turn
+    (``short_len``).  The split and every prompt token come from one
+    ``random.Random(seed)`` stream, so the same seed reproduces the
+    same workload byte-for-byte — required for bit-parity comparisons
+    between serving topologies (fused vs. disaggregated) under the
+    *same* traffic.
+
+    Returns ``(payload_fn, kinds)``: the ``payload_fn`` to hand to
+    :class:`LoadGenerator` and a per-request ``"long"``/``"short"``
+    label list for phase-split latency reporting.
+    """
+    rng = random.Random(seed)
+    kinds = ["long" if rng.random() < float(long_frac) else "short"
+             for _ in range(int(requests))]
+    prompts = [[rng.randrange(1, int(vocab)) for _ in
+                range(long_len if k == "long" else short_len)]
+               for k in kinds]
+
+    def payload(i: int) -> Dict[str, Any]:
+        p: Dict[str, Any] = {"prompt": prompts[i],
+                             "max_new_tokens": int(max_new_tokens)}
+        if deadline_ms is not None:
+            p["deadline_ms"] = float(deadline_ms)
+        return p
+
+    return payload, kinds
 
 
 async def sse_generate(host: str, port: int, payload: Dict[str, Any],
@@ -164,13 +201,19 @@ class LoadGenerator:
         report).
     seed:
         inter-arrival RNG seed (reproducible arrival process).
+    kinds:
+        optional per-request workload label (e.g. the ``"long"`` /
+        ``"short"`` list from :func:`bimodal_payload_fn`); when given,
+        the summary reports TTFT percentiles per label so a mixed
+        workload's long-prefill tail doesn't hide inside the aggregate.
     """
 
     def __init__(self, host: str, port: int,
                  payload_fn: Callable[[int], Dict[str, Any]],
                  requests: int = 64, concurrency: int = 8,
                  rate: Optional[float] = None, seed: int = 0,
-                 clock: Callable[[], float] = time.perf_counter) -> None:
+                 clock: Callable[[], float] = time.perf_counter,
+                 kinds: Optional[List[str]] = None) -> None:
         self.host, self.port = host, int(port)
         self.payload_fn = payload_fn
         self.requests = int(requests)
@@ -178,6 +221,7 @@ class LoadGenerator:
         self.rate = rate
         self.seed = int(seed)
         self.clock = clock
+        self.kinds = list(kinds) if kinds is not None else None
         self.results: List[Dict[str, Any]] = []
 
     async def _one(self, i: int, sem: asyncio.Semaphore) -> None:
@@ -221,6 +265,18 @@ class LoadGenerator:
                 errs[r["error"]] = errs.get(r["error"], 0) + 1
         ttft = [r["ttft_s"] * 1e3 for r in ok if r["ttft_s"] is not None]
         tpot = [r["tpot_s"] * 1e3 for r in ok if r["tpot_s"] is not None]
+        by_kind: Dict[str, Any] = {}
+        if self.kinds is not None:
+            for kind in sorted(set(self.kinds)):
+                ks = [r["ttft_s"] * 1e3 for r in ok
+                      if r["ttft_s"] is not None
+                      and r["i"] < len(self.kinds)
+                      and self.kinds[r["i"]] == kind]
+                by_kind[kind] = {
+                    "requests": sum(1 for k in self.kinds if k == kind),
+                    "ttft_ms_p50": round(percentile(ks, 50), 3),
+                    "ttft_ms_p99": round(percentile(ks, 99), 3),
+                }
         return {
             "mode": ("closed" if self.rate is None
                      else f"poisson@{self.rate:g}/s"),
@@ -234,6 +290,7 @@ class LoadGenerator:
             "ttft_ms_p99": round(percentile(ttft, 99), 3),
             "tpot_ms_p50": round(percentile(tpot, 50), 3),
             "tpot_ms_p99": round(percentile(tpot, 99), 3),
+            **({"by_kind": by_kind} if by_kind else {}),
         }
 
 
@@ -252,23 +309,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--vocab", type=int, default=64)
     ap.add_argument("--deadline-ms", type=float, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--long-frac", type=float, default=0.0,
+                    help="bimodal mix: fraction of requests that are "
+                         "long prefills (0 disables the mix)")
+    ap.add_argument("--long-prompt-len", type=int, default=64,
+                    help="prompt length of the long-prefill mode")
     args = ap.parse_args(argv)
 
-    rng = random.Random(args.seed)
-    prompts = [[rng.randrange(1, args.vocab) for _ in
-                range(args.prompt_len)] for _ in range(args.requests)]
+    kinds: Optional[List[str]] = None
+    if args.long_frac > 0.0:
+        payload, kinds = bimodal_payload_fn(
+            args.requests, short_len=args.prompt_len,
+            long_len=args.long_prompt_len, long_frac=args.long_frac,
+            max_new_tokens=args.max_new_tokens, vocab=args.vocab,
+            seed=args.seed, deadline_ms=args.deadline_ms)
+    else:
+        rng = random.Random(args.seed)
+        prompts = [[rng.randrange(1, args.vocab) for _ in
+                    range(args.prompt_len)] for _ in range(args.requests)]
 
-    def payload(i: int) -> Dict[str, Any]:
-        p: Dict[str, Any] = {"prompt": prompts[i],
-                             "max_new_tokens": args.max_new_tokens}
-        if args.deadline_ms is not None:
-            p["deadline_ms"] = args.deadline_ms
-        return p
+        def payload(i: int) -> Dict[str, Any]:
+            p: Dict[str, Any] = {"prompt": prompts[i],
+                                 "max_new_tokens": args.max_new_tokens}
+            if args.deadline_ms is not None:
+                p["deadline_ms"] = args.deadline_ms
+            return p
 
     gen = LoadGenerator(args.host, args.port, payload,
                         requests=args.requests,
                         concurrency=args.concurrency, rate=args.rate,
-                        seed=args.seed)
+                        seed=args.seed, kinds=kinds)
     summary = gen.run()
     print(json.dumps(summary, indent=2, sort_keys=True))
     return 0 if summary["completed"] == summary["requests"] else 1

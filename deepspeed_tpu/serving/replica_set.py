@@ -254,6 +254,32 @@ class EngineReplicaHandle:
 
         self._submit(op, on_done)
 
+    def export_handoff_async(self, on_done: Callable[[Any], Any]) -> None:
+        """Pull the engine's handoff-ready sessions (prefill + first
+        token done, KV in spill format) off the replica thread — the
+        prefill-role half of disaggregated serving; ``on_done(sessions)``
+        at join time."""
+        eng = self.engine
+
+        def op() -> List[Dict[str, Any]]:
+            return eng.export_handoff()
+
+        self._submit(op, on_done)
+
+    def import_handoff_async(self, sessions: List[Dict[str, Any]],
+                             export_t: float,
+                             on_done: Callable[[Any], Any]) -> None:
+        """Install handed-off prefill sessions on this (decode-role)
+        replica's thread; the engine stamps ``export_t -> now`` as each
+        request's handoff stall.  ``on_done(new_uids)`` at join time —
+        the router re-keys its uid ledger with them."""
+        eng = self.engine
+
+        def op() -> List[int]:
+            return eng.import_handoff(sessions, export_t)
+
+        self._submit(op, on_done)
+
     def join_all(self) -> None:
         """Fold every pending op (its ``on_done`` runs here, on the
         caller's thread); first replica fault re-raises after the

@@ -151,7 +151,7 @@ class DrainingRejection(RouterRejection):
 class _RouterReq:
     __slots__ = ("rid", "prompt", "kw", "priority", "accept_t",
                  "affinity", "cost", "replica", "uid", "attempts",
-                 "deadline_t", "cancelled", "streamed")
+                 "deadline_t", "cancelled", "streamed", "phase")
 
     def __init__(self, rid: int, prompt: np.ndarray, kw: Dict[str, Any],
                  priority: int, accept_t: float, affinity: int,
@@ -169,6 +169,9 @@ class _RouterReq:
         self.deadline_t: Optional[float] = None   # clock() expiry
         self.cancelled = False    # lazy heap removal marker
         self.streamed = 0         # generated tokens already emitted
+        # disaggregated serving: "prefill"/"decode" classification when
+        # a role split is active; None in fused mode (no role filter)
+        self.phase: Optional[str] = None
 
 
 # -- load-balancing policies ---------------------------------------------
@@ -295,7 +298,8 @@ class Router:
             "rerouted": 0, "finished": 0, "replica_deaths": 0,
             "replicas_added": 0, "replicas_retired": 0,
             "sessions_handed_off": 0, "hedges": 0, "hedge_won": 0,
-            "hedge_lost": 0, "failed_replica_death": 0, "revived": 0}
+            "hedge_lost": 0, "failed_replica_death": 0, "revived": 0,
+            "handoffs": 0, "handoff_kv": 0, "handoff_reprefill": 0}
         self._routed: Dict[str, int] = {h.name: 0 for h in self.handles}
         # -- health breaker state -----------------------------------------
         self.breaker = breaker
@@ -309,6 +313,20 @@ class Router:
         self._revive_pending = 0      # tripped replicas awaiting a probe
         self._revive_failures = 0     # consecutive probation deaths
         self.frozen = False           # revival frozen after max_trips
+        # -- disaggregated serving (prefill/decode role split) ------------
+        # name -> "prefill" | "decode"; empty = fused mode (every
+        # replica does both, no handoffs).  set_roles() installs it.
+        self._roles: Dict[str, str] = {}
+        # prompt length (tokens) at which a request classifies as a
+        # long prefill and is marked for prefill->decode handoff;
+        # seeded to one page-aligned prefix chunk
+        self.handoff_min_prompt = self._chunk
+        self.handoff_depth = 2        # in-flight export rounds / prefill
+        self.prefill_fraction = 0.5   # knob: share of prefill replicas
+        self._handoff_inflight: Dict[str, int] = {}
+        # rid -> {"src", "dst"} while the session blob is between the
+        # export fold and the import fold (death-path bookkeeping)
+        self._handoff_transit: Dict[int, Dict[str, str]] = {}
 
     # -- admission -------------------------------------------------------
 
@@ -322,6 +340,82 @@ class Router:
         return [h for h in self.handles
                 if h.alive and h.name not in self._retiring
                 and self._health.get(h.name) != "suspect"]
+
+    # -- disaggregated serving: prefill/decode role split -----------------
+
+    def set_roles(self, roles: Dict[str, str]) -> None:
+        """Install a replica role map for disaggregated serving:
+        ``{name: "prefill" | "decode"}``.  Prefill-role replicas take
+        long-prompt requests, run prefill + the first token, then hand
+        the session (KV in spill format, donor digests riding along)
+        to a decode-role replica; decode-role replicas take short-chat
+        traffic directly plus the handed-off sessions.  An unnamed
+        replica keeps serving both phases.  An empty map reverts to
+        fused mode.  A non-empty map must name at least one replica of
+        EACH role — a one-sided split would strand one traffic class.
+        Install roles before traffic for clean phase-label attribution
+        (the per-replica latency trackers re-label here)."""
+        roles = {str(k): str(v) for k, v in roles.items()}
+        have = {h.name for h in self.handles}
+        unknown = set(roles) - have
+        if unknown:
+            raise ValueError(f"unknown replicas {sorted(unknown)} "
+                             f"(have {sorted(have)})")
+        bad = set(roles.values()) - {"prefill", "decode"}
+        if bad:
+            raise ValueError(f"unknown roles {sorted(bad)} "
+                             "(want 'prefill' or 'decode')")
+        if roles:
+            vals = set(roles.values())
+            if vals != {"prefill", "decode"}:
+                raise ValueError(
+                    "a role split needs at least one prefill AND one "
+                    f"decode replica (got only {sorted(vals)})")
+        self._roles = roles
+        if roles:
+            self.prefill_fraction = (
+                sum(1 for v in roles.values() if v == "prefill")
+                / len(roles))
+        for h in self.handles:
+            rl = getattr(getattr(h, "engine", None),
+                         "request_latency", None)
+            if rl is not None and hasattr(rl, "set_phase"):
+                rl.set_phase(roles.get(h.name, ""))
+        trace.event("router_roles", cat="serving",
+                    prefill=",".join(sorted(
+                        n for n, v in roles.items() if v == "prefill")),
+                    decode=",".join(sorted(
+                        n for n, v in roles.items() if v == "decode")))
+
+    def set_prefill_fraction(self, frac: float) -> None:
+        """Knob apply: re-derive the role map so about ``frac`` of the
+        role-split replicas carry the prefill role (each role always
+        keeps >= 1 replica).  Existing prefill replicas are kept
+        prefill-side first — their prefix caches are warm.  A no-op in
+        fused mode: the knob re-balances an existing split, it never
+        creates one."""
+        self.prefill_fraction = min(max(float(frac), 0.0), 1.0)
+        if not self._roles:
+            return
+        names = [h.name for h in self.handles if h.name in self._roles]
+        if len(names) < 2:
+            return
+        n_pre = min(max(int(round(self.prefill_fraction * len(names))),
+                        1), len(names) - 1)
+        pre_first = sorted(
+            names, key=lambda n: (self._roles.get(n) != "prefill", n))
+        new = {n: ("prefill" if i < n_pre else "decode")
+               for i, n in enumerate(pre_first)}
+        if new != self._roles:
+            self.set_roles(new)
+
+    def _role_ok(self, name: str, phase: Optional[str]) -> bool:
+        """May replica ``name`` take a ``phase``-classified request?
+        Trivially yes in fused mode, for unclassified requests, and
+        for replicas outside the role map."""
+        if not self._roles or phase is None:
+            return True
+        return self._roles.get(name, phase) == phase
 
     # -- health breaker ---------------------------------------------------
 
@@ -518,6 +612,16 @@ class Router:
                          int(prompt.size) + max_new)
         if deadline_ms is not None:
             req.deadline_t = req.accept_t + float(deadline_ms) / 1e3
+        if self._roles:
+            # classify: long prefills go to prefill-role replicas and
+            # hand their finished KV to a decoder; short-chat requests
+            # (and single-token ones, which finish at their prefill)
+            # go straight to decode-role replicas
+            req.phase = ("prefill"
+                         if prompt.size >= self.handoff_min_prompt
+                         else "decode")
+            if req.phase == "prefill" and max_new > 1:
+                req.kw["handoff"] = True
         self._live[rid] = req
         heapq.heappush(self._heap, (-req.priority, self._hseq, req))
         self._hseq += 1
@@ -538,7 +642,16 @@ class Router:
                         return h
         h = self._policy(self, cands, req)
         if self.sticky and req.affinity != ROOT_HASH:
-            self._affinity.setdefault(req.affinity, h.name)
+            pinned = self._affinity.get(req.affinity)
+            if (pinned is not None
+                    and not self._role_ok(pinned, req.phase)):
+                # the pin points across the role split (e.g. at a
+                # replica re-roled to decode): re-home the chain to the
+                # replica this request lands on, so later repeats of
+                # the prefix hit a prefill replica that will own it
+                self._affinity[req.affinity] = h.name
+            else:
+                self._affinity.setdefault(req.affinity, h.name)
         return h
 
     def _send(self, req: _RouterReq, h: Any) -> None:
@@ -632,6 +745,7 @@ class Router:
         sent = 0
         burn = self._max_burn() if (self.slo is not None
                                     and not self._draining) else 0.0
+        deferred: List[Tuple[int, int, _RouterReq]] = []
         while self._heap:
             req = self._heap[0][2]
             if req.cancelled:
@@ -654,12 +768,22 @@ class Router:
                 # waiting behind it)
                 break
             cands = [h for h in self._dispatchable()
-                     if len(self._assigned[h.name]) < self._cap(h.name)]
+                     if len(self._assigned[h.name]) < self._cap(h.name)
+                     and self._role_ok(h.name, req.phase)]
             if not cands:
+                if self._roles and req.phase is not None:
+                    # this request's role has no room, but the OTHER
+                    # role may — park it aside so a full prefill side
+                    # never head-of-line-blocks decode traffic (or
+                    # vice versa)
+                    deferred.append(heapq.heappop(self._heap))
+                    continue
                 break
             heapq.heappop(self._heap)
             self._send(req, self._pick(req, cands))
             sent += 1
+        for entry in deferred:
+            heapq.heappush(self._heap, entry)
         return sent
 
     def _cap(self, name: str) -> int:
@@ -681,6 +805,7 @@ class Router:
             self._check_health()
             self._maybe_revive()
             self._dispatch_queued()
+            self._pump_handoffs()
             for h in list(self.handles):
                 if not h.alive:
                     continue
@@ -691,6 +816,155 @@ class Router:
                                  self._on_step_done(hh, payload))
                 except Exception as e:
                     self._on_replica_death(h, e)
+
+    # -- prefill -> decode handoff ----------------------------------------
+
+    def _pump_handoffs(self) -> None:
+        """One export round per prefill-role replica that may hold a
+        finished handoff prefill, bounded to ``handoff_depth`` export
+        ops in flight per replica.  The export's fold picks a decode
+        replica and chains the import (`_on_handoff_export`)."""
+        if not self._roles:
+            return
+        for h in list(self.handles):
+            if (not h.alive or h.name in self._retiring
+                    or self._roles.get(h.name) != "prefill"
+                    or getattr(h, "export_handoff_async", None) is None):
+                continue
+            if (self._handoff_inflight.get(h.name, 0)
+                    >= max(int(self.handoff_depth), 1)):
+                continue
+            # only poke the replica while a handoff-marked request is
+            # assigned there — the export op is not free, it occupies
+            # a slot of the replica's feed window
+            if not any(rq is not None and rq.kw.get("handoff")
+                       for rq in (self._live.get(rid) for rid in
+                                  self._assigned.get(h.name, ()))):
+                continue
+            self._handoff_inflight[h.name] = \
+                self._handoff_inflight.get(h.name, 0) + 1
+            t0 = self.clock()
+            try:
+                h.export_handoff_async(
+                    on_done=lambda sessions, hh=h, t=t0:
+                    self._on_handoff_export(hh, sessions, t))
+            except Exception as e:   # join of an older op faulted
+                self._handoff_inflight[h.name] = max(
+                    self._handoff_inflight.get(h.name, 1) - 1, 0)
+                self._on_replica_death(h, e)
+
+    def _on_handoff_export(self, h: Any, sessions: List[Dict[str, Any]],
+                           t0: float) -> None:
+        """Export fold (router thread): route the finished-prefill
+        session blobs to a decode-role replica and submit the import.
+        Sessions are marked in-transit until the import folds — the
+        death path knows which side of the wire still owns them."""
+        self._handoff_inflight[h.name] = max(
+            self._handoff_inflight.get(h.name, 1) - 1, 0)
+        if not sessions:
+            return
+        t_exp = self.clock()
+        cands = [x for x in self._dispatchable()
+                 if x.name != h.name
+                 and self._roles.get(x.name) == "decode"
+                 and getattr(x, "import_handoff_async", None) is not None]
+        # degenerate fallback (decode side died mid-flight): re-import
+        # on the donor itself — the session decodes where it prefilled
+        tgt = (min(cands, key=lambda x: (self._tokens[x.name], x.idx))
+               if cands else h)
+        for s in sessions:
+            rid = self._uid_rid.get((h.name, int(s["uid"])))
+            if rid is not None:
+                self._handoff_transit[rid] = {"src": h.name,
+                                              "dst": tgt.name}
+        try:
+            tgt.import_handoff_async(
+                sessions, t_exp,
+                on_done=lambda uids, hh=h, tt=tgt, ss=sessions,
+                a=t0, b=t_exp:
+                self._on_handoff_import(hh, tt, ss, uids, a, b))
+        except Exception as e:       # join of an older op faulted
+            for s in sessions:
+                rid = self._uid_rid.get((h.name, int(s["uid"])))
+                if rid is not None:
+                    self._handoff_transit.pop(rid, None)
+            self._on_replica_death(tgt, e)
+
+    def _on_handoff_import(self, src: Any, tgt: Any,
+                           sessions: List[Dict[str, Any]],
+                           new_uids: List[int], t0: float,
+                           t_exp: float) -> None:
+        """Import fold (router thread): re-key each session's ledger
+        entry from ``(src, old_uid)`` to ``(tgt, new_uid)`` — the same
+        re-keying retire_replica does — and account the handoff path
+        (KV payload vs degraded re-prefill).  Emits the
+        ``cat="handoff"`` span quartet per session."""
+        t_imp = self.clock()
+        moved: List[Tuple[int, Optional[Dict[str, Any]]]] = []
+        for s, new_uid in zip(sessions, new_uids):
+            sp = s.get("spill")
+            payload = None if sp is None else sp.get("payload")
+            self.stats_counters["handoffs"] += 1
+            self.stats_counters["handoff_kv" if payload is not None
+                                else "handoff_reprefill"] += 1
+            rid = self._uid_rid.pop((src.name, int(s["uid"])), None)
+            if rid is None:
+                # cancelled (or failed loudly) while in transit: tear
+                # the freshly installed copy down on the receiver
+                self._cancel_on_replica(tgt, int(new_uid))
+                moved.append((-1, payload))
+                continue
+            self._handoff_transit.pop(rid, None)
+            req = self._live.get(rid)
+            self._uid_rid[(tgt.name, int(new_uid))] = rid
+            self._assigned.get(src.name, set()).discard(rid)
+            self._assigned[tgt.name].add(rid)
+            if req is not None:
+                if src.name in self._tokens:
+                    self._tokens[src.name] -= req.cost
+                self._tokens[tgt.name] += req.cost
+                req.replica = tgt.name
+                req.uid = int(new_uid)
+                req.phase = "decode"
+            moved.append((rid, payload))
+        t_done = self.clock()
+        if _metrics.enabled:
+            fam = _metrics.counter("dstpu_handoff_total",
+                                   "Prefill->decode handoffs by path",
+                                   labels=("path",))
+            n_kv = sum(1 for _, p in moved if p is not None)
+            if n_kv:
+                fam.labels(path="kv").inc(n_kv)
+            if len(moved) - n_kv:
+                fam.labels(path="reprefill").inc(len(moved) - n_kv)
+            kv_bytes = sum(len(p["payload"]) for _, p in moved
+                           if p is not None)
+            if kv_bytes:
+                _metrics.counter(
+                    "dstpu_handoff_bytes_total",
+                    "Handoff KV payload bytes moved").inc(kv_bytes)
+        if trace.enabled:
+            for rid, payload in moved:
+                attrs = {"rid": int(rid), "src": src.name,
+                         "dst": tgt.name}
+                trace.add_complete("handoff_export", t0,
+                                   max(t_exp - t0, 0.0),
+                                   cat="handoff", **attrs)
+                trace.add_complete(
+                    "handoff_transfer", t_exp, max(t_imp - t_exp, 0.0),
+                    cat="handoff",
+                    bytes=(len(payload["payload"])
+                           if payload is not None else 0), **attrs)
+                trace.add_complete("handoff_import", t_imp,
+                                   max(t_done - t_imp, 0.0),
+                                   cat="handoff", **attrs)
+                trace.add_complete(
+                    "handoff_verify", t_imp, max(t_done - t_imp, 0.0),
+                    cat="handoff",
+                    pages=(int(payload["n_pages"])
+                           if payload is not None else 0),
+                    digests=bool(payload is not None
+                                 and payload.get("digests")), **attrs)
 
     def _on_step_done(self, h: Any, payload: Any) -> None:
         # payload is (outs, pool, deltas); legacy fakes post (outs, pool)
@@ -759,6 +1033,10 @@ class Router:
             return False
         req.cancelled = True
         self.stats_counters["cancelled"] += 1
+        # mid-handoff: the popped _live entry (and the uid mapping
+        # popped below) make the import fold cancel the fresh copy on
+        # the receiver — the transit marker just needs clearing
+        self._handoff_transit.pop(rid, None)
         if rid in self._hedges and req.uid is None:
             # two puts still race for this rid and neither has
             # admitted: each admit fold sees req.cancelled (or the
@@ -823,10 +1101,47 @@ class Router:
             extra={"replica": h.name,
                    "requeued_rids": orphans,
                    "policy": self.policy})
-        for rid in orphans:
+        # sessions in prefill->decode transit whose RECEIVER just died:
+        # the blob is lost with it — fail or requeue from the full
+        # prompt (these rids sit in the SOURCE's assigned set, so the
+        # orphan loop below never sees them)
+        for rid, tr in list(self._handoff_transit.items()):
+            if tr["dst"] != h.name or tr["src"] == h.name:
+                continue
+            self._handoff_transit.pop(rid, None)
             req = self._live.get(rid)
             if req is None:
                 continue
+            src = tr["src"]
+            if req.uid is not None:
+                self._uid_rid.pop((src, req.uid), None)
+            self._assigned.get(src, set()).discard(rid)
+            if src in self._tokens:
+                self._tokens[src] -= req.cost
+            req.uid = None
+            req.replica = None
+            if req.streamed > 0 and req.kw.get("do_sample"):
+                self._live.pop(rid, None)
+                self.stats_counters["failed_replica_death"] += 1
+                self._emit("replica_death", rid, None)
+                trace.event("router_replica_death_fail", cat="serving",
+                            rid=rid, streamed=int(req.streamed))
+                continue
+            self.stats_counters["rerouted"] += 1
+            heapq.heappush(self._heap, (-req.priority, self._hseq, req))
+            self._hseq += 1
+        for rid in orphans:
+            tr = self._handoff_transit.get(rid)
+            if (tr is not None and tr["src"] == h.name
+                    and tr["dst"] != h.name):
+                # the session blob already left this replica: the
+                # in-flight import on tr["dst"] will claim the rid at
+                # its fold — requeueing here would run it twice
+                continue
+            req = self._live.get(rid)
+            if req is None:
+                continue
+            self._handoff_transit.pop(rid, None)
             if req.uid is not None:
                 self._uid_rid.pop((h.name, req.uid), None)
             self._tokens[h.name] -= req.cost
@@ -864,6 +1179,16 @@ class Router:
         # affinity pins to a dead replica would strand their chains
         for k in [k for k, v in self._affinity.items() if v == h.name]:
             del self._affinity[k]
+        self._handoff_inflight.pop(h.name, None)
+        if self._roles.pop(h.name, None) is not None:
+            vals = set(self._roles.values())
+            if vals != {"prefill", "decode"}:
+                # the split lost one whole side: fall back to fused
+                # routing so the surviving role's traffic cannot be
+                # stranded behind an empty candidate set
+                self._roles = {}
+                for rq in self._live.values():
+                    rq.phase = None
         try:
             h.close()
         except Exception:
@@ -1037,6 +1362,13 @@ class Router:
         self._pressure.pop(name, None)
         self._health.pop(name, None)
         self._probation_left.pop(name, None)
+        self._handoff_inflight.pop(name, None)
+        if self._roles.pop(name, None) is not None:
+            vals = set(self._roles.values())
+            if vals != {"prefill", "decode"}:
+                self._roles = {}    # split lost a side: fused fallback
+                for rq in self._live.values():
+                    rq.phase = None
         self.stats_counters["replicas_retired"] += 1
         self.stats_counters["sessions_handed_off"] += handed_off
         trace.event("router_shrink", cat="control", replica=name,
@@ -1101,10 +1433,15 @@ class Router:
         out.update(self.stats_counters)
         if self.breaker is not None:
             out["frozen"] = self.frozen
+        if self._roles:
+            out["prefill_fraction"] = round(self.prefill_fraction, 4)
+            out["handoffs_in_transit"] = len(self._handoff_transit)
         for h in self.handles:
             out[f"routed_{h.name}"] = self._routed[h.name]
             out[f"outstanding_tokens_{h.name}"] = self._tokens[h.name]
             out[f"state_{h.name}"] = self._health.get(h.name, "healthy")
+            if h.name in self._roles:
+                out[f"role_{h.name}"] = self._roles[h.name]
             if h.name in self._pressure:
                 out[f"pressure_{h.name}"] = self._pressure[h.name]
         if self.slo is not None:

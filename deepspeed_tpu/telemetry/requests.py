@@ -53,6 +53,8 @@ _HIST_SPECS = {
         "Router accept to replica slot admission (ms)",
     "spill_stall_ms": "Restore-bracket stall attributed to the request (ms)",
     "prefill_ms": "Admission to prefill-complete (ms)",
+    "handoff_stall_ms":
+        "Prefill-replica export to decode-replica install (ms)",
 }
 
 
@@ -71,7 +73,8 @@ class _Rec:
     __slots__ = ("uid", "submit_t", "admit_t", "first_token_t",
                  "last_token_t", "tokens", "spill_stall_s", "spills",
                  "finish_t", "prefill_end_t", "prefill_computed",
-                 "prefill_cached", "errors", "router_accept_t")
+                 "prefill_cached", "errors", "router_accept_t",
+                 "handoff_stall_s", "handoffs")
 
     def __init__(self, uid: Any, submit_t: float):
         self.uid = uid
@@ -88,6 +91,8 @@ class _Rec:
         self.prefill_computed = 0
         self.prefill_cached = 0
         self.errors = 0
+        self.handoff_stall_s = 0.0
+        self.handoffs = 0
 
 
 class RequestLatencyTracker:
@@ -103,11 +108,16 @@ class RequestLatencyTracker:
         # keeps their registry children apart (solo engines keep the
         # empty label value)
         self.replica = str(replica)
+        # disaggregated serving: the replica's ROLE ("prefill"/"decode",
+        # "" when fused) — folded into the histogram label so TTFT/TPOT
+        # attribute to the right side of the split
+        self.phase = ""
         self._live: Dict[Any, _Rec] = {}
         self._done: deque = deque(maxlen=max_completed)
         self.submitted = 0
         self.finished = 0
         self.cancelled = 0
+        self.handed_off = 0
         # "auto": the process registry singleton (respects its enabled
         # flag); None/False: no metrics feed; else an injected registry.
         self._registry = registry
@@ -118,6 +128,15 @@ class RequestLatencyTracker:
         """Re-label after construction (ReplicaSet assigns indices);
         drops cached children so future observations carry the label."""
         self.replica = str(replica)
+        self._hists.clear()
+        self._hist_fams.clear()
+
+    def set_phase(self, phase: str) -> None:
+        """Tag this tracker with the replica's serving role (``""`` /
+        ``"prefill"`` / ``"decode"``); future observations land under a
+        ``replica/phase`` label value so the two roles' TTFT/TPOT stay
+        separate series."""
+        self.phase = str(phase)
         self._hists.clear()
         self._hist_fams.clear()
 
@@ -134,7 +153,9 @@ class RequestLatencyTracker:
                                 labels=("replica",),
                                 buckets=_metrics_mod.MS_BUCKETS)
             self._hist_fams[name] = fam
-            h = fam.labels(replica=self.replica)
+            label = (f"{self.replica}/{self.phase}" if self.phase
+                     else self.replica)
+            h = fam.labels(replica=label)
             self._hists[name] = h
         h.observe(value_ms)
 
@@ -198,6 +219,34 @@ class RequestLatencyTracker:
         if r is not None:
             r.spill_stall_s += float(seconds)
 
+    def on_handoff_stall(self, uid: Any, seconds: float) -> None:
+        """Receiver-side handoff stall: prefill-replica export to
+        decode-replica install, stamped on the DECODE replica's record
+        (the stall delays that replica's re-admission of the request)."""
+        r = self._live.get(uid)
+        if r is not None:
+            r.handoff_stall_s += float(seconds)
+            r.handoffs += 1
+
+    def on_handoff_out(self, uid: Any) -> Optional[Dict[str, Any]]:
+        """Donor-side handoff: the request leaves this (prefill-role)
+        replica after its first token.  Closes the record here —
+        TTFT/queue-wait/prefill attribute to the prefill role; the
+        decode replica's fresh record owns TPOT from its own import."""
+        r = self._live.pop(uid, None)
+        if r is None:
+            return None
+        r.finish_t = self.clock()
+        self._done.append(r)
+        self.handed_off += 1
+        rec = self._rec_summary(r)
+        for name in ("ttft_ms", "queue_wait_ms", "router_queue_wait_ms",
+                     "prefill_ms"):
+            v = rec.get(name)
+            if v is not None:
+                self._observe(name, v)
+        return rec
+
     def on_error(self, uid: Any) -> None:
         """A recoverable per-request fault (e.g. KV restore failure
         forcing re-prefill) — feeds the tail sampler's error arm."""
@@ -225,7 +274,7 @@ class RequestLatencyTracker:
         rec = self._rec_summary(r)
         for name in ("ttft_ms", "tpot_ms", "queue_wait_ms",
                      "router_queue_wait_ms", "spill_stall_ms",
-                     "prefill_ms"):
+                     "prefill_ms", "handoff_stall_ms"):
             v = rec.get(name)
             if v is not None:
                 self._observe(name, v)
@@ -259,8 +308,11 @@ class RequestLatencyTracker:
             "prefill_ms": ((r.prefill_end_t - r.admit_t) * 1e3
                            if r.prefill_end_t is not None
                            and r.admit_t is not None else None),
+            "handoff_stall_ms": (r.handoff_stall_s * 1e3
+                                 if r.handoffs > 0 else None),
             "tokens": r.tokens,
             "spills": r.spills,
+            "handoffs": r.handoffs,
             "errors": r.errors,
         }
 
@@ -289,10 +341,13 @@ class RequestLatencyTracker:
                            for r in done
                            if r.prefill_end_t is not None
                            and r.admit_t is not None],
+            "handoff_stall_ms": [r.handoff_stall_s * 1e3 for r in done
+                                 if r.handoffs > 0],
         }
         out: Dict[str, Any] = {"completed": len(done),
                                "submitted": self.submitted,
                                "cancelled": self.cancelled,
+                               "handed_off": self.handed_off,
                                "in_flight": len(self._live),
                                "prefill_computed_tokens": sum(
                                    r.prefill_computed for r in done),

@@ -168,6 +168,14 @@ def main() -> int:
                         "static single engine, parked sessions handed "
                         "off in spill format and restored "
                         "digest-verified on the survivor)")
+    p.add_argument("--disagg", action="store_true",
+                   help="also gate disaggregated serving (1 prefill + "
+                        "1 decode replica under a bimodal prompt mix: "
+                        "request conservation, greedy bit-parity vs "
+                        "one fused replica, every handoff "
+                        "digest-verified on the receiver, and the "
+                        "corrupted-wire leg healing by fold to "
+                        "re-prefill)")
     p.add_argument("--chaos", action="store_true",
                    help="also gate serving fault tolerance (one "
                         "replica hang, one mid-stream death, one NVMe "
@@ -1211,6 +1219,137 @@ def main() -> int:
               f"routed_r1={e_stats['routed_r1']} "
               f"survivor_imports={tc['imports']} "
               f"pages_verified={tc['pages_verified']}")
+    if args.disagg:
+        # ---- disaggregated serving: split prefill from decode --------
+        # replica roles as first-class router state: long prompts land
+        # on the prefill replica, run prefill + the first token there,
+        # then the finished KV streams to the decode replica in spill
+        # format (packed bytes + the donor's digests), where the
+        # restore verifies end-to-end; short-chat traffic goes straight
+        # to the decode replica.  Greedy outputs must stay bit-exact vs
+        # one fused replica, and a corrupted wire payload must be
+        # CAUGHT (quarantine + fold to re-prefill), never decoded from.
+        from deepspeed_tpu.resilience import faults as dg_faults
+        from deepspeed_tpu.serving import ReplicaSet, Router
+
+        dg_rng = np.random.default_rng(args.seed + 6)
+        dg_sizes = (24, 5, 40, 7, 33, 6, 20, 9)   # bimodal mix
+        dg_prompts = [dg_rng.integers(1, 64, size=(n,), dtype=np.int32)
+                      for n in dg_sizes]
+        dg_new = min(args.tokens, 12)
+        dg_long = sum(1 for p in dg_prompts if p.size >= 16)
+
+        def dg_engine(i=0):
+            return RaggedInferenceEngineV2(
+                LlamaForCausalLM(cfg), params=params, max_seqs=4,
+                max_seq_len=max_len, prefill_chunk=16, page_size=16,
+                num_pages=9, decode_block_size=4,
+                kv_reserve="on_demand", kv_tiering={"host_pages": 64},
+                rng=jax.random.PRNGKey(args.seed))
+
+        dg_ref_eng = dg_engine()
+        dg_ref = {}
+        dg_order = {dg_ref_eng.put_request(p, max_new_tokens=dg_new): i
+                    for i, p in enumerate(dg_prompts)}
+        while dg_ref_eng.has_work():
+            dg_ref_eng.step()
+            for uid, toks in dg_ref_eng.get_outputs():
+                dg_ref[dg_order[uid]] = toks
+        dg_ref_eng.sync()
+        for uid, toks in dg_ref_eng.get_outputs():
+            dg_ref[dg_order[uid]] = toks
+        dg_ref_eng.close()
+
+        def dg_run(inject=None):
+            rs = ReplicaSet(dg_engine, 2)
+            router = Router(rs, policy="least_tokens")
+            router.set_roles({"r0": "prefill", "r1": "decode"})
+            rids = {router.submit(p, max_new_tokens=dg_new): i
+                    for i, p in enumerate(dg_prompts)}
+            outs = router.drain()
+            stats = router.stats()
+            pre, dec = rs.handles[0].engine, rs.handles[1].engine
+            pre.audit_kv_sharing()
+            dec.audit_kv_sharing()
+            res = {"outs": {rids[r]: t for r, t in outs.items()},
+                   "stats": stats,
+                   "pre_handoffs": pre.handoffs,
+                   "dec_tiering": dict(dec.tiering.counters),
+                   "handed_off": pre.request_latency.handed_off,
+                   "stall_p50": dec.request_latency.summary().get(
+                       "handoff_stall_ms_p50")}
+            rs.close()
+            return res
+
+        clean = dg_run()
+        ok_conserve = sorted(clean["outs"]) == sorted(dg_ref)
+        if not ok_conserve:
+            print(f"FAIL [disagg]: request conservation broke "
+                  f"({len(clean['outs'])} of {len(dg_ref)} finished)")
+            failures += 1
+        else:
+            diverged = [i for i in dg_ref
+                        if not np.array_equal(clean["outs"][i],
+                                              dg_ref[i])]
+            if diverged:
+                print(f"FAIL [disagg]: greedy outputs diverged from "
+                      f"the fused replica for requests {diverged}")
+                failures += 1
+        st = clean["stats"]
+        if not (st["handoffs"] == st["handoff_kv"] == dg_long
+                and st["handoff_reprefill"] == 0):
+            print(f"FAIL [disagg]: vacuous split — expected {dg_long} "
+                  f"KV-path handoffs, got handoffs={st['handoffs']} "
+                  f"kv={st['handoff_kv']} "
+                  f"reprefill={st['handoff_reprefill']}")
+            failures += 1
+        tc = clean["dec_tiering"]
+        if tc["imports"] != st["handoff_kv"]:
+            print(f"FAIL [disagg]: handoff payloads skipped the spill "
+                  f"wire format (receiver imports={tc['imports']} != "
+                  f"kv handoffs={st['handoff_kv']})")
+            failures += 1
+        if not (tc["pages_verified"] == tc["pages_restored"] > 0
+                and tc["quarantined"] == 0):
+            print(f"FAIL [disagg]: restored pages skipped digest "
+                  f"verification (verified={tc['pages_verified']} "
+                  f"restored={tc['pages_restored']} "
+                  f"quarantined={tc['quarantined']})")
+            failures += 1
+        if clean["handed_off"] != dg_long or not clean["stall_p50"]:
+            print(f"FAIL [disagg]: handoff telemetry did not land "
+                  f"(donor handed_off={clean['handed_off']}, receiver "
+                  f"stall p50={clean['stall_p50']})")
+            failures += 1
+
+        # degraded leg: a bitflip on every handoff wire payload — the
+        # donor's digests must catch it at restore (quarantine), the
+        # session folds to a re-prefill continuation, parity holds
+        with dg_faults.FaultInjector(seed=args.seed) as dg_inj:
+            dg_inj.bitflip("handoff.import", bits=1, count=100)
+            hurt = dg_run(inject=True)
+        ok_conserve = sorted(hurt["outs"]) == sorted(dg_ref)
+        diverged = ([] if not ok_conserve else
+                    [i for i in dg_ref
+                     if not np.array_equal(hurt["outs"][i], dg_ref[i])])
+        if not ok_conserve or diverged:
+            print(f"FAIL [disagg]: corrupted-wire leg broke parity "
+                  f"(conserved={ok_conserve}, diverged={diverged})")
+            failures += 1
+        htc = hurt["dec_tiering"]
+        if not (htc["quarantined"] > 0
+                and any(s == "handoff.import"
+                        for s, _, _ in dg_inj.fired)):
+            print(f"FAIL [disagg]: corrupted handoff payload was not "
+                  f"quarantined (quarantined={htc['quarantined']}, "
+                  f"fired={len(dg_inj.fired)}) — silent SDC risk")
+            failures += 1
+        print(f"[disagg] requests={len(clean['outs'])} "
+              f"handoffs={st['handoffs']} kv={st['handoff_kv']} "
+              f"imports={tc['imports']} "
+              f"pages_verified={tc['pages_verified']} "
+              f"stall_p50_ms={clean['stall_p50']} "
+              f"corrupted_quarantined={htc['quarantined']}")
     if args.autotune:
         # ---- closed-loop control plane over a mis-tuned engine -------
         # the controller must walk a deliberately detuned engine back
@@ -1456,6 +1595,9 @@ def main() -> int:
            "drain endings" if args.frontdoor else "") +
           (", elastic grow+shrink conserved every request bit-exactly "
            "with digest-verified handoff" if args.elastic else "") +
+          (", disaggregated 1P+1D bit-identical to fused with every "
+           "handoff digest-verified and the corrupted wire quarantined"
+           if args.disagg else "") +
           (", chaos campaign conserved every request through hang/"
            "death/NVMe faults within watchdog overhead budget"
            if args.chaos else "") +

@@ -71,6 +71,15 @@ _RESILIENCE_REPLICA = {"breaker_trip", "breaker_suspect",
                        "hedge_won", "hedge_lost", "replica_hang"}
 _RESILIENCE_TIER = {"tier_degraded", "tier_rearmed"}
 
+# the disaggregated-serving handoff vocabulary (serving.router): one
+# span quartet per handed-off session — export (donor parks + packs),
+# transfer (the blob between the export and import folds), import
+# (receiver install) and verify (the digest bracket) — every span
+# naming the router rid and both replicas, so a handoff's timeline
+# reconstructs from the trace alone
+_HANDOFF_SPANS = {"handoff_export", "handoff_transfer",
+                  "handoff_import", "handoff_verify"}
+
 
 def load_events(path: str) -> Tuple[List[Dict[str, Any]], str]:
     """Load events from either format; returns ``(events, kind)`` where
@@ -267,6 +276,28 @@ def validate_events(events: List[Dict[str, Any]]) -> List[str]:
                 if not isinstance(val, str) or not val:
                     problems.append(f"event {i}: {name} missing str "
                                     f"'{key}' arg (got {val!r})")
+        if ev.get("cat") == "handoff":
+            # prefill->decode handoffs are a reconstruction contract:
+            # complete spans only, every one naming the router rid and
+            # the source/destination replicas of the wire transfer
+            name = ev.get("name")
+            if name not in _HANDOFF_SPANS:
+                problems.append(f"event {i}: unknown handoff event "
+                                f"{name!r}")
+            elif ph != "X":
+                problems.append(f"event {i}: handoff span {name!r} "
+                                f"must be a complete span")
+            else:
+                a = ev.get("args", {})
+                rid = a.get("rid")
+                if not isinstance(rid, int) or isinstance(rid, bool):
+                    problems.append(f"event {i}: {name} missing int "
+                                    f"'rid' arg (got {rid!r})")
+                for key in ("src", "dst"):
+                    val = a.get(key)
+                    if not isinstance(val, str) or not val:
+                        problems.append(f"event {i}: {name} missing "
+                                        f"str '{key}' arg (got {val!r})")
         if len(problems) >= 20:
             problems.append("... (stopping after 20 problems)")
             break
